@@ -1,0 +1,102 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Capability surface of Ray (tasks, actors, objects, placement groups,
+collectives, Train/Tune/Data/Serve/RL libraries) re-architected TPU-first:
+scheduling is slice/chip aware, the data plane between chips is XLA
+collectives over ICI/DCN (not NCCL object push), and the compute path is
+jax/pjit/pallas SPMD programs.
+
+Quick start::
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21)) == 42
+
+Heavy subsystems (``ray_tpu.train``, ``ray_tpu.data``, ``ray_tpu.parallel``,
+``ray_tpu.ops``, ``ray_tpu.models``, ``ray_tpu.collective``) are imported
+lazily so that worker processes and non-jax users never pay jax import cost.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ._private import runtime as _runtime_mod
+from ._private.api import (ActorClass, ActorHandle, ActorMethod, ObjectRef,
+                           PlacementGroup, RemoteFunction, available_resources,
+                           cluster_resources, get, get_actor, kill, nodes,
+                           placement_group, put, remote,
+                           remove_placement_group, wait)
+from ._private.exceptions import (ActorError, GetTimeoutError, ObjectLostError,
+                                  RayTpuError, TaskError, WorkerCrashedError)
+from ._private.scheduler import (NodeAffinitySchedulingStrategy,
+                                 PlacementGroupSchedulingStrategy)
+
+__version__ = "0.1.0"
+
+_init_lock = threading.Lock()
+
+
+def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: str = "default", ignore_reinit_error: bool = True,
+         **_compat: Any):
+    """Start the ray_tpu runtime in this process (driver).
+
+    Reference analog: ray.init (python/ray/_private/worker.py:1441) — but the
+    control plane, node plane and driver live in one process for single-host
+    sessions; worker processes are spawned on demand.
+    """
+    with _init_lock:
+        if _runtime_mod.driver_runtime() is not None:
+            if ignore_reinit_error:
+                return _runtime_mod.driver_runtime()
+            raise RuntimeError("ray_tpu.init() already called")
+        return _runtime_mod.init_runtime(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+            namespace=namespace)
+
+
+def is_initialized() -> bool:
+    return _runtime_mod.current_runtime() is not None
+
+
+def shutdown() -> None:
+    rt = _runtime_mod.driver_runtime()
+    if rt is not None:
+        rt.shutdown()
+
+
+def _private_worker_mode(worker_runtime) -> None:
+    """Called by worker_entry to install the worker-side runtime facade."""
+    _runtime_mod.set_worker_runtime(worker_runtime)
+
+
+def __getattr__(name: str):
+    # Lazy submodule loading: ray_tpu.train / data / parallel / ops / models /
+    # collective / tune / serve / rl / util.
+    import importlib
+    if name in ("train", "data", "parallel", "ops", "models", "collective",
+                "tune", "serve", "rl", "util", "accelerators"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "get_actor", "cluster_resources", "available_resources", "nodes",
+    "placement_group", "remove_placement_group", "PlacementGroup",
+    "ObjectRef", "ActorHandle", "ActorClass", "ActorMethod", "RemoteFunction",
+    "NodeAffinitySchedulingStrategy", "PlacementGroupSchedulingStrategy",
+    "RayTpuError", "TaskError", "ActorError", "WorkerCrashedError",
+    "ObjectLostError", "GetTimeoutError",
+]

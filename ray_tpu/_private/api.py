@@ -1,0 +1,396 @@
+"""Public API objects: remote functions, actors, object refs, placement groups.
+
+Maps the reference's Python API layer (reference:
+python/ray/remote_function.py:41 RemoteFunction/_remote:314,
+python/ray/actor.py:1445 ActorClass/_remote:1024, ActorHandle:2128,
+ActorMethod:825, python/ray/includes/object_ref.pxi:50 ObjectRef) onto the
+ray_tpu Runtime.  Both driver and worker processes use the same classes; the
+runtime facade (``current_runtime``) routes calls to the in-process Runtime on
+the driver or over the worker pipe inside tasks.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from . import serialization
+from .config import Config
+from .exceptions import RayTpuError
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
+from .protocol import TaskSpec
+from .resources import ResourceSet, task_resources
+from .runtime import current_runtime, driver_runtime
+from .scheduler import (NodeAffinitySchedulingStrategy,
+                        PlacementGroupSchedulingStrategy)
+
+
+def _require_runtime():
+    rt = current_runtime()
+    if rt is None:
+        raise RayTpuError("ray_tpu.init() has not been called")
+    return rt
+
+
+def _control(method: str, *args, **kwargs):
+    rt = _require_runtime()
+    if hasattr(rt, "control"):  # WorkerRuntime
+        return rt.control(method, *args, **kwargs)
+    return getattr(rt, "ctl_" + method)(*args, **kwargs)
+
+
+class ObjectRef:
+    """Handle to a (possibly pending) immutable object
+    (reference: python/ray/includes/object_ref.pxi:50)."""
+
+    __slots__ = ("_id",)
+
+    def __init__(self, object_id: ObjectID):
+        self._id = object_id
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id,))
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def future(self):
+        import concurrent.futures
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def fill():
+            try:
+                fut.set_result(get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+        threading.Thread(target=fill, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        """Support `await ref` inside async actors."""
+        import asyncio
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+def _pack_arg(value: Any):
+    """Convert one call argument into a TaskSpec descriptor."""
+    if isinstance(value, ObjectRef):
+        return ("ref", value.id())
+    payload = serialization.pack_payload(value)
+    if len(payload) > Config.get("max_inline_object_size"):
+        # Large argument: promote to an object so it travels via shm once.
+        return ("ref", _put_value(value))
+    return ("val", payload)
+
+
+def _put_value(value: Any) -> ObjectID:
+    rt = _require_runtime()
+    return rt.put(value)
+
+
+def _next_task_id() -> TaskID:
+    rt = _require_runtime()
+    if hasattr(rt, "current_task_id") and rt.current_task_id is not None:
+        return TaskID.of(rt.current_task_id.actor_id())
+    if hasattr(rt, "current_actor_id") and rt.current_actor_id is not None:
+        return TaskID.of(rt.current_actor_id)
+    job_id = rt.job_id
+    from .ids import ActorID as _A
+    nil_actor = _A(job_id.binary() + b"\x00" * 8)
+    return TaskID.of(nil_actor)
+
+
+def _normalize_strategy(options: Dict[str, Any]):
+    strategy = options.get("scheduling_strategy")
+    pg, bundle = None, -1
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pgh = strategy.placement_group
+        pg = pgh.id if isinstance(pgh, PlacementGroup) else pgh
+        bundle = strategy.placement_group_bundle_index
+        strategy = None
+    if options.get("placement_group") is not None:
+        pgh = options["placement_group"]
+        pg = pgh.id if isinstance(pgh, PlacementGroup) else pgh
+        bundle = options.get("placement_group_bundle_index", -1)
+    return strategy, pg, bundle
+
+
+class RemoteFunction:
+    def __init__(self, fn, **default_options):
+        self._fn = fn
+        self._options = default_options
+        self._fn_blob: Optional[bytes] = None
+        functools.update_wrapper(self, fn)
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(options)
+        rf = RemoteFunction(self._fn, **merged)
+        rf._fn_blob = self._fn_blob
+        return rf
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__!r} cannot be called "
+            "directly; use .remote()")
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        rt = _require_runtime()
+        opts = self._options
+        if self._fn_blob is None:
+            self._fn_blob = serialization.dumps_control(self._fn)
+        num_returns = opts.get("num_returns", 1)
+        task_id = _next_task_id()
+        return_ids = [ObjectID.of(task_id, i) for i in range(num_returns)]
+        strategy, pg, bundle = _normalize_strategy(opts)
+        resources = task_resources(opts.get("num_cpus"), opts.get("num_tpus"),
+                                   opts.get("memory"), opts.get("resources"),
+                                   default_num_cpus=1.0)
+        spec = TaskSpec(
+            task_id=task_id,
+            name=opts.get("name") or self._fn.__name__,
+            fn_blob=self._fn_blob, method_name=None,
+            arg_descs=[_pack_arg(a) for a in args],
+            kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
+            return_ids=return_ids, resources=resources,
+            max_retries=opts.get("max_retries",
+                                 Config.get("task_max_retries_default")),
+            placement_group=pg, bundle_index=bundle,
+            scheduling_strategy=strategy,
+            runtime_env=opts.get("runtime_env"))
+        rt.submit_spec(spec)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name,
+                           opts.get("num_returns", self._num_returns))
+
+    def remote(self, *args, **kwargs):
+        rt = _require_runtime()
+        task_id = TaskID.of(self._handle._actor_id)
+        return_ids = [ObjectID.of(task_id, i)
+                      for i in range(self._num_returns)]
+        spec = TaskSpec(
+            task_id=task_id,
+            name=f"{self._handle._class_name}.{self._name}",
+            fn_blob=None, method_name=self._name,
+            arg_descs=[_pack_arg(a) for a in args],
+            kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
+            return_ids=return_ids, resources=ResourceSet(),
+            actor_id=self._handle._actor_id,
+            max_concurrency=self._handle._max_concurrency)
+        rt.submit_spec(spec)
+        refs = [ObjectRef(oid) for oid in return_ids]
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = "",
+                 max_concurrency: int = 1):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._max_concurrency = max_concurrency
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (ActorHandle,
+                (self._actor_id, self._class_name, self._max_concurrency))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()})"
+
+
+class ActorClass:
+    def __init__(self, cls, **default_options):
+        self._cls = cls
+        self._options = default_options
+        self._cls_blob: Optional[bytes] = None
+
+    def options(self, **options) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(options)
+        ac = ActorClass(self._cls, **merged)
+        ac._cls_blob = self._cls_blob
+        return ac
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__!r} cannot be instantiated "
+            "directly; use .remote()")
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        rt = _require_runtime()
+        opts = self._options
+        name = opts.get("name")
+        if name and opts.get("get_if_exists"):
+            existing = _control("get_named_actor", name,
+                                opts.get("namespace"))
+            if existing is not None:
+                aid, _mr, cls_name = existing
+                return ActorHandle(ActorID(aid), cls_name,
+                                   opts.get("max_concurrency", 1))
+        if self._cls_blob is None:
+            self._cls_blob = serialization.dumps_control(self._cls)
+        actor_id = ActorID.of(rt.job_id)
+        max_restarts = opts.get("max_restarts",
+                                Config.get("actor_max_restarts_default"))
+        _control("register_actor", actor_id.binary(), name,
+                 opts.get("namespace"), max_restarts, self._cls.__name__)
+        strategy, pg, bundle = _normalize_strategy(opts)
+        resources = task_resources(opts.get("num_cpus"), opts.get("num_tpus"),
+                                   opts.get("memory"), opts.get("resources"),
+                                   default_num_cpus=0.0)
+        spec = TaskSpec(
+            task_id=TaskID.of(actor_id),
+            name=f"{self._cls.__name__}.__init__",
+            fn_blob=self._cls_blob, method_name=None,
+            arg_descs=[_pack_arg(a) for a in args],
+            kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
+            return_ids=[], resources=resources,
+            create_actor_id=actor_id,
+            placement_group=pg, bundle_index=bundle,
+            scheduling_strategy=strategy,
+            runtime_env=opts.get("runtime_env"),
+            max_concurrency=opts.get("max_concurrency", 1))
+        _control("actor_creation_spec", actor_id.binary(), spec)
+        rt.submit_spec(spec)
+        return ActorHandle(actor_id, self._cls.__name__,
+                           opts.get("max_concurrency", 1))
+
+
+def remote(*args, **options):
+    """``@remote`` / ``@remote(**options)`` for functions and classes."""
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, **options)
+        return RemoteFunction(target, **options)
+    if len(args) == 1 and not options and callable(args[0]):
+        return wrap(args[0])
+    if args:
+        raise TypeError("remote() takes keyword options only")
+    return wrap
+
+
+# --------------------------------------------------------------------- #
+# module-level API
+# --------------------------------------------------------------------- #
+
+def get(refs, timeout: Optional[float] = None):
+    rt = _require_runtime()
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef, got {type(r).__name__}")
+    values = rt.get([r.id() for r in ref_list], timeout)
+    return values[0] if single else values
+
+
+def put(value: Any) -> ObjectRef:
+    return ObjectRef(_put_value(value))
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    rt = _require_runtime()
+    ids = [r.id() for r in refs]
+    ready_ids, pending_ids = rt.wait(ids, num_returns, timeout, fetch_local)
+    by_id = {r.id(): r for r in refs}
+    return [by_id[i] for i in ready_ids], [by_id[i] for i in pending_ids]
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    _control("kill_actor", actor._actor_id.binary(), no_restart)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    found = _control("get_named_actor", name, namespace)
+    if found is None:
+        raise ValueError(f"no actor named {name!r}")
+    aid, _mr, cls_name = found
+    return ActorHandle(ActorID(aid), cls_name)
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _control("cluster_resources")
+
+
+def available_resources() -> Dict[str, float]:
+    return _control("available_resources")
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return _control("nodes")
+
+
+# --------------------------------------------------------------------- #
+# placement groups (reference: python/ray/util/placement_group.py)
+# --------------------------------------------------------------------- #
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundle_count: int = 0):
+        self.id = pg_id
+        self.bundle_count = bundle_count
+
+    def ready(self, timeout: Optional[float] = 30.0) -> bool:
+        deadline = None if timeout is None else (timeout + _mono())
+        while True:
+            state = _control("pg_state", self.id.binary())
+            if state == "CREATED":
+                return True
+            if state in ("REMOVED", None):
+                return False
+            if deadline is not None and _mono() > deadline:
+                return False
+            import time
+            time.sleep(0.01)
+
+    def bundle_locations(self):
+        return _control("pg_bundle_locations", self.id.binary())
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_count))
+
+
+def _mono() -> float:
+    import time
+    return time.monotonic()
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: Optional[str] = None) -> PlacementGroup:
+    pg_id_bytes = _control("create_pg", bundles, strategy, name)
+    return PlacementGroup(PlacementGroupID(pg_id_bytes), len(bundles))
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    _control("remove_pg", pg.id.binary())

@@ -1,0 +1,174 @@
+"""Flag/config system for the ray_tpu runtime.
+
+Mirrors the capability of the reference's single-source flag registry
+(reference: src/ray/common/ray_config_def.h — 241 ``RAY_CONFIG(type, name,
+default)`` macros, each overridable by a ``RAY_<name>`` env var and by a JSON
+blob pushed from the frontend at process start).  Here the registry is a
+declarative table of typed flags; precedence is
+
+    explicit ``Config.initialize(overrides)``  >  env ``RAY_TPU_<NAME>``  >  default.
+
+Workers inherit the driver's resolved config through a serialized JSON blob in
+their spawn environment, so every process in a cluster sees one consistent
+view (same contract as RayConfig::initialize in the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+ENV_PREFIX = "RAY_TPU_"
+# Env var carrying the driver's resolved config to child worker processes.
+CONFIG_BLOB_ENV = "RAY_TPU_CONFIG_BLOB"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: lambda s: int(s, 0),
+    float: float,
+    str: str,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    default: Any
+    doc: str
+
+
+class Config:
+    """Process-wide typed flag registry with env + JSON-blob overrides."""
+
+    _flags: Dict[str, _Flag] = {}
+    _values: Dict[str, Any] = {}
+    _lock = threading.Lock()
+    _initialized = False
+
+    @classmethod
+    def define(cls, name: str, type_: type, default: Any, doc: str = "") -> None:
+        cls._flags[name] = _Flag(name, type_, default, doc)
+
+    @classmethod
+    def initialize(cls, overrides: Optional[Dict[str, Any]] = None) -> None:
+        """Resolve all flags. Called once at init; idempotent refresh allowed."""
+        with cls._lock:
+            values: Dict[str, Any] = {}
+            blob = os.environ.get(CONFIG_BLOB_ENV)
+            blob_values = json.loads(blob) if blob else {}
+            for name, flag in cls._flags.items():
+                val = flag.default
+                if name in blob_values:
+                    val = blob_values[name]
+                env_val = os.environ.get(ENV_PREFIX + name.upper())
+                if env_val is not None:
+                    val = _PARSERS[flag.type](env_val)
+                if overrides and name in overrides:
+                    val = overrides[name]
+                if val is not None and not isinstance(val, flag.type):
+                    val = flag.type(val)
+                values[name] = val
+            cls._values = values
+            cls._initialized = True
+
+    @classmethod
+    def get(cls, name: str) -> Any:
+        if not cls._initialized:
+            cls.initialize()
+        try:
+            return cls._values[name]
+        except KeyError:
+            raise KeyError(f"unknown config flag: {name}") from None
+
+    @classmethod
+    def set(cls, name: str, value: Any) -> None:
+        if not cls._initialized:
+            cls.initialize()
+        if name not in cls._flags:
+            raise KeyError(f"unknown config flag: {name}")
+        with cls._lock:
+            cls._values[name] = value
+
+    @classmethod
+    def blob(cls) -> str:
+        """JSON blob of the resolved config, for child process inheritance."""
+        if not cls._initialized:
+            cls.initialize()
+        return json.dumps(cls._values)
+
+    @classmethod
+    def all(cls) -> Dict[str, Any]:
+        if not cls._initialized:
+            cls.initialize()
+        return dict(cls._values)
+
+
+D = Config.define
+
+# --- Object store ----------------------------------------------------------
+# Inline threshold mirrors max_direct_call_object_size (reference:
+# src/ray/common/ray_config_def.h:245, 100 KiB).
+D("max_inline_object_size", int, 100 * 1024,
+  "Objects <= this many bytes travel inline in control messages; larger ones "
+  "go to the shared-memory store.")
+D("object_store_memory", int, 2 * 1024 ** 3,
+  "Soft cap on bytes resident in the host shared-memory object store.")
+D("object_spill_dir", str, "",
+  "Directory for spilling objects when the store exceeds its cap "
+  "(empty = <session_dir>/spill).")
+
+# --- Scheduler -------------------------------------------------------------
+D("scheduler_spread_threshold", float, 0.5,
+  "Hybrid policy: pack onto nodes under this utilization, then spread "
+  "(reference: hybrid_scheduling_policy.cc top_k logic).")
+D("lease_timeout_s", float, 30.0, "Worker lease request timeout.")
+D("max_pending_lease_requests_per_key", int, 10,
+  "Pipelined lease requests per scheduling key.")
+
+# --- Worker pool -----------------------------------------------------------
+D("num_workers_soft_limit", int, 0,
+  "Max resident idle workers per node (0 = num_cpus).")
+D("worker_register_timeout_s", float, 60.0,
+  "How long to wait for a spawned worker to call back.")
+D("worker_idle_kill_s", float, 300.0,
+  "Idle workers beyond the soft limit are reaped after this long.")
+D("worker_start_method", str, "spawn",
+  "multiprocessing start method for worker processes.")
+
+# --- Health / fault tolerance ---------------------------------------------
+D("health_check_period_s", float, 1.0,
+  "Controller -> node liveness probe period (reference: "
+  "gcs_health_check_manager.h timeouts).")
+D("health_check_failure_threshold", int, 5,
+  "Consecutive missed probes before a node is declared dead.")
+D("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")
+D("actor_max_restarts_default", int, 0, "Default actor restarts.")
+
+# --- Chaos / testing (reference: src/ray/rpc/rpc_chaos.cc:33,
+# RAY_testing_rpc_failure) --------------------------------------------------
+D("testing_rpc_failure", str, "",
+  "Comma list 'method=prob' — injected message-drop probability per RPC "
+  "method, for chaos tests.")
+D("testing_delay_us", int, 0,
+  "Injected artificial delay (microseconds) in message dispatch, for "
+  "determinism-shaking tests.")
+
+# --- TPU / accelerator -----------------------------------------------------
+D("tpu_chips_per_host_override", int, 0,
+  "Force chips-per-host for tests (0 = autodetect).")
+D("visible_accelerator_env", str, "TPU_VISIBLE_CHIPS",
+  "Env var used to pin a worker to its granted chips (reference: "
+  "python/ray/_private/accelerators/tpu.py NOSET/VISIBLE chips plumbing).")
+
+# --- Logging ---------------------------------------------------------------
+D("log_level", str, "INFO", "Runtime log level.")
+D("session_dir", str, "", "Session directory (empty = /tmp/ray_tpu/session_*).")

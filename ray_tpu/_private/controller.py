@@ -1,0 +1,233 @@
+"""Control plane: cluster state tables (the GCS equivalent).
+
+The reference's GCS (reference: src/ray/gcs/gcs_server.h:97) owns node
+registry + health (gcs_node_manager.h, gcs_health_check_manager.h:46), the
+actor FSM (gcs/actor/gcs_actor_manager.h:94 — REGISTER → PENDING → ALIVE →
+RESTARTING/DEAD with max_restarts), placement groups with two-phase bundle
+commit (gcs_placement_group_scheduler.h:115), a job table, an internal KV
+(gcs_kv_manager.h) and pubsub.  This module is the same control plane as
+plain in-process tables behind a lock; the transport seam (every mutation is a
+method call) is where a gRPC service drops in for multi-host deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .ids import ActorID, JobID, NodeID, PlacementGroupID
+from .protocol import TaskSpec
+from .resources import ResourceSet
+
+# Actor FSM states (reference: gcs_actor_manager.h FSM)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+# Placement group states
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    hostname: str
+    total_resources: ResourceSet
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    is_head: bool = False
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    state: str
+    creation_spec: Optional[TaskSpec]
+    max_restarts: int
+    num_restarts: int = 0
+    node_id: Optional[NodeID] = None
+    death_cause: Optional[str] = None
+    namespace: str = "default"
+    class_name: str = ""
+
+
+@dataclass
+class BundleInfo:
+    index: int
+    resources: ResourceSet
+    node_id: Optional[NodeID] = None  # committed location
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    name: Optional[str]
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    bundles: List[BundleInfo]
+    state: str = PG_PENDING
+
+
+@dataclass
+class JobInfo:
+    job_id: JobID
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    entrypoint: str = ""
+
+
+class Controller:
+    """In-process GCS-equivalent state store."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[tuple, ActorID] = {}  # (namespace, name)
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.jobs: Dict[JobID, JobInfo] = {}
+        self._kv: Dict[str, Dict[str, bytes]] = {}
+        self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
+
+    # -- nodes --------------------------------------------------------------
+
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self.nodes[info.node_id] = info
+        self.publish("node_added", info)
+
+    def heartbeat(self, node_id: NodeID) -> None:
+        with self._lock:
+            n = self.nodes.get(node_id)
+            if n:
+                n.last_heartbeat = time.monotonic()
+
+    def mark_node_dead(self, node_id: NodeID, reason: str = "") -> None:
+        with self._lock:
+            n = self.nodes.get(node_id)
+            if not n or not n.alive:
+                return
+            n.alive = False
+        self.publish("node_removed", node_id)
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    # -- jobs ---------------------------------------------------------------
+
+    def register_job(self, info: JobInfo) -> None:
+        with self._lock:
+            self.jobs[info.job_id] = info
+
+    def finish_job(self, job_id: JobID) -> None:
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j:
+                j.end_time = time.time()
+
+    # -- actors -------------------------------------------------------------
+
+    def register_actor(self, info: ActorInfo) -> None:
+        with self._lock:
+            self.actors[info.actor_id] = info
+            if info.name:
+                key = (info.namespace, info.name)
+                if key in self.named_actors:
+                    existing = self.actors.get(self.named_actors[key])
+                    if existing and existing.state != DEAD:
+                        raise ValueError(
+                            f"actor name {info.name!r} already taken in "
+                            f"namespace {info.namespace!r}")
+                self.named_actors[key] = info.actor_id
+
+    def set_actor_state(self, actor_id: ActorID, state: str,
+                        node_id: Optional[NodeID] = None,
+                        death_cause: Optional[str] = None) -> None:
+        with self._lock:
+            a = self.actors.get(actor_id)
+            if not a:
+                return
+            a.state = state
+            if node_id is not None:
+                a.node_id = node_id
+            if death_cause is not None:
+                a.death_cause = death_cause
+        self.publish("actor_state", (actor_id, state))
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self._lock:
+            return self.actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> Optional[ActorInfo]:
+        with self._lock:
+            aid = self.named_actors.get((namespace, name))
+            return self.actors.get(aid) if aid else None
+
+    def on_node_death_actors(self, node_id: NodeID) -> List[ActorInfo]:
+        """Actors that were living on a dead node (restart candidates)."""
+        with self._lock:
+            return [a for a in self.actors.values()
+                    if a.node_id == node_id and a.state in (ALIVE, PENDING_CREATION)]
+
+    # -- placement groups ---------------------------------------------------
+
+    def register_placement_group(self, info: PlacementGroupInfo) -> None:
+        with self._lock:
+            self.placement_groups[info.pg_id] = info
+
+    def set_pg_state(self, pg_id: PlacementGroupID, state: str) -> None:
+        with self._lock:
+            pg = self.placement_groups.get(pg_id)
+            if pg:
+                pg.state = state
+        self.publish("pg_state", (pg_id, state))
+
+    def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[PlacementGroupInfo]:
+        with self._lock:
+            return self.placement_groups.get(pg_id)
+
+    # -- internal KV (reference: gcs_kv_manager.h) --------------------------
+
+    def kv_put(self, key: str, value: bytes, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        with self._lock:
+            ns = self._kv.setdefault(namespace, {})
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def kv_get(self, key: str, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self._kv.get(namespace, {}).get(key)
+
+    def kv_del(self, key: str, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._kv.get(namespace, {}).pop(key, None) is not None
+
+    def kv_keys(self, prefix: str = "", namespace: str = "default") -> List[str]:
+        with self._lock:
+            return [k for k in self._kv.get(namespace, {}) if k.startswith(prefix)]
+
+    # -- pubsub (reference: src/ray/pubsub/publisher.h) ---------------------
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._subscribers.setdefault(channel, []).append(callback)
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subscribers.get(channel, []))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass

@@ -1,0 +1,68 @@
+"""User-facing exception types (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base for all runtime errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ray.get with remote traceback
+    (reference: RayTaskError in python/ray/exceptions.py)."""
+
+    def __init__(self, cause: BaseException, task_name: str = "",
+                 remote_traceback: str = ""):
+        self.cause = cause
+        self.task_name = task_name
+        self.remote_traceback = remote_traceback
+        super().__init__(
+            f"task {task_name!r} failed: {type(cause).__name__}: {cause}\n"
+            f"--- remote traceback ---\n{remote_traceback}")
+
+    @classmethod
+    def from_exception(cls, exc: BaseException, task_name: str = "") -> "TaskError":
+        return cls(exc, task_name, traceback.format_exc())
+
+    def __reduce__(self):
+        return (TaskError, (self.cause, self.task_name, self.remote_traceback))
+
+
+class ActorError(RayTpuError):
+    """The actor died before or while executing the method
+    (reference: RayActorError)."""
+
+    def __init__(self, actor_id=None, cause: Optional[str] = None):
+        self.actor_id = actor_id
+        super().__init__(f"actor {actor_id} is dead: {cause or 'unknown cause'}")
+
+
+class ActorUnavailableError(RayTpuError):
+    """Actor temporarily unreachable (restarting)."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process died mid-task (reference: WorkerCrashedError)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object value is unrecoverable (reference: ObjectLostError)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get timed out (reference: GetTimeoutError)."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    pass

@@ -1,0 +1,328 @@
+"""Node plane: worker pool + per-node dispatch (the raylet equivalent).
+
+The reference's raylet owns the WorkerPool (reference:
+src/ray/raylet/worker_pool.h:283 — process spawning, idle pools, prestart),
+local dispatch with resource pinning (local_lease_manager.h:61) and the
+node's object store.  Here NodeManager plays that role for one host: it
+spawns Python worker processes (multiprocessing ``spawn`` so jax state never
+leaks across fork), keeps an idle pool, pins TPU chips to granted tasks via
+``TPU_VISIBLE_CHIPS``-style env isolation (reference:
+python/ray/_private/accelerators/tpu.py set_current_process_visible_accelerator_ids),
+and runs one receiver thread per worker that routes TaskDone / nested
+submissions / get requests back into the Runtime.
+
+Chaos hooks are built into the send path from day one (reference:
+src/ray/rpc/rpc_chaos.cc:33 RAY_testing_rpc_failure): configured drop
+probabilities and injected delays apply to every message class.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from multiprocessing.connection import Listener
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from .config import Config
+from .controller import NodeInfo
+from .ids import ActorID, NodeID, TaskID, WorkerID
+from .object_store import SharedMemoryStore
+from .protocol import (ActorStateMsg, GetRequest, KillWorker, PutFromWorker,
+                       RpcCall, RunTask, SubmitFromWorker, TaskDone,
+                       TaskSpec, WaitRequest, WorkerReady)
+from .resources import ResourceSet, TPU
+
+IDLE = "idle"
+BUSY = "busy"
+DEAD = "dead"
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    proc: Any
+    conn: Any
+    state: str = IDLE
+    actor_id: Optional[ActorID] = None
+    running: Set[TaskID] = field(default_factory=set)
+    reader: Optional[threading.Thread] = None
+    ready: threading.Event = field(default_factory=threading.Event)
+    send_lock: threading.Lock = field(default_factory=threading.Lock)
+    assigned_chips: Dict[TaskID, List[int]] = field(default_factory=dict)
+
+
+class NodeManager:
+    def __init__(self, node_info: NodeInfo, runtime, num_tpu_chips: int = 0):
+        self.info = node_info
+        self.runtime = runtime  # driver Runtime; provides message handlers
+        self.store = SharedMemoryStore()
+        self._workers: Dict[WorkerID, WorkerHandle] = {}
+        self._idle: List[WorkerID] = []
+        self._lock = threading.RLock()
+        self._chip_pool: List[int] = list(range(num_tpu_chips))
+        self._closed = False
+        # Workers are spawned as fresh interpreters that dial back in
+        # (reference: worker_pool.h StartWorkerProcess + raylet socket
+        # registration) — no fork, no __main__ re-import, no jax inheritance.
+        self._sock_path = os.path.join(
+            tempfile.mkdtemp(prefix="ray_tpu_"), "node.sock")
+        self._authkey = os.urandom(16)
+        self._listener = Listener(self._sock_path, "AF_UNIX",
+                                  authkey=self._authkey)
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          name="node-acceptor", daemon=True)
+        self._acceptor.start()
+        # chaos config parsed once
+        self._drop_probs: Dict[str, float] = {}
+        spec = Config.get("testing_rpc_failure")
+        if spec:
+            for part in spec.split(","):
+                if "=" in part:
+                    m, p = part.split("=")
+                    self._drop_probs[m.strip()] = float(p)
+
+    # -- worker lifecycle ---------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                if self._closed:
+                    return
+                continue
+            try:
+                hello: WorkerReady = conn.recv()
+            except (EOFError, OSError):
+                conn.close()
+                continue
+            with self._lock:
+                handle = self._workers.get(hello.worker_id)
+            if handle is None:
+                conn.close()
+                continue
+            handle.conn = conn
+            reader = threading.Thread(
+                target=self._reader_loop, args=(handle,),
+                name=f"reader-{hello.worker_id.hex()[:8]}", daemon=True)
+            handle.reader = reader
+            handle.ready.set()
+            reader.start()
+
+    def _spawn_worker(self, env: Optional[Dict[str, str]] = None) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        child_env = dict(os.environ)
+        child_env.update(env or {})
+        child_env.update({
+            "RAY_TPU_WORKER_ID": worker_id.hex(),
+            "RAY_TPU_JOB_ID": self.runtime.job_id.hex(),
+            "RAY_TPU_NODE_SOCK": self._sock_path,
+            "RAY_TPU_AUTHKEY": self._authkey.hex(),
+            "RAY_TPU_CONFIG_BLOB": Config.blob(),
+        })
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=child_env, cwd=os.getcwd())
+        handle = WorkerHandle(worker_id, proc, None)
+        with self._lock:
+            self._workers[worker_id] = handle
+        if not handle.ready.wait(Config.get("worker_register_timeout_s")):
+            raise RuntimeError("worker failed to register in time")
+        return handle
+
+    def _acquire_worker(self) -> WorkerHandle:
+        with self._lock:
+            while self._idle:
+                wid = self._idle.pop()
+                h = self._workers.get(wid)
+                if h is not None and h.state == IDLE:
+                    h.state = BUSY
+                    return h
+        h = self._spawn_worker()
+        h.state = BUSY
+        return h
+
+    def _release_worker(self, handle: WorkerHandle) -> None:
+        with self._lock:
+            if handle.state == DEAD or handle.actor_id is not None:
+                return
+            handle.state = IDLE
+            self._idle.append(handle.worker_id)
+
+    # -- dispatch -----------------------------------------------------------
+
+    def dispatch_task(self, spec: TaskSpec,
+                      resolved_args, resolved_kwargs,
+                      target_worker: Optional[WorkerID] = None) -> None:
+        """Send a fully-resolved task to a worker (lease grant + push)."""
+        if target_worker is not None:
+            with self._lock:
+                handle = self._workers.get(target_worker)
+            if handle is None or handle.state == DEAD:
+                self.runtime.on_dispatch_failed(spec, "target worker dead")
+                return
+        else:
+            handle = self._acquire_worker()
+            if spec.create_actor_id is not None:
+                handle.actor_id = spec.create_actor_id
+        # TPU chip pinning: integral chip grants get exclusive visibility.
+        n_chips = int(spec.resources.get(TPU))
+        if n_chips > 0:
+            with self._lock:
+                grant = self._chip_pool[:n_chips]
+                del self._chip_pool[:n_chips]
+            handle.assigned_chips[spec.task_id] = grant
+            # Never mutate the caller's spec (retries reuse it) and always
+            # overwrite the chip list: a retried task must see its fresh
+            # grant, not the first attempt's chips.
+            env = dict(spec.runtime_env.get("env_vars", {})) if spec.runtime_env else {}
+            env[Config.get("visible_accelerator_env")] = \
+                ",".join(str(c) for c in grant)
+            import copy as _copy
+            spec = _copy.copy(spec)
+            spec.runtime_env = dict(spec.runtime_env or {}, env_vars=env)
+        handle.running.add(spec.task_id)
+        self.runtime.note_task_running(spec.task_id, self.info.node_id,
+                                       handle.worker_id)
+        self._send(handle, RunTask(spec, resolved_args, resolved_kwargs))
+        if spec.create_actor_id is not None:
+            # Bind only after the creation message is on the wire so queued
+            # method calls can never overtake __init__ on the worker pipe.
+            self.runtime.bind_actor_worker(
+                spec.create_actor_id, self.info.node_id, handle.worker_id)
+
+    def _send(self, handle: WorkerHandle, msg) -> None:
+        name = type(msg).__name__
+        delay_us = Config.get("testing_delay_us")
+        if delay_us:
+            time.sleep(random.random() * delay_us / 1e6)
+        p = self._drop_probs.get(name)
+        if p and random.random() < p:
+            return  # chaos: message dropped
+        try:
+            with handle.send_lock:
+                handle.conn.send(msg)
+        except (BrokenPipeError, OSError):
+            pass  # reader loop will notice the death
+
+    def send_to_worker(self, worker_id: WorkerID, msg) -> None:
+        with self._lock:
+            handle = self._workers.get(worker_id)
+        if handle is not None and handle.state != DEAD:
+            self._send(handle, msg)
+
+    # -- receive ------------------------------------------------------------
+
+    def _reader_loop(self, handle: WorkerHandle) -> None:
+        conn = handle.conn
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            try:
+                self._handle_msg(handle, msg)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+        self._on_worker_death(handle)
+
+    def _handle_msg(self, handle: WorkerHandle, msg) -> None:
+        rt = self.runtime
+        if isinstance(msg, WorkerReady):
+            handle.ready.set()
+        elif isinstance(msg, TaskDone):
+            handle.running.discard(msg.task_id)
+            if handle.actor_id is None:
+                chips = handle.assigned_chips.pop(msg.task_id, None)
+                if chips:
+                    with self._lock:
+                        self._chip_pool.extend(chips)
+            # else: an actor keeps its creation chips for its lifetime; they
+            # return to the pool on worker death (_on_worker_death).
+            is_actor_worker = handle.actor_id is not None
+            rt.on_task_done(msg, self.info.node_id)
+            if not is_actor_worker:
+                self._release_worker(handle)
+        elif isinstance(msg, SubmitFromWorker):
+            rt.submit_spec(msg.spec)
+        elif isinstance(msg, GetRequest):
+            rt.on_get_request(self, msg)
+        elif isinstance(msg, WaitRequest):
+            rt.on_wait_request(self, msg)
+        elif isinstance(msg, PutFromWorker):
+            rt.on_put_from_worker(msg)
+        elif isinstance(msg, ActorStateMsg):
+            rt.on_actor_state(msg, self.info.node_id, handle.worker_id)
+        elif isinstance(msg, RpcCall):
+            rt.on_rpc_call(self, msg)
+
+    def _on_worker_death(self, handle: WorkerHandle) -> None:
+        if self._closed:
+            return
+        with self._lock:
+            if handle.state == DEAD:
+                return
+            handle.state = DEAD
+            self._workers.pop(handle.worker_id, None)
+            if handle.worker_id in self._idle:
+                self._idle.remove(handle.worker_id)
+            for task_id, chips in handle.assigned_chips.items():
+                self._chip_pool.extend(chips)
+            handle.assigned_chips.clear()
+            running = list(handle.running)
+        self.runtime.on_worker_died(handle.worker_id, self.info.node_id,
+                                    running, handle.actor_id)
+
+    # -- misc ---------------------------------------------------------------
+
+    def kill_actor_worker(self, worker_id: WorkerID, force: bool = True) -> None:
+        with self._lock:
+            handle = self._workers.get(worker_id)
+        if handle is None:
+            return
+        if force and handle.proc.poll() is None:
+            handle.proc.terminate()
+        else:
+            self._send(handle, KillWorker("actor killed"))
+
+    def num_workers(self) -> int:
+        with self._lock:
+            return len(self._workers)
+
+    def prestart_workers(self, n: int) -> None:
+        for _ in range(n):
+            h = self._spawn_worker()
+            with self._lock:
+                self._idle.append(h.worker_id)
+
+    def shutdown(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        with self._lock:
+            handles = list(self._workers.values())
+            self._workers.clear()
+            self._idle.clear()
+        for h in handles:
+            try:
+                if h.conn is not None:
+                    h.conn.close()
+            except Exception:
+                pass
+            if h.proc.poll() is None:
+                h.proc.terminate()
+        for h in handles:
+            try:
+                h.proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+        self.store.shutdown()

@@ -1,0 +1,271 @@
+"""Host shared-memory object store (plasma equivalent).
+
+The reference's plasma store (reference: src/ray/object_manager/plasma/
+store.h:55 PlasmaStore, eviction_policy.cc LRU, dlmalloc.cc shm arena) holds
+immutable sealed objects in shared memory for zero-copy reads by co-located
+workers, with LRU eviction and disk spill (reference:
+src/ray/raylet/local_object_manager.h:46 SpillObjects/restore).
+
+TPU-native differences: objects here are the *host-side* staging tier — large
+numpy/jax host arrays serialized with out-of-band buffers land in a shm
+segment and deserialize as zero-copy views, from which ``jax.device_put``
+moves them HBM-ward.  Device-to-device movement never goes through this store
+(it rides ICI via XLA collectives); this store serves task args/returns,
+dataset blocks, and checkpoint staging.
+
+Implementation: one POSIX shm segment per object (named ``rt_<id16>``), a
+store index in the owning node process, LRU eviction to a spill directory when
+over the configured cap.  Any process on the host can map a sealed object by
+name without talking to the store (the directory hands out the name).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Dict, Optional, Tuple
+
+from . import serialization
+from .config import Config
+from .ids import ObjectID
+
+
+def _shm_name(object_id: ObjectID) -> str:
+    # Full 22-byte hex (44 chars): truncating would collide ObjectIDs that
+    # differ only in the trailing return-index bytes.
+    return "rt_" + object_id.hex()
+
+
+class _SafeSharedMemory(shared_memory.SharedMemory):
+    """SharedMemory whose close() tolerates live exported views.
+
+    Zero-copy reads hand out numpy views over the mapping; at interpreter
+    exit those views can outlive the segment object, and mmap.close() raises
+    BufferError.  The segment is reclaimed at process exit either way.
+    """
+
+    def close(self) -> None:  # noqa: D102
+        try:
+            super().close()
+        except BufferError:
+            pass
+
+
+def _open_untracked(name: str, create: bool, size: int = 0) -> shared_memory.SharedMemory:
+    """SharedMemory without the resource_tracker auto-unlink.
+
+    Python's resource tracker unlinks segments when any attaching process
+    exits; objects here outlive their creating worker by design, so the store
+    owns unlink explicitly.
+    """
+    shm = _SafeSharedMemory(name=name, create=create, size=size)
+    if create:
+        try:
+            resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+        except Exception:
+            pass
+    return shm
+
+
+@dataclass
+class _Entry:
+    nbytes: int
+    sealed: bool = False
+    pinned: int = 0
+    shm: Optional[shared_memory.SharedMemory] = None
+    spilled_path: Optional[str] = None
+    create_time: float = field(default_factory=time.monotonic)
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class SharedMemoryStore:
+    """Node-local store of immutable shared-memory objects."""
+
+    def __init__(self, capacity_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self._capacity = capacity_bytes or Config.get("object_store_memory")
+        self._spill_dir = spill_dir or Config.get("object_spill_dir") or None
+        self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
+        self._used = 0
+        self._lock = threading.RLock()
+        self.num_spilled = 0
+        self.num_restored = 0
+
+    # -- write path ---------------------------------------------------------
+
+    def create(self, object_id: ObjectID, nbytes: int) -> memoryview:
+        with self._lock:
+            if object_id in self._entries:
+                raise ValueError(f"object {object_id} already exists")
+            self._ensure_space(nbytes)
+            shm = _open_untracked(_shm_name(object_id), create=True,
+                                  size=max(nbytes, 1))
+            self._entries[object_id] = _Entry(nbytes=nbytes, shm=shm)
+            self._used += nbytes
+            return shm.buf[:nbytes]
+
+    def seal(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._entries[object_id].sealed = True
+
+    def put_serialized(self, object_id: ObjectID, meta: bytes, buffers) -> int:
+        nbytes = serialization.payload_nbytes(meta, buffers)
+        view = self.create(object_id, nbytes)
+        serialization.write_payload_into(view, meta, buffers)
+        del view
+        self.seal(object_id)
+        return nbytes
+
+    def put(self, object_id: ObjectID, value: Any) -> int:
+        meta, buffers = serialization.serialize_payload(value)
+        return self.put_serialized(object_id, meta, buffers)
+
+    # -- read path ----------------------------------------------------------
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.sealed
+
+    def get_buffer(self, object_id: ObjectID) -> Tuple[memoryview, Any]:
+        """Returns (payload view, keepalive handle). Restores from spill."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                raise KeyError(f"object {object_id} not in store")
+            if not e.sealed:
+                raise ValueError(f"object {object_id} not sealed")
+            if e.shm is None:
+                self._restore(object_id, e)
+            self._entries.move_to_end(object_id)  # LRU touch
+            return e.shm.buf[: e.nbytes], e.shm
+
+    def get(self, object_id: ObjectID) -> Any:
+        buf, _keepalive = self.get_buffer(object_id)
+        return serialization.read_payload_from(buf)
+
+    def pin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._entries[object_id].pinned += 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e and e.pinned > 0:
+                e.pinned -= 1
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.pop(object_id, None)
+            if e is None:
+                return
+            if e.shm is not None:
+                self._used -= e.nbytes
+                try:
+                    e.shm.close()
+                    e.shm.unlink()
+                except FileNotFoundError:
+                    pass
+            if e.spilled_path and os.path.exists(e.spilled_path):
+                os.unlink(e.spilled_path)
+
+    def shm_name(self, object_id: ObjectID) -> str:
+        return _shm_name(object_id)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"num_objects": len(self._entries), "used_bytes": self._used,
+                    "capacity_bytes": self._capacity,
+                    "num_spilled": self.num_spilled,
+                    "num_restored": self.num_restored}
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for oid in list(self._entries):
+                self.delete(oid)
+
+    # -- eviction / spill ---------------------------------------------------
+
+    def _ensure_space(self, nbytes: int) -> None:
+        if self._used + nbytes <= self._capacity:
+            return
+        # Evict sealed, unpinned, in-memory objects in LRU order.
+        for oid, e in list(self._entries.items()):
+            if self._used + nbytes <= self._capacity:
+                break
+            if e.sealed and e.pinned == 0 and e.shm is not None:
+                self._spill(oid, e)
+        if self._used + nbytes > self._capacity:
+            raise ObjectStoreFullError(
+                f"need {nbytes} bytes; {self._used}/{self._capacity} used and "
+                "nothing evictable")
+
+    def _spill_path(self, object_id: ObjectID) -> str:
+        d = self._spill_dir
+        if not d:
+            d = os.path.join("/tmp", "ray_tpu_spill", str(os.getpid()))
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, object_id.hex())
+
+    def _spill(self, object_id: ObjectID, e: _Entry) -> None:
+        path = self._spill_path(object_id)
+        with open(path, "wb") as f:
+            f.write(e.shm.buf[: e.nbytes])
+        e.spilled_path = path
+        e.shm.close()
+        e.shm.unlink()
+        e.shm = None
+        self._used -= e.nbytes
+        self.num_spilled += 1
+
+    def _restore(self, object_id: ObjectID, e: _Entry) -> None:
+        if not e.spilled_path:
+            raise KeyError(f"object {object_id} has no data and no spill copy")
+        self._ensure_space(e.nbytes)
+        shm = _open_untracked(_shm_name(object_id), create=True,
+                              size=max(e.nbytes, 1))
+        with open(e.spilled_path, "rb") as f:
+            f.readinto(shm.buf)
+        e.shm = shm
+        self._used += e.nbytes
+        self.num_restored += 1
+
+
+class RemoteObjectReader:
+    """Maps sealed objects created by other processes on this host by name."""
+
+    @staticmethod
+    def read(shm_name: str, nbytes: int) -> Any:
+        shm = _open_untracked(shm_name, create=False)
+        try:
+            # Deserialized arrays may view the mapping; copy-free read then
+            # detach on return: loads with buffers keeps views alive via the
+            # returned object, so hold the shm on the object.
+            value = serialization.read_payload_from(shm.buf[:nbytes])
+            if hasattr(value, "__dict__"):
+                try:
+                    value.__dict__["_ray_tpu_shm_keepalive"] = shm
+                except Exception:
+                    pass
+            return value, shm
+        except Exception:
+            shm.close()
+            raise
+
+    @staticmethod
+    def write(shm_name_unused: str, object_id: ObjectID, value: Any) -> Tuple[str, int]:
+        """Create + seal an object segment from a non-owner process."""
+        meta, buffers = serialization.serialize_payload(value)
+        nbytes = serialization.payload_nbytes(meta, buffers)
+        shm = _open_untracked(_shm_name(object_id), create=True,
+                              size=max(nbytes, 1))
+        serialization.write_payload_into(shm.buf[:nbytes], meta, buffers)
+        shm.close()
+        return _shm_name(object_id), nbytes

@@ -1,0 +1,162 @@
+"""Wire-level structures exchanged between driver, node manager and workers.
+
+The reference expresses these as protobufs (reference: src/ray/protobuf/
+common.proto TaskSpec, node_manager.proto, core_worker.proto) carried over
+gRPC; here they are small dataclasses carried over multiprocessing pipes
+(pickle).  The shape is kept close to ``TaskSpecification`` (reference:
+src/ray/common/task/task_spec.h:82) so a later native transport can swap in
+underneath without touching the scheduler or API layers.
+
+Value descriptors (how an argument/return travels):
+    ("inline", payload_bytes)            — packed payload, small objects
+    ("shm", name, nbytes)                — host shared-memory segment
+    ("err", payload_bytes)               — serialized exception
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .resources import ResourceSet
+
+ValueDesc = Tuple  # ("inline", bytes) | ("shm", str, int) | ("err", bytes)
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    name: str
+    # One of: serialized function (normal task / actor ctor) or method name.
+    fn_blob: Optional[bytes]
+    method_name: Optional[str]
+    # Args are ObjectIDs (dependencies) or already-serialized inline values.
+    arg_descs: List[Tuple[str, Any]]  # ("ref", ObjectID) | ("val", bytes)
+    kwarg_descs: Dict[str, Tuple[str, Any]]
+    return_ids: List[ObjectID]
+    resources: ResourceSet
+    actor_id: Optional[ActorID] = None        # actor method target
+    create_actor_id: Optional[ActorID] = None  # actor construction
+    max_retries: int = 0
+    retry_count: int = 0
+    placement_group: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    scheduling_strategy: Optional[Any] = None
+    runtime_env: Optional[Dict[str, Any]] = None
+    max_concurrency: int = 1
+    submitter: str = "driver"  # worker id hex of the submitting process
+
+
+@dataclass
+class RunTask:
+    """node -> worker: execute a task whose args are fully resolved."""
+    spec: TaskSpec
+    resolved_args: List[ValueDesc]
+    resolved_kwargs: Dict[str, ValueDesc]
+
+
+@dataclass
+class TaskDone:
+    """worker -> node: task finished."""
+    task_id: TaskID
+    worker_id: WorkerID
+    results: List[Tuple[ObjectID, ValueDesc]]
+    error: Optional[ValueDesc] = None
+    is_application_error: bool = False
+    actor_id: Optional[ActorID] = None
+    execution_time_s: float = 0.0
+
+
+@dataclass
+class SubmitFromWorker:
+    """worker -> node: nested task/actor submission."""
+    spec: TaskSpec
+
+
+@dataclass
+class GetRequest:
+    """worker -> node: resolve object values for a blocking get."""
+    request_id: int
+    worker_id: WorkerID
+    object_ids: List[ObjectID]
+    timeout_s: Optional[float] = None
+
+
+@dataclass
+class GetReply:
+    """node -> worker."""
+    request_id: int
+    values: List[ValueDesc]
+    timed_out: bool = False
+
+
+@dataclass
+class WaitRequest:
+    request_id: int
+    worker_id: WorkerID
+    object_ids: List[ObjectID]
+    num_returns: int
+    timeout_s: Optional[float]
+    fetch_local: bool = True
+
+
+@dataclass
+class WaitReply:
+    request_id: int
+    ready: List[ObjectID]
+
+
+@dataclass
+class PutFromWorker:
+    """worker -> node: register a worker-created object."""
+    object_id: ObjectID
+    desc: ValueDesc
+    owner_hint: Optional[str] = None
+
+
+@dataclass
+class ActorStateMsg:
+    """worker -> node: actor constructor finished / actor died."""
+    actor_id: ActorID
+    state: str  # "alive" | "error"
+    error: Optional[ValueDesc] = None
+
+
+@dataclass
+class KillWorker:
+    reason: str = ""
+
+
+@dataclass
+class CancelTask:
+    task_id: TaskID
+    force: bool = False
+
+
+@dataclass
+class WorkerReady:
+    worker_id: WorkerID
+    pid: int
+
+
+@dataclass
+class FreeObjects:
+    object_ids: List[ObjectID] = field(default_factory=list)
+
+
+@dataclass
+class RpcCall:
+    """worker -> node: generic control-plane call (KV, actor lookup, ...)."""
+    request_id: int
+    worker_id: WorkerID
+    method: str
+    args: Tuple
+    kwargs: Dict
+
+
+@dataclass
+class RpcReply:
+    request_id: int
+    value: Any = None
+    error: Optional[str] = None

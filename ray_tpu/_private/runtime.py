@@ -1,0 +1,718 @@
+"""Driver-side runtime: ownership, object directory, task routing, actors.
+
+This is the CoreWorker-equivalent for the driver process (reference:
+src/ray/core_worker/core_worker.h:167) plus the pieces of the reference's
+TaskManager / ReferenceCounter / ActorTaskSubmitter that round-1 centralizes
+in the driver:
+
+  * ObjectDirectory — per-object state + waiters (reference: memory store
+    futures, store_provider/memory_store/).
+  * submission routing — normal tasks go through the cluster scheduler
+    (dependency stage + placement, reference: normal_task_submitter.h:86);
+    actor tasks are sequenced per-actor and pushed to the actor's dedicated
+    worker (reference: actor_task_submitter.h:68 SequentialActorSubmitQueue).
+  * failure handling — task retries on worker crash (reference:
+    task_manager.h:248 ResubmitTask), actor restart FSM driven off worker
+    death (reference: gcs_actor_manager.h:94).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import serialization
+from .config import Config
+from .controller import (ALIVE, DEAD, PENDING_CREATION, RESTARTING,
+                         ActorInfo, Controller, JobInfo, NodeInfo,
+                         PlacementGroupInfo)
+from .exceptions import (ActorError, GetTimeoutError, ObjectLostError,
+                         TaskError, WorkerCrashedError)
+from .ids import (ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID,
+                  WorkerID)
+from .node import NodeManager
+from .object_store import RemoteObjectReader
+from .protocol import (ActorStateMsg, GetReply, GetRequest, PutFromWorker,
+                       RpcCall, RpcReply, TaskDone, TaskSpec, WaitReply,
+                       WaitRequest)
+from .resources import CPU, TPU, ResourceSet
+from .scheduler import ClusterScheduler
+
+_runtime_lock = threading.Lock()
+_global_runtime: Optional["Runtime"] = None
+_worker_runtime = None  # set in worker processes
+
+
+def set_worker_runtime(rt) -> None:
+    global _worker_runtime
+    _worker_runtime = rt
+
+
+def current_runtime():
+    """The active runtime facade: WorkerRuntime inside workers, else driver."""
+    if _worker_runtime is not None:
+        return _worker_runtime
+    return _global_runtime
+
+
+def driver_runtime() -> Optional["Runtime"]:
+    return _global_runtime
+
+
+class ObjectState:
+    __slots__ = ("event", "desc", "callbacks", "lock")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.desc = None
+        self.callbacks: List[Callable[[], None]] = []
+        self.lock = threading.Lock()
+
+    def mark_ready(self, desc) -> None:
+        with self.lock:
+            if self.event.is_set():
+                return
+            self.desc = desc
+            self.event.set()
+            cbs, self.callbacks = self.callbacks, []
+        for cb in cbs:
+            cb()
+
+    def add_callback(self, cb: Callable[[], None]) -> None:
+        with self.lock:
+            if not self.event.is_set():
+                self.callbacks.append(cb)
+                return
+        cb()
+
+
+@dataclass
+class _RunningTask:
+    spec: TaskSpec
+    node_id: NodeID
+    worker_id: Optional[WorkerID] = None
+
+
+@dataclass
+class _ActorRuntimeState:
+    worker_id: Optional[WorkerID] = None
+    node_id: Optional[NodeID] = None
+    next_seq: int = 0          # next sequence number to assign
+    next_dispatch: int = 0     # next sequence number eligible to dispatch
+    ready_buffer: Dict[int, Tuple[TaskSpec, list, dict]] = field(default_factory=dict)
+    pending_bind: List[Tuple[TaskSpec, list, dict]] = field(default_factory=list)
+    lock: threading.RLock = field(default_factory=threading.RLock)
+
+
+class Runtime:
+    """Driver-process runtime (controller + scheduler + local node plane)."""
+
+    def __init__(self, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 namespace: str = "default"):
+        Config.initialize()
+        self.job_id = JobID.next()
+        self.namespace = namespace
+        self.driver_task_id = TaskID.for_driver(self.job_id)
+        self.controller = Controller()
+        self.controller.register_job(JobInfo(self.job_id))
+
+        if num_tpus is None:
+            from ..accelerators.tpu import TPUAcceleratorManager
+            num_tpus = TPUAcceleratorManager.detect_num_chips()
+        node_resources: Dict[str, float] = {
+            CPU: float(num_cpus if num_cpus is not None else (os.cpu_count() or 1)),
+            "memory": float(os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"))
+            if hasattr(os, "sysconf") else 64e9,
+        }
+        if num_tpus:
+            node_resources[TPU] = float(num_tpus)
+            from ..accelerators.tpu import TPUAcceleratorManager
+            marker = TPUAcceleratorManager.slice_head_resource_name()
+            if marker:
+                node_resources[marker] = 1.0
+        if resources:
+            node_resources.update(resources)
+
+        self.node_id = NodeID.from_random()
+        node_info = NodeInfo(self.node_id, socket.gethostname(),
+                             ResourceSet(node_resources), is_head=True)
+        self.controller.register_node(node_info)
+
+        self.directory: Dict[ObjectID, ObjectState] = {}
+        self._dir_lock = threading.RLock()
+        self._mapped_segments: Dict[ObjectID, Any] = {}
+
+        self.scheduler = ClusterScheduler(self.controller, self._object_ready)
+        self.scheduler.on_dispatch_error = self._fail_task
+        self.node = NodeManager(node_info, self, num_tpu_chips=int(num_tpus or 0))
+        self.scheduler.add_node(node_info)
+        self.nodes: Dict[NodeID, NodeManager] = {self.node_id: self.node}
+
+        self._running: Dict[TaskID, _RunningTask] = {}
+        self._running_lock = threading.Lock()
+        self._actors: Dict[ActorID, _ActorRuntimeState] = {}
+        self._actors_lock = threading.Lock()
+        self._put_index = 0
+        self._put_lock = threading.Lock()
+        self._shutdown = False
+
+    # ------------------------------------------------------------------ #
+    # object directory
+    # ------------------------------------------------------------------ #
+
+    def _state(self, object_id: ObjectID) -> ObjectState:
+        with self._dir_lock:
+            st = self.directory.get(object_id)
+            if st is None:
+                st = ObjectState()
+                self.directory[object_id] = st
+            return st
+
+    def _object_ready(self, object_id: ObjectID) -> bool:
+        with self._dir_lock:
+            st = self.directory.get(object_id)
+        return st is not None and st.event.is_set()
+
+    def mark_ready(self, object_id: ObjectID, desc) -> None:
+        self._state(object_id).mark_ready(desc)
+        self.scheduler.notify_object_ready(object_id)
+
+    def _materialize(self, object_id: ObjectID, desc) -> Any:
+        kind = desc[0]
+        if kind == "inline":
+            return serialization.unpack_payload(desc[1])
+        if kind == "shm":
+            shm = self._mapped_segments.get(object_id)
+            if shm is None:
+                value, shm = RemoteObjectReader.read(desc[1], desc[2])
+                self._mapped_segments[object_id] = shm
+                return value
+            return serialization.read_payload_from(shm.buf[: desc[2]])
+        if kind == "err":
+            raise serialization.unpack_payload(desc[1])
+        raise ValueError(f"bad descriptor {desc!r}")
+
+    # ------------------------------------------------------------------ #
+    # public API surface (driver side)
+    # ------------------------------------------------------------------ #
+
+    def put(self, value: Any) -> ObjectID:
+        with self._put_lock:
+            self._put_index += 1
+            idx = (1 << 20) + self._put_index
+        object_id = ObjectID.of(self.driver_task_id, idx)
+        meta, buffers = serialization.serialize_payload(value)
+        nbytes = serialization.payload_nbytes(meta, buffers)
+        if nbytes <= Config.get("max_inline_object_size"):
+            buf = bytearray(nbytes)
+            serialization.write_payload_into(memoryview(buf), meta, buffers)
+            self.mark_ready(object_id, ("inline", bytes(buf)))
+        else:
+            self.node.store.put_serialized(object_id, meta, buffers)
+            self.mark_ready(
+                object_id, ("shm", self.node.store.shm_name(object_id), nbytes))
+        return object_id
+
+    def get(self, object_ids: List[ObjectID],
+            timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        states = [self._state(o) for o in object_ids]
+        for st in states:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError("get timed out")
+            if not st.event.wait(remaining):
+                raise GetTimeoutError("get timed out")
+        return [self._materialize(o, st.desc)
+                for o, st in zip(object_ids, states)]
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int,
+             timeout: Optional[float], fetch_local: bool = True):
+        if num_returns > len(object_ids):
+            raise ValueError(
+                f"num_returns={num_returns} exceeds the {len(object_ids)} "
+                "refs passed to wait()")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(object_ids)
+        ready: List[ObjectID] = []
+        while len(ready) < num_returns:
+            progressed = False
+            for o in list(pending):
+                if self._object_ready(o):
+                    ready.append(o)
+                    pending.remove(o)
+                    progressed = True
+                    if len(ready) >= num_returns:
+                        break
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if not progressed:
+                time.sleep(0.001)
+        return ready, pending
+
+    def free(self, object_ids: List[ObjectID]) -> None:
+        for oid in object_ids:
+            with self._dir_lock:
+                st = self.directory.pop(oid, None)
+            shm = self._mapped_segments.pop(oid, None)
+            if shm is not None:
+                try:
+                    shm.close()
+                except Exception:
+                    pass
+            if st is not None and st.desc and st.desc[0] == "shm":
+                try:
+                    self.node.store.delete(oid)
+                except KeyError:
+                    from .object_store import _open_untracked
+                    try:
+                        seg = _open_untracked(st.desc[1], create=False)
+                        seg.close()
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+
+    # ------------------------------------------------------------------ #
+    # task submission
+    # ------------------------------------------------------------------ #
+
+    def submit_spec(self, spec: TaskSpec) -> None:
+        for oid in spec.return_ids:
+            self._state(oid)
+        if spec.actor_id is not None:
+            self._submit_actor_task(spec)
+        elif spec.create_actor_id is not None:
+            self._submit_actor_creation(spec)
+        else:
+            self.scheduler.submit(spec, self._dispatch_normal)
+
+    def _resolve(self, spec: TaskSpec):
+        args = []
+        for kind, payload in spec.arg_descs:
+            if kind == "ref":
+                args.append(self._state(payload).desc)
+            else:
+                args.append(("inline", payload))
+        kwargs = {}
+        for k, (kind, payload) in spec.kwarg_descs.items():
+            if kind == "ref":
+                kwargs[k] = self._state(payload).desc
+            else:
+                kwargs[k] = ("inline", payload)
+        return args, kwargs
+
+    def _dispatch_normal(self, spec: TaskSpec, node_id: NodeID) -> None:
+        args, kwargs = self._resolve(spec)
+        self._track(spec, node_id)
+        self.nodes[node_id].dispatch_task(spec, args, kwargs)
+
+    # -- actors ---------------------------------------------------------- #
+
+    def register_actor(self, info: ActorInfo) -> None:
+        self.controller.register_actor(info)
+        with self._actors_lock:
+            self._actors[info.actor_id] = _ActorRuntimeState()
+
+    def _submit_actor_creation(self, spec: TaskSpec) -> None:
+        self.controller.set_actor_state(spec.create_actor_id, PENDING_CREATION)
+        self.scheduler.submit(spec, self._dispatch_normal)
+
+    def _actor_state(self, actor_id: ActorID) -> _ActorRuntimeState:
+        with self._actors_lock:
+            st = self._actors.get(actor_id)
+            if st is None:
+                st = _ActorRuntimeState()
+                self._actors[actor_id] = st
+            return st
+
+    def _submit_actor_task(self, spec: TaskSpec) -> None:
+        ast = self._actor_state(spec.actor_id)
+        info = self.controller.get_actor(spec.actor_id)
+        if info is not None and info.state == DEAD:
+            self._fail_task(spec, ActorError(spec.actor_id, info.death_cause))
+            return
+        with ast.lock:
+            seq = ast.next_seq
+            ast.next_seq += 1
+        deps = [a[1] for a in spec.arg_descs if a[0] == "ref"]
+        deps += [d[1] for d in spec.kwarg_descs.values() if d[0] == "ref"]
+        unresolved = [d for d in deps if not self._object_ready(d)]
+
+        def on_deps_ready():
+            args, kwargs = self._resolve(spec)
+            self._enqueue_actor_dispatch(ast, spec, seq, args, kwargs)
+
+        if not unresolved:
+            on_deps_ready()
+        else:
+            remaining = {"n": len(unresolved)}
+            rlock = threading.Lock()
+
+            def one_ready():
+                with rlock:
+                    remaining["n"] -= 1
+                    done = remaining["n"] == 0
+                if done:
+                    on_deps_ready()
+
+            for d in unresolved:
+                self._state(d).add_callback(one_ready)
+
+    def _enqueue_actor_dispatch(self, ast: _ActorRuntimeState, spec: TaskSpec,
+                                seq: int, args, kwargs) -> None:
+        """Strict per-actor ordering: dispatch seq k only after k-1
+        (reference: sequential_actor_submit_queue.h)."""
+        to_send = []
+        with ast.lock:
+            ast.ready_buffer[seq] = (spec, args, kwargs)
+            while ast.next_dispatch in ast.ready_buffer:
+                item = ast.ready_buffer.pop(ast.next_dispatch)
+                ast.next_dispatch += 1
+                to_send.append(item)
+        for item in to_send:
+            self._dispatch_to_actor_worker(ast, *item)
+
+    def _dispatch_to_actor_worker(self, ast: _ActorRuntimeState,
+                                  spec: TaskSpec, args, kwargs) -> None:
+        with ast.lock:
+            if ast.worker_id is None:
+                ast.pending_bind.append((spec, args, kwargs))
+                return
+            node_id, worker_id = ast.node_id, ast.worker_id
+        self._track(spec, node_id)
+        self.nodes[node_id].dispatch_task(spec, args, kwargs,
+                                          target_worker=worker_id)
+
+    def bind_actor_worker(self, actor_id: ActorID, node_id: NodeID,
+                          worker_id: WorkerID) -> None:
+        ast = self._actor_state(actor_id)
+        with ast.lock:
+            ast.worker_id = worker_id
+            ast.node_id = node_id
+            pending, ast.pending_bind = ast.pending_bind, []
+        for item in pending:
+            self._dispatch_to_actor_worker(ast, *item)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        ast = self._actor_state(actor_id)
+        info = self.controller.get_actor(actor_id)
+        if info is not None and no_restart:
+            info.max_restarts = info.num_restarts  # no further restarts
+        if ast.worker_id is not None and ast.node_id is not None:
+            self.nodes[ast.node_id].kill_actor_worker(ast.worker_id)
+
+    # ------------------------------------------------------------------ #
+    # events from the node plane
+    # ------------------------------------------------------------------ #
+
+    def note_task_running(self, task_id: TaskID, node_id: NodeID,
+                          worker_id: WorkerID) -> None:
+        with self._running_lock:
+            rt = self._running.get(task_id)
+            if rt is not None:
+                rt.worker_id = worker_id
+
+    def _track(self, spec: TaskSpec, node_id: NodeID) -> None:
+        with self._running_lock:
+            self._running[spec.task_id] = _RunningTask(spec, node_id)
+
+    def on_task_done(self, msg: TaskDone, node_id: NodeID) -> None:
+        with self._running_lock:
+            running = self._running.pop(msg.task_id, None)
+        spec = running.spec if running else None
+        if msg.error is not None:
+            for oid in (spec.return_ids if spec else [r[0] for r in msg.results]):
+                self.mark_ready(oid, msg.error)
+        else:
+            for oid, desc in msg.results:
+                self.mark_ready(oid, desc)
+        if spec is not None and spec.create_actor_id is None:
+            # Actor creation keeps its resources for the actor's lifetime.
+            if not spec.resources.is_empty() or spec.placement_group is not None:
+                self.scheduler.release(node_id, spec.resources,
+                                       spec.placement_group, spec.bundle_index)
+
+    def on_dispatch_failed(self, spec: TaskSpec, reason: str) -> None:
+        self._fail_task(spec, WorkerCrashedError(reason))
+
+    def _fail_task(self, spec: TaskSpec, exc: Exception) -> None:
+        desc = ("err", serialization.pack_payload(exc))
+        for oid in spec.return_ids:
+            self.mark_ready(oid, desc)
+
+    def on_worker_died(self, worker_id: WorkerID, node_id: NodeID,
+                       running_tasks: List[TaskID],
+                       actor_id: Optional[ActorID]) -> None:
+        if self._shutdown:
+            return
+        specs: List[TaskSpec] = []
+        with self._running_lock:
+            for tid in running_tasks:
+                rt = self._running.pop(tid, None)
+                if rt is not None:
+                    specs.append(rt.spec)
+        for spec in specs:
+            if spec.create_actor_id is None and (
+                    not spec.resources.is_empty()
+                    or spec.placement_group is not None):
+                self.scheduler.release(node_id, spec.resources,
+                                       spec.placement_group, spec.bundle_index)
+            if spec.actor_id is None and spec.create_actor_id is None and \
+                    spec.retry_count < spec.max_retries:
+                spec.retry_count += 1
+                self.submit_spec(spec)
+            elif spec.actor_id is not None:
+                self._fail_task(spec, ActorError(
+                    spec.actor_id, f"worker died while running {spec.name}"))
+            elif spec.create_actor_id is None:
+                self._fail_task(spec, WorkerCrashedError(
+                    f"worker {worker_id} died while running {spec.name}"))
+        if actor_id is not None:
+            self._on_actor_worker_death(actor_id, node_id)
+
+    def _on_actor_worker_death(self, actor_id: ActorID, node_id: NodeID) -> None:
+        info = self.controller.get_actor(actor_id)
+        if info is None or info.state == DEAD:
+            return
+        ast = self._actor_state(actor_id)
+        with ast.lock:
+            ast.worker_id = None
+            ast.node_id = None
+        # Release the actor's held creation resources.
+        if info.creation_spec is not None:
+            cs = info.creation_spec
+            if not cs.resources.is_empty() or cs.placement_group is not None:
+                self.scheduler.release(node_id, cs.resources,
+                                       cs.placement_group, cs.bundle_index)
+        if info.num_restarts < info.max_restarts:
+            info.num_restarts += 1
+            self.controller.set_actor_state(actor_id, RESTARTING)
+            spec = info.creation_spec
+            new_spec = TaskSpec(
+                task_id=TaskID.of(actor_id), name=spec.name,
+                fn_blob=spec.fn_blob, method_name=None,
+                arg_descs=spec.arg_descs, kwarg_descs=spec.kwarg_descs,
+                return_ids=[], resources=spec.resources,
+                create_actor_id=actor_id, max_retries=0,
+                placement_group=spec.placement_group,
+                bundle_index=spec.bundle_index,
+                scheduling_strategy=spec.scheduling_strategy,
+                runtime_env=spec.runtime_env,
+                max_concurrency=spec.max_concurrency)
+            self._submit_actor_creation(new_spec)
+        else:
+            self.controller.set_actor_state(actor_id, DEAD,
+                                            death_cause="worker died")
+            with ast.lock:
+                pending = ast.pending_bind + list(ast.ready_buffer.values())
+                ast.pending_bind = []
+                ast.ready_buffer.clear()
+            for spec, _a, _k in pending:
+                self._fail_task(spec, ActorError(actor_id, "actor died"))
+
+    def on_actor_state(self, msg: ActorStateMsg, node_id: NodeID,
+                       worker_id: WorkerID) -> None:
+        if msg.state == "alive":
+            self.controller.set_actor_state(msg.actor_id, ALIVE, node_id)
+        else:
+            cause = "creation failed"
+            if msg.error is not None and msg.error[0] == "err":
+                try:
+                    exc = serialization.unpack_payload(msg.error[1])
+                    inner = getattr(exc, "cause", exc)
+                    cause = f"creation failed: {type(inner).__name__}: {inner}"
+                except Exception:
+                    pass
+            self.controller.set_actor_state(msg.actor_id, DEAD,
+                                            death_cause=cause)
+            ast = self._actor_state(msg.actor_id)
+            with ast.lock:
+                pending = ast.pending_bind + list(ast.ready_buffer.values())
+                ast.pending_bind = []
+                ast.ready_buffer.clear()
+            err = msg.error or ("err", serialization.pack_payload(
+                ActorError(msg.actor_id, cause)))
+            for spec, _a, _k in pending:
+                for oid in spec.return_ids:
+                    self.mark_ready(oid, err)
+
+    # -- worker-initiated requests -------------------------------------- #
+
+    def on_get_request(self, node: NodeManager, msg: GetRequest) -> None:
+        states = [self._state(o) for o in msg.object_ids]
+        remaining = {"n": len(states)}
+        lock = threading.Lock()
+        replied = {"done": False}
+
+        def finish(timed_out: bool):
+            with lock:
+                if replied["done"]:
+                    return
+                replied["done"] = True
+            values = [st.desc if st.event.is_set() else ("err", b"")
+                      for st in states]
+            node.send_to_worker(msg.worker_id,
+                                GetReply(msg.request_id, values, timed_out))
+
+        def one_ready():
+            with lock:
+                remaining["n"] -= 1
+                done = remaining["n"] == 0
+            if done:
+                finish(False)
+
+        if msg.timeout_s is not None:
+            timer = threading.Timer(msg.timeout_s, lambda: finish(True))
+            timer.daemon = True
+            timer.start()
+        if not states:
+            finish(False)
+        for st in states:
+            st.add_callback(one_ready)
+
+    def on_wait_request(self, node: NodeManager, msg: WaitRequest) -> None:
+        def run():
+            ready, _ = self.wait(msg.object_ids, msg.num_returns,
+                                 msg.timeout_s)
+            node.send_to_worker(msg.worker_id,
+                                WaitReply(msg.request_id, ready))
+        threading.Thread(target=run, daemon=True).start()
+
+    def on_put_from_worker(self, msg: PutFromWorker) -> None:
+        self.mark_ready(msg.object_id, msg.desc)
+
+    def on_rpc_call(self, node: NodeManager, msg: RpcCall) -> None:
+        try:
+            fn = getattr(self, "ctl_" + msg.method)
+            value = fn(*msg.args, **msg.kwargs)
+            node.send_to_worker(msg.worker_id, RpcReply(msg.request_id, value))
+        except Exception as e:
+            node.send_to_worker(msg.worker_id,
+                                RpcReply(msg.request_id, None, repr(e)))
+
+    # control-plane methods callable from workers (and used by the driver
+    # API directly). All arguments/returns must be plain picklable data.
+
+    def ctl_kv_put(self, key, value, namespace="default", overwrite=True):
+        return self.controller.kv_put(key, value, namespace, overwrite)
+
+    def ctl_kv_get(self, key, namespace="default"):
+        return self.controller.kv_get(key, namespace)
+
+    def ctl_kv_del(self, key, namespace="default"):
+        return self.controller.kv_del(key, namespace)
+
+    def ctl_kv_keys(self, prefix="", namespace="default"):
+        return self.controller.kv_keys(prefix, namespace)
+
+    def ctl_get_named_actor(self, name, namespace=None):
+        info = self.controller.get_named_actor(name,
+                                               namespace or self.namespace)
+        if info is None or info.state == DEAD:
+            return None
+        return (info.actor_id.binary(), info.max_restarts, info.class_name)
+
+    def ctl_register_actor(self, actor_id_bytes, name, namespace, max_restarts,
+                           class_name):
+        info = ActorInfo(ActorID(actor_id_bytes), name or None,
+                         "DEPENDENCIES_UNREADY", None, max_restarts,
+                         namespace=namespace or self.namespace,
+                         class_name=class_name)
+        self.register_actor(info)
+        return True
+
+    def ctl_actor_creation_spec(self, actor_id_bytes, spec: TaskSpec):
+        info = self.controller.get_actor(ActorID(actor_id_bytes))
+        if info is not None:
+            info.creation_spec = spec
+        return True
+
+    def ctl_kill_actor(self, actor_id_bytes, no_restart=True):
+        self.kill_actor(ActorID(actor_id_bytes), no_restart)
+        return True
+
+    def ctl_actor_state(self, actor_id_bytes):
+        info = self.controller.get_actor(ActorID(actor_id_bytes))
+        return info.state if info else None
+
+    def ctl_create_pg(self, bundles: List[Dict[str, float]], strategy: str,
+                      name: Optional[str] = None):
+        from .controller import BundleInfo
+        pg_id = PlacementGroupID.of(self.job_id)
+        info = PlacementGroupInfo(
+            pg_id, name, strategy,
+            [BundleInfo(i, ResourceSet(b)) for i, b in enumerate(bundles)])
+        self.controller.register_placement_group(info)
+        self.scheduler.create_placement_group(info)
+        return pg_id.binary()
+
+    def ctl_pg_state(self, pg_id_bytes):
+        info = self.controller.get_placement_group(PlacementGroupID(pg_id_bytes))
+        return info.state if info else None
+
+    def ctl_pg_bundle_locations(self, pg_id_bytes):
+        info = self.controller.get_placement_group(PlacementGroupID(pg_id_bytes))
+        if info is None:
+            return None
+        return [b.node_id.binary() if b.node_id else None for b in info.bundles]
+
+    def ctl_remove_pg(self, pg_id_bytes):
+        info = self.controller.get_placement_group(PlacementGroupID(pg_id_bytes))
+        if info is not None:
+            self.scheduler.remove_placement_group(info)
+        return True
+
+    def ctl_cluster_resources(self):
+        return self.scheduler.total_resources()
+
+    def ctl_available_resources(self):
+        return self.scheduler.available_resources()
+
+    def ctl_nodes(self):
+        return [{"node_id": n.node_id.hex(), "alive": n.alive,
+                 "hostname": n.hostname,
+                 "resources": n.total_resources.to_dict(),
+                 "is_head": n.is_head}
+                for n in self.controller.nodes.values()]
+
+    def ctl_list_actors(self):
+        return [{"actor_id": a.actor_id.hex(), "state": a.state,
+                 "name": a.name, "class_name": a.class_name,
+                 "num_restarts": a.num_restarts}
+                for a in self.controller.actors.values()]
+
+    # ------------------------------------------------------------------ #
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self.scheduler.stop()
+        self.node.shutdown()
+        for shm in self._mapped_segments.values():
+            try:
+                shm.close()
+            except Exception:
+                pass
+        self._mapped_segments.clear()
+        self.controller.finish_job(self.job_id)
+        global _global_runtime
+        with _runtime_lock:
+            if _global_runtime is self:
+                _global_runtime = None
+
+
+def init_runtime(**kwargs) -> Runtime:
+    global _global_runtime
+    with _runtime_lock:
+        if _global_runtime is not None:
+            return _global_runtime
+        rt = Runtime(**kwargs)
+        _global_runtime = rt
+        return rt

@@ -1,0 +1,383 @@
+"""Cluster scheduler: dependency resolution, policies, placement groups.
+
+Maps the reference's two-level lease scheduler (reference:
+src/ray/raylet/scheduling/cluster_lease_manager.h:41 queueing + node
+selection, local_lease_manager.h:61 local dispatch, policies under
+raylet/scheduling/policy/ — hybrid_scheduling_policy.cc pack-then-spread,
+spread, node-affinity, bundle_scheduling_policy.cc) into one in-process
+component: tasks enter a dependency stage (reference:
+lease_dependency_manager.h), move to a ready queue, a policy picks a node,
+resources are pinned, and the node's worker pool gets a dispatch callback.
+
+TPU-first addition: resources are typed (``TPU`` chips, ``TPU-<gen>-head``
+slice markers) and placement-group bundles model pod slices, so gang
+placement of an SPMD worker group = one STRICT_SPREAD slice PG (the
+SlicePlacementGroup concept, reference: python/ray/util/tpu.py:414, moved
+into the scheduler proper).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .config import Config
+from .controller import (Controller, NodeInfo, PlacementGroupInfo, PG_CREATED,
+                         PG_PENDING, PG_REMOVED)
+from .ids import NodeID, ObjectID, PlacementGroupID, TaskID
+from .protocol import TaskSpec
+from .resources import ResourceSet
+
+PACK = "PACK"
+SPREAD = "SPREAD"
+STRICT_PACK = "STRICT_PACK"
+STRICT_SPREAD = "STRICT_SPREAD"
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: "NodeID"
+    soft: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: object  # PlacementGroup handle or PlacementGroupID
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class _PendingTask:
+    spec: TaskSpec
+    unresolved: Set[ObjectID]
+    dispatch: Callable[[TaskSpec, NodeID], None]
+
+
+@dataclass
+class _NodeState:
+    info: NodeInfo
+    available: ResourceSet
+    # Per-PG-bundle reserved-and-still-free resources.
+    bundle_available: Dict[Tuple[PlacementGroupID, int], ResourceSet] = field(
+        default_factory=dict)
+
+
+class Infeasible(Exception):
+    """No alive node could ever satisfy the request."""
+
+
+class ClusterScheduler:
+    def __init__(self, controller: Controller,
+                 object_ready: Callable[[ObjectID], bool]):
+        self._controller = controller
+        self._object_ready = object_ready
+        self._lock = threading.RLock()
+        self._nodes: Dict[NodeID, _NodeState] = {}
+        self._ready: deque = deque()          # _PendingTask with deps resolved
+        self._waiting: Dict[ObjectID, List[_PendingTask]] = defaultdict(list)
+        self._infeasible: List[_PendingTask] = []
+        self._wake = threading.Condition(self._lock)
+        self._running = True
+        self._spread_rr = 0
+        self._pending_pgs: List[PlacementGroupInfo] = []
+        # Set by the Runtime: called with (spec, exc) when dispatch blows up.
+        self.on_dispatch_error: Optional[Callable] = None
+        self._thread = threading.Thread(target=self._loop, name="scheduler",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- node lifecycle -----------------------------------------------------
+
+    def add_node(self, info: NodeInfo) -> None:
+        with self._wake:
+            self._nodes[info.node_id] = _NodeState(info, info.total_resources.copy())
+            # Newly added capacity may unblock infeasible tasks.
+            self._ready.extend(self._infeasible)
+            self._infeasible.clear()
+            self._wake.notify_all()
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._wake:
+            self._nodes.pop(node_id, None)
+            self._wake.notify_all()
+
+    def available_resources(self) -> Dict[str, float]:
+        with self._lock:
+            total = ResourceSet()
+            for ns in self._nodes.values():
+                total = total + ns.available
+            return total.to_dict()
+
+    def total_resources(self) -> Dict[str, float]:
+        with self._lock:
+            total = ResourceSet()
+            for ns in self._nodes.values():
+                total = total + ns.info.total_resources
+            return total.to_dict()
+
+    # -- task intake --------------------------------------------------------
+
+    def submit(self, spec: TaskSpec,
+               dispatch: Callable[[TaskSpec, NodeID], None]) -> None:
+        deps = {a[1] for a in spec.arg_descs if a[0] == "ref"}
+        deps |= {d[1] for d in spec.kwarg_descs.values() if d[0] == "ref"}
+        # Readiness must be checked under the scheduler lock: an object can
+        # become ready between the check and registration, and
+        # notify_object_ready (which holds the same lock) would then have
+        # already fired, stranding the task in _waiting forever.
+        with self._wake:
+            unresolved = {d for d in deps if not self._object_ready(d)}
+            task = _PendingTask(spec, unresolved, dispatch)
+            if unresolved:
+                for d in unresolved:
+                    self._waiting[d].append(task)
+            else:
+                self._ready.append(task)
+                self._wake.notify_all()
+
+    def notify_object_ready(self, object_id: ObjectID) -> None:
+        with self._wake:
+            tasks = self._waiting.pop(object_id, [])
+            moved = False
+            for t in tasks:
+                t.unresolved.discard(object_id)
+                if not t.unresolved:
+                    self._ready.append(t)
+                    moved = True
+            if moved:
+                self._wake.notify_all()
+
+    def release(self, node_id: NodeID, resources: ResourceSet,
+                pg: Optional[PlacementGroupID] = None,
+                bundle_index: int = -1) -> None:
+        with self._wake:
+            ns = self._nodes.get(node_id)
+            if ns is None:
+                return
+            if pg is not None:
+                key = (pg, bundle_index) if bundle_index >= 0 else None
+                if key is not None and key in ns.bundle_available:
+                    ns.bundle_available[key] = ns.bundle_available[key] + resources
+                else:
+                    # PG was removed while the task ran: resources go back to
+                    # the node's main pool.
+                    ns.available = ns.available + resources
+            else:
+                ns.available = ns.available + resources
+            self._wake.notify_all()
+
+    # -- scheduling loop ----------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._wake:
+                while self._running and not self._ready:
+                    self._retry_pending_pgs_locked()
+                    self._wake.wait(timeout=0.5)
+                if not self._running:
+                    return
+                self._retry_pending_pgs_locked()
+                n = len(self._ready)
+                deferred: List[_PendingTask] = []
+                progress = False
+                for _ in range(n):
+                    task = self._ready.popleft()
+                    node_id = self._try_place(task.spec)
+                    if node_id is None:
+                        deferred.append(task)
+                        continue
+                    progress = True
+                    try:
+                        task.dispatch(task.spec, node_id)
+                    except Exception as exc:
+                        # Undo the resource deduction and surface the error;
+                        # silently dropping would leak capacity and hang get().
+                        spec = task.spec
+                        self.release(node_id, spec.resources,
+                                     spec.placement_group, spec.bundle_index)
+                        if self.on_dispatch_error is not None:
+                            try:
+                                self.on_dispatch_error(spec, exc)
+                            except Exception:
+                                pass
+                self._ready.extend(deferred)
+                if deferred and not progress:
+                    # Nothing placeable right now; sleep until resources free.
+                    self._wake.wait(timeout=0.05)
+
+    def stop(self) -> None:
+        with self._wake:
+            self._running = False
+            self._wake.notify_all()
+
+    # -- placement ----------------------------------------------------------
+
+    def _bundle_key(self, ns: _NodeState, pg: PlacementGroupID,
+                    bundle_index: int, need: ResourceSet):
+        if bundle_index >= 0:
+            key = (pg, bundle_index)
+            return key if key in ns.bundle_available else None
+        # Wildcard bundle: first bundle on this node with room.
+        for key, avail in ns.bundle_available.items():
+            if key[0] == pg and need.fits(avail):
+                return key
+        return None
+
+    def _try_place(self, spec: TaskSpec) -> Optional[NodeID]:
+        need = spec.resources
+        if spec.placement_group is not None:
+            for ns in self._nodes.values():
+                key = self._bundle_key(ns, spec.placement_group,
+                                       spec.bundle_index, need)
+                if key is not None and need.fits(ns.bundle_available[key]):
+                    ns.bundle_available[key] = ns.bundle_available[key] - need
+                    return ns.info.node_id
+            return None
+
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            ns = self._nodes.get(strategy.node_id)
+            if ns is not None and need.fits(ns.available):
+                ns.available = ns.available - need
+                return ns.info.node_id
+            if not strategy.soft:
+                return None  # stays queued until that node frees up
+
+        candidates = [ns for ns in self._nodes.values()
+                      if need.fits(ns.available)]
+        if not candidates:
+            if not any(need.fits(ns.info.total_resources)
+                       for ns in self._nodes.values()):
+                pass  # infeasible now; capacity may still appear later
+            return None
+
+        if strategy == "SPREAD":
+            self._spread_rr += 1
+            ns = candidates[self._spread_rr % len(candidates)]
+        else:
+            ns = self._hybrid_pick(candidates)
+        ns.available = ns.available - need
+        return ns.info.node_id
+
+    def _hybrid_pick(self, candidates: List[_NodeState]) -> _NodeState:
+        """Pack onto busiest node under the threshold, else least utilized
+        (reference: hybrid_scheduling_policy.cc)."""
+        thresh = Config.get("scheduler_spread_threshold")
+
+        def utilization(ns: _NodeState) -> float:
+            utils = []
+            for k, total in ns.info.total_resources.items():
+                if total > 0:
+                    utils.append(1.0 - ns.available.get(k) / total)
+            return max(utils) if utils else 0.0
+
+        under = [ns for ns in candidates if utilization(ns) < thresh]
+        if under:
+            return max(under, key=utilization)
+        return min(candidates, key=utilization)
+
+    # -- placement groups ---------------------------------------------------
+
+    def create_placement_group(self, pg: PlacementGroupInfo) -> bool:
+        """Two-phase reserve: compute full assignment against a snapshot,
+        commit only if every bundle fits (reference:
+        gcs_placement_group_scheduler.h:115 prepare/commit).  A group that
+        does not fit yet stays PENDING and is retried whenever capacity
+        frees up (reference: GcsPlacementGroupManager pending queue)."""
+        with self._wake:
+            if self._try_commit_pg(pg):
+                return True
+            self._pending_pgs.append(pg)
+            return False
+
+    def _try_commit_pg(self, pg: PlacementGroupInfo) -> bool:
+        snapshot = {nid: ns.available.copy() for nid, ns in self._nodes.items()}
+        assignment = self._plan_bundles(pg, snapshot)
+        if assignment is None:
+            return False
+        for bundle, node_id in zip(pg.bundles, assignment):
+            ns = self._nodes[node_id]
+            ns.available = ns.available - bundle.resources
+            ns.bundle_available[(pg.pg_id, bundle.index)] = bundle.resources.copy()
+            bundle.node_id = node_id
+        self._controller.set_pg_state(pg.pg_id, PG_CREATED)
+        self._wake.notify_all()
+        return True
+
+    def _retry_pending_pgs_locked(self) -> None:
+        if not self._pending_pgs:
+            return
+        still_pending = []
+        for pg in self._pending_pgs:
+            if pg.state == PG_REMOVED:
+                continue
+            if not self._try_commit_pg(pg):
+                still_pending.append(pg)
+        self._pending_pgs = still_pending
+
+    def _plan_bundles(self, pg: PlacementGroupInfo,
+                      snapshot: Dict[NodeID, ResourceSet]) -> Optional[List[NodeID]]:
+        node_ids = list(snapshot.keys())
+        if not node_ids:
+            return None
+        assignment: List[NodeID] = []
+        if pg.strategy == STRICT_PACK:
+            for nid in node_ids:
+                avail = snapshot[nid].copy()
+                ok = True
+                for b in pg.bundles:
+                    if not b.resources.fits(avail):
+                        ok = False
+                        break
+                    avail = avail - b.resources
+                if ok:
+                    return [nid] * len(pg.bundles)
+            return None
+        used_nodes: Set[NodeID] = set()
+        order = node_ids if pg.strategy != SPREAD else random.sample(
+            node_ids, len(node_ids))
+        for b in pg.bundles:
+            placed = None
+            if pg.strategy == STRICT_SPREAD:
+                cands = [n for n in order if n not in used_nodes
+                         and b.resources.fits(snapshot[n])]
+            elif pg.strategy == SPREAD:
+                cands = sorted(
+                    (n for n in order if b.resources.fits(snapshot[n])),
+                    key=lambda n: n in used_nodes)
+            else:  # PACK: prefer already-used nodes
+                cands = sorted(
+                    (n for n in order if b.resources.fits(snapshot[n])),
+                    key=lambda n: n not in used_nodes)
+            if cands:
+                placed = cands[0]
+            if placed is None:
+                return None
+            snapshot[placed] = snapshot[placed] - b.resources
+            used_nodes.add(placed)
+            assignment.append(placed)
+        return assignment
+
+    def remove_placement_group(self, pg: PlacementGroupInfo) -> None:
+        with self._wake:
+            for b in pg.bundles:
+                if b.node_id is None:
+                    continue
+                ns = self._nodes.get(b.node_id)
+                if ns is None:
+                    continue
+                remaining = ns.bundle_available.pop((pg.pg_id, b.index), None)
+                if remaining is not None:
+                    # Return the whole bundle; in-use slices return via release().
+                    ns.available = ns.available + remaining
+                b.node_id = None
+            self._controller.set_pg_state(pg.pg_id, PG_REMOVED)
+            self._wake.notify_all()
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._ready) + sum(len(v) for v in self._waiting.values())

@@ -1,0 +1,92 @@
+"""Serialization for tasks, actors and objects.
+
+The reference splits serialization into msgpack for metadata + a cloudpickle
+fork with pickle5 out-of-band buffers for payloads (reference:
+python/ray/_private/serialization.py, python/ray/cloudpickle/).  We keep the
+same split — msgpack for small control-plane structures, cloudpickle protocol
+5 with out-of-band buffer extraction for user payloads — so that large numpy /
+jax host arrays serialize zero-copy into the shared-memory store and
+deserialize as views over the mapped segment.
+
+Wire format for payloads:
+    [u32 n_buffers] [u64 len_meta] [meta: cloudpickle bytes]
+    ([u64 len_buf] [buf bytes]) * n_buffers
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+_HEADER = struct.Struct("<IQ")
+_LEN = struct.Struct("<Q")
+
+
+def dumps_control(obj: Any) -> bytes:
+    """Serialize a control-plane message (no user objects)."""
+    return cloudpickle.dumps(obj, protocol=5)
+
+
+def loads_control(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def serialize_payload(obj: Any) -> Tuple[bytes, List[memoryview]]:
+    """Serialize a user object; returns (meta, out-of-band buffers).
+
+    Buffers are returned separately so callers can place them directly into
+    shared memory without an intermediate copy.
+    """
+    buffers: List[pickle.PickleBuffer] = []
+    meta = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    return meta, [b.raw() for b in buffers]
+
+
+def payload_nbytes(meta: bytes, buffers: List[memoryview]) -> int:
+    return _HEADER.size + len(meta) + sum(_LEN.size + b.nbytes for b in buffers)
+
+
+def write_payload_into(dest: memoryview, meta: bytes, buffers: List[memoryview]) -> int:
+    """Pack meta+buffers into ``dest``; returns bytes written."""
+    off = 0
+    _HEADER.pack_into(dest, off, len(buffers), len(meta))
+    off += _HEADER.size
+    dest[off: off + len(meta)] = meta
+    off += len(meta)
+    for b in buffers:
+        _LEN.pack_into(dest, off, b.nbytes)
+        off += _LEN.size
+        flat = b.cast("B") if b.format != "B" or b.ndim != 1 else b
+        dest[off: off + flat.nbytes] = flat
+        off += flat.nbytes
+    return off
+
+
+def pack_payload(obj: Any) -> bytes:
+    meta, buffers = serialize_payload(obj)
+    out = bytearray(payload_nbytes(meta, buffers))
+    write_payload_into(memoryview(out), meta, buffers)
+    return bytes(out)
+
+
+def read_payload_from(src: memoryview) -> Any:
+    """Deserialize from a packed payload; numpy buffers become views of src."""
+    off = 0
+    n_buffers, len_meta = _HEADER.unpack_from(src, off)
+    off += _HEADER.size
+    meta = bytes(src[off: off + len_meta])
+    off += len_meta
+    bufs = []
+    for _ in range(n_buffers):
+        (n,) = _LEN.unpack_from(src, off)
+        off += _LEN.size
+        bufs.append(src[off: off + n])
+        off += n
+    return pickle.loads(meta, buffers=bufs)
+
+
+def unpack_payload(data: bytes) -> Any:
+    return read_payload_from(memoryview(data))

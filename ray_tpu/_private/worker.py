@@ -1,0 +1,259 @@
+"""Worker process: task execution loop.
+
+The reference's worker is a language process embedding the C++ CoreWorker
+(reference: src/ray/core_worker/core_worker.h:167) — a gRPC server receiving
+PushTask, a TaskReceiver with per-concurrency-group thread/fiber pools
+(reference: task_execution/task_receiver.h:43), and client stubs for
+submitting nested work.  Here the worker is a spawned Python process with a
+receiver thread (the transport endpoint), an executor pool (the concurrency
+groups), and a ``WorkerRuntime`` that the public API routes through when
+called from inside a task — so nested ``.remote()`` / ``get`` / ``put`` work
+exactly as on the driver (reference: core_worker.h SubmitTask/Get/Put).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .config import Config
+from .exceptions import TaskError
+from .ids import ActorID, ObjectID, TaskID, WorkerID
+from .object_store import RemoteObjectReader
+from .protocol import (ActorStateMsg, GetReply, GetRequest, KillWorker,
+                       PutFromWorker, RpcCall, RpcReply, RunTask,
+                       SubmitFromWorker, TaskDone, WaitReply, WaitRequest,
+                       WorkerReady)
+
+
+def _materialize(desc, keepalives: List) -> Any:
+    kind = desc[0]
+    if kind == "inline":
+        return serialization.unpack_payload(desc[1])
+    if kind == "shm":
+        value, shm = RemoteObjectReader.read(desc[1], desc[2])
+        keepalives.append(shm)
+        return value
+    if kind == "err":
+        raise serialization.unpack_payload(desc[1])
+    raise ValueError(f"unknown value descriptor {kind!r}")
+
+
+def _serialize_result(object_id: ObjectID, value: Any):
+    meta, buffers = serialization.serialize_payload(value)
+    nbytes = serialization.payload_nbytes(meta, buffers)
+    if nbytes <= Config.get("max_inline_object_size"):
+        out = bytearray(nbytes)
+        serialization.write_payload_into(memoryview(out), meta, buffers)
+        return ("inline", bytes(out))
+    shm_name, nbytes = RemoteObjectReader.write("", object_id, value)
+    return ("shm", shm_name, nbytes)
+
+
+class WorkerRuntime:
+    """Runtime facade available inside a worker process.
+
+    Implements the same surface the driver Runtime exposes to the public API
+    (submit/get/put/wait/kv/actor lookup), forwarding over the worker pipe.
+    """
+
+    def __init__(self, conn, worker_id: WorkerID, job_id):
+        self.conn = conn
+        self.worker_id = worker_id
+        self.job_id = job_id
+        self._send_lock = threading.Lock()
+        self._req_lock = threading.Lock()
+        self._next_req = 0
+        self._pending: Dict[int, queue.Queue] = {}
+        self.current_task_id: Optional[TaskID] = None
+        self.current_actor_id: Optional[ActorID] = None
+        self._obj_index_lock = threading.Lock()
+        self._obj_index = 1 << 20  # put-objects live above return indices
+
+    # -- plumbing -----------------------------------------------------------
+
+    def send(self, msg) -> None:
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def _call(self, make_msg, timeout: Optional[float] = None):
+        with self._req_lock:
+            self._next_req += 1
+            rid = self._next_req
+            q: queue.Queue = queue.Queue()
+            self._pending[rid] = q
+        self.send(make_msg(rid))
+        try:
+            return q.get(timeout=timeout)
+        finally:
+            with self._req_lock:
+                self._pending.pop(rid, None)
+
+    def deliver_reply(self, request_id: int, reply) -> None:
+        with self._req_lock:
+            q = self._pending.get(request_id)
+        if q is not None:
+            q.put(reply)
+
+    # -- API surface --------------------------------------------------------
+
+    def submit_spec(self, spec) -> None:
+        self.send(SubmitFromWorker(spec))
+
+    def get(self, object_ids: List[ObjectID], timeout: Optional[float] = None):
+        reply: GetReply = self._call(
+            lambda rid: GetRequest(rid, self.worker_id, object_ids, timeout),
+            timeout=None)
+        if reply.timed_out:
+            from .exceptions import GetTimeoutError
+            raise GetTimeoutError(f"get timed out on {object_ids}")
+        keepalives: List = []
+        return [_materialize(d, keepalives) for d in reply.values]
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int,
+             timeout: Optional[float], fetch_local: bool = True):
+        reply: WaitReply = self._call(
+            lambda rid: WaitRequest(rid, self.worker_id, object_ids,
+                                    num_returns, timeout, fetch_local))
+        ready_set = set(reply.ready)
+        ready = [o for o in object_ids if o in ready_set]
+        not_ready = [o for o in object_ids if o not in ready_set]
+        return ready, not_ready
+
+    def put(self, value: Any) -> ObjectID:
+        task_id = self.current_task_id or TaskID.for_driver(self.job_id)
+        with self._obj_index_lock:
+            self._obj_index += 1
+            idx = self._obj_index
+        object_id = ObjectID.of(task_id, idx)
+        desc = _serialize_result(object_id, value)
+        self.send(PutFromWorker(object_id, desc))
+        return object_id
+
+    def control(self, method: str, *args, **kwargs):
+        """Generic control-plane call (KV, named actors, PGs, metadata)."""
+        reply: RpcReply = self._call(
+            lambda rid: RpcCall(rid, self.worker_id, method, args, kwargs))
+        if reply.error is not None:
+            raise RuntimeError(reply.error)
+        return reply.value
+
+
+class WorkerLoop:
+    def __init__(self, conn, worker_id: WorkerID, job_id):
+        self.runtime = WorkerRuntime(conn, worker_id, job_id)
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec")
+        self._actor_lock = threading.Lock()
+        # Shm segments backing zero-copy views that an actor may retain in
+        # its state must outlive the task that mapped them.
+        self._actor_keepalives: List = []
+
+    # -- task execution -----------------------------------------------------
+
+    def _run_task(self, msg: RunTask) -> None:
+        spec = msg.spec
+        rt = self.runtime
+        rt.current_task_id = spec.task_id
+        # Actor tasks may stash zero-copy arg views in actor state, so their
+        # backing shm segments live as long as the actor.
+        if spec.create_actor_id is not None or spec.actor_id is not None:
+            keepalives = self._actor_keepalives
+        else:
+            keepalives = []
+        results: List[Tuple[ObjectID, tuple]] = []
+        error = None
+        is_app_error = False
+        import time as _time
+        t0 = _time.monotonic()
+        try:
+            if spec.runtime_env and spec.runtime_env.get("env_vars"):
+                os.environ.update(spec.runtime_env["env_vars"])
+            args = [_materialize(d, keepalives) for d in msg.resolved_args]
+            kwargs = {k: _materialize(d, keepalives)
+                      for k, d in msg.resolved_kwargs.items()}
+            if spec.create_actor_id is not None:
+                cls = serialization.loads_control(spec.fn_blob)
+                self.actor_instance = cls(*args, **kwargs)
+                self.actor_id = spec.create_actor_id
+                rt.current_actor_id = spec.create_actor_id
+                rt.send(ActorStateMsg(spec.create_actor_id, "alive"))
+                value_list = [None] * len(spec.return_ids)
+            elif spec.actor_id is not None:
+                if self.actor_instance is None:
+                    raise RuntimeError("actor instance not initialized")
+                method = getattr(self.actor_instance, spec.method_name)
+                out = method(*args, **kwargs)
+                value_list = self._split_returns(out, spec)
+            else:
+                fn = serialization.loads_control(spec.fn_blob)
+                out = fn(*args, **kwargs)
+                value_list = self._split_returns(out, spec)
+            for oid, value in zip(spec.return_ids, value_list):
+                results.append((oid, _serialize_result(oid, value)))
+        except BaseException as exc:  # noqa: BLE001 - forwarded to caller
+            is_app_error = True
+            wrapped = TaskError(exc, spec.name, traceback.format_exc())
+            try:
+                error = ("err", serialization.pack_payload(wrapped))
+            except Exception:
+                error = ("err", serialization.pack_payload(
+                    TaskError(RuntimeError(str(exc)), spec.name,
+                              traceback.format_exc())))
+            if spec.create_actor_id is not None:
+                rt.send(ActorStateMsg(spec.create_actor_id, "error", error))
+        finally:
+            rt.current_task_id = None
+        rt.send(TaskDone(spec.task_id, rt.worker_id, results, error,
+                         is_app_error, spec.actor_id or spec.create_actor_id,
+                         _time.monotonic() - t0))
+
+    @staticmethod
+    def _split_returns(out: Any, spec) -> List[Any]:
+        n = len(spec.return_ids)
+        if n == 0:
+            return []
+        if n == 1:
+            return [out]
+        if not isinstance(out, (tuple, list)) or len(out) != n:
+            raise ValueError(
+                f"task {spec.name!r} declared num_returns={n} but returned "
+                f"{type(out).__name__} of length "
+                f"{len(out) if isinstance(out, (tuple, list)) else 'n/a'}")
+        return list(out)
+
+    # -- receive loop -------------------------------------------------------
+
+    def run(self) -> None:
+        rt = self.runtime
+        rt.send(WorkerReady(rt.worker_id, os.getpid()))
+        conn = rt.conn
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            if isinstance(msg, RunTask):
+                if msg.spec.max_concurrency > 1 and \
+                        self._executor._max_workers < msg.spec.max_concurrency:
+                    self._executor = ThreadPoolExecutor(
+                        max_workers=msg.spec.max_concurrency,
+                        thread_name_prefix="task-exec")
+                self._executor.submit(self._run_task, msg)
+            elif isinstance(msg, (GetReply, WaitReply, RpcReply)):
+                rt.deliver_reply(msg.request_id, msg)
+            elif isinstance(msg, KillWorker):
+                break
+        try:
+            self._executor.shutdown(wait=False)
+        finally:
+            os._exit(0)
+
+

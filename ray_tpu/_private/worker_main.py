@@ -1,0 +1,38 @@
+"""Worker process entry point: ``python -m ray_tpu._private.worker_main``.
+
+Spawned by NodeManager as a fresh interpreter; dials back into the node's
+unix socket and registers (reference: worker processes exec'd by
+raylet/worker_pool.h connect back over the raylet socket,
+src/ray/raylet_ipc_client/).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> None:
+    sock_path = os.environ["RAY_TPU_NODE_SOCK"]
+    authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+    worker_id_hex = os.environ["RAY_TPU_WORKER_ID"]
+    job_id_hex = os.environ["RAY_TPU_JOB_ID"]
+
+    from multiprocessing.connection import Client
+
+    from .config import Config
+    from .ids import JobID, WorkerID
+    from .worker import WorkerLoop
+
+    Config.initialize()
+    conn = Client(sock_path, "AF_UNIX", authkey=authkey)
+
+    import ray_tpu
+    loop = WorkerLoop(conn, WorkerID.from_hex(worker_id_hex),
+                      JobID.from_hex(job_id_hex))
+    ray_tpu._private_worker_mode(loop.runtime)
+    loop.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from .tpu import TPUAcceleratorManager
+
+__all__ = ["TPUAcceleratorManager"]

@@ -1,0 +1,127 @@
+"""TPU accelerator plugin: detection, topology, chip visibility.
+
+Models the reference's accelerator plugin system (reference:
+python/ray/_private/accelerators/accelerator.py:16 AcceleratorManager ABC;
+TPU implementation python/ray/_private/accelerators/tpu.py:345 — resource
+name "TPU", TPU_VISIBLE_CHIPS isolation, per-generation chips/host logic
+:237, slice-head marker resource :670, topology validation :426).
+
+Detection deliberately avoids importing jax in the driver: initializing the
+TPU runtime takes exclusive hold of the chips, which must stay free for
+worker processes.  Chips are discovered from the device tree / environment
+instead, the same way the reference reads GCE metadata and env vars.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, List, Optional
+
+from .._private.config import Config
+
+# Generation -> default chips per host for common slices (reference:
+# tpu.py:237 per-generation logic).
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
+
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+TPU_ACCELERATOR_TYPE_ENV = "TPU_ACCELERATOR_TYPE"  # e.g. "v5litepod-256"
+TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+TPU_NAME_ENV = "TPU_NAME"
+MEGASCALE_COORDINATOR_ENV = "MEGASCALE_COORDINATOR_ADDRESS"
+MEGASCALE_NUM_SLICES_ENV = "MEGASCALE_NUM_SLICES"
+MEGASCALE_SLICE_ID_ENV = "MEGASCALE_SLICE_ID"
+
+
+class TPUAcceleratorManager:
+    resource_name = "TPU"
+
+    @staticmethod
+    def detect_num_chips() -> int:
+        """Chips on this host, without initializing a TPU runtime."""
+        override = Config.get("tpu_chips_per_host_override")
+        if override:
+            return override
+        visible = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+        if visible:
+            return len([c for c in visible.split(",") if c.strip() != ""])
+        # Device nodes: /dev/accel* (TPU VM) or vfio for newer stacks.
+        accel = glob.glob("/dev/accel*")
+        if accel:
+            return len(accel)
+        vfio = glob.glob("/dev/vfio/[0-9]*")
+        if vfio:
+            return len(vfio)
+        acc_type = os.environ.get(TPU_ACCELERATOR_TYPE_ENV)
+        if acc_type:
+            gen = TPUAcceleratorManager.generation_from_type(acc_type)
+            return _CHIPS_PER_HOST.get(gen, 4)
+        return 0
+
+    @staticmethod
+    def generation_from_type(accelerator_type: str) -> str:
+        """'v5litepod-256' -> 'v5e', 'v4-8' -> 'v4'."""
+        m = re.match(r"v(\d+)(lite)?(pod|p|e)?", accelerator_type or "")
+        if not m:
+            return "unknown"
+        ver = m.group(1)
+        if m.group(2) == "lite" or m.group(3) == "e":
+            return f"v{ver}e"
+        if m.group(3) == "p" and ver == "5":
+            return "v5p"
+        return f"v{ver}"
+
+    @staticmethod
+    def accelerator_type() -> Optional[str]:
+        return os.environ.get(TPU_ACCELERATOR_TYPE_ENV)
+
+    @staticmethod
+    def slice_head_resource_name() -> Optional[str]:
+        """Marker resource advertised only by a slice's worker 0, used for
+        gang-scheduling one coordinator per slice (reference: tpu.py:670
+        TPU-{version}-head)."""
+        acc_type = os.environ.get(TPU_ACCELERATOR_TYPE_ENV)
+        if not acc_type:
+            return None
+        worker_id = os.environ.get(TPU_WORKER_ID_ENV, "0")
+        if worker_id != "0":
+            return None
+        gen = TPUAcceleratorManager.generation_from_type(acc_type)
+        return f"TPU-{gen}-head"
+
+    @staticmethod
+    def num_hosts_for_type(accelerator_type: str) -> int:
+        """'v5litepod-256' -> 32 hosts (256 chips / 8 per host)."""
+        m = re.search(r"-(\d+)$", accelerator_type or "")
+        if not m:
+            return 1
+        chips = int(m.group(1))
+        gen = TPUAcceleratorManager.generation_from_type(accelerator_type)
+        per_host = _CHIPS_PER_HOST.get(gen, 4)
+        return max(1, chips // per_host)
+
+    @staticmethod
+    def set_visible_chips(chip_ids: List[int]) -> None:
+        os.environ[TPU_VISIBLE_CHIPS_ENV] = ",".join(str(c) for c in chip_ids)
+
+    @staticmethod
+    def get_current_process_visible_chips() -> Optional[List[int]]:
+        v = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+        if v is None:
+            return None
+        return [int(c) for c in v.split(",") if c.strip() != ""]
+
+
+def get_tpu_coordinator_env_vars(slice_id: int, num_slices: int,
+                                 coordinator_address: str) -> Dict[str, str]:
+    """MEGASCALE env plumbing for multi-slice (DCN) jobs (reference:
+    python/ray/util/tpu.py:206 get_tpu_coordinator_env_vars and
+    python/ray/train/v2/jax/config.py:95-103)."""
+    if num_slices <= 1:
+        return {}
+    return {
+        MEGASCALE_COORDINATOR_ENV: coordinator_address,
+        MEGASCALE_NUM_SLICES_ENV: str(num_slices),
+        MEGASCALE_SLICE_ID_ENV: str(slice_id),
+    }

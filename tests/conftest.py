@@ -1,0 +1,37 @@
+"""Test fixtures.
+
+TPU-less CI substrate (SURVEY §4.2): jax collective/SPMD tests run on a
+virtual 8-device CPU mesh via XLA host-platform device multiplexing — the
+same technique the reference uses for TPU-logic tests without hardware
+(reference: python/ray/tests/accelerators/test_tpu.py mocks env/metadata).
+The env vars must be set before the first jax import anywhere in the process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    """Module-scoped runtime (reference: conftest ray_start_regular)."""
+    import ray_tpu
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_isolated():
+    """Function-scoped runtime for tests that mutate cluster state."""
+    import ray_tpu
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()

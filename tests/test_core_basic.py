@@ -1,0 +1,160 @@
+"""Core substrate unit tests: ids, config, serialization, object store."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
+                                  PlacementGroupID, TaskID)
+from ray_tpu._private.object_store import SharedMemoryStore
+from ray_tpu._private.resources import ResourceSet, task_resources
+
+
+class TestIDs:
+    def test_sizes_and_lineage(self):
+        job = JobID.from_int(7)
+        actor = ActorID.of(job)
+        task = TaskID.of(actor)
+        obj = ObjectID.of(task, 3)
+        assert obj.task_id() == task
+        assert task.actor_id() == actor
+        assert actor.job_id() == job
+        assert obj.job_id() == job
+        assert obj.index() == 3
+
+    def test_hex_roundtrip(self):
+        nid = NodeID.from_random()
+        assert NodeID.from_hex(nid.hex()) == nid
+
+    def test_nil(self):
+        assert ObjectID.nil().is_nil()
+        assert not ObjectID.of(TaskID.for_driver(JobID.from_int(1)), 0).is_nil()
+
+    def test_pg_id(self):
+        job = JobID.from_int(9)
+        pg = PlacementGroupID.of(job)
+        assert pg.job_id() == job
+
+    def test_hashable(self):
+        job = JobID.from_int(1)
+        t = TaskID.for_driver(job)
+        s = {ObjectID.of(t, i) for i in range(10)}
+        assert len(s) == 10
+
+
+class TestConfig:
+    def test_defaults(self):
+        Config.initialize()
+        assert Config.get("max_inline_object_size") == 100 * 1024
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_MAX_INLINE_OBJECT_SIZE", "1234")
+        Config.initialize()
+        assert Config.get("max_inline_object_size") == 1234
+        monkeypatch.delenv("RAY_TPU_MAX_INLINE_OBJECT_SIZE")
+        Config.initialize()
+
+    def test_unknown_flag(self):
+        with pytest.raises(KeyError):
+            Config.get("no_such_flag")
+
+    def test_blob_roundtrip(self, monkeypatch):
+        Config.initialize()
+        blob = Config.blob()
+        monkeypatch.setenv("RAY_TPU_CONFIG_BLOB", blob)
+        Config.initialize({})
+        assert Config.get("max_inline_object_size") == 100 * 1024
+
+
+class TestSerialization:
+    def test_roundtrip_scalars(self):
+        for v in [1, "x", None, {"a": [1, 2]}, (1, 2)]:
+            assert serialization.unpack_payload(
+                serialization.pack_payload(v)) == v
+
+    def test_numpy_out_of_band(self):
+        arr = np.arange(1000, dtype=np.float64)
+        meta, bufs = serialization.serialize_payload(arr)
+        assert sum(b.nbytes for b in bufs) >= arr.nbytes
+        out = serialization.unpack_payload(serialization.pack_payload(arr))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_closure(self):
+        y = 10
+        fn = serialization.loads_control(
+            serialization.dumps_control(lambda x: x + y))
+        assert fn(5) == 15
+
+
+class TestResourceSet:
+    def test_arithmetic(self):
+        a = ResourceSet({"CPU": 4, "TPU": 8})
+        b = ResourceSet({"CPU": 1, "TPU": 2})
+        c = a - b
+        assert c.get("CPU") == 3 and c.get("TPU") == 6
+        assert (c + b).get("TPU") == 8
+
+    def test_fits(self):
+        avail = ResourceSet({"CPU": 2})
+        assert ResourceSet({"CPU": 2}).fits(avail)
+        assert not ResourceSet({"CPU": 2.5}).fits(avail)
+        assert not ResourceSet({"TPU": 1}).fits(avail)
+        assert ResourceSet({}).fits(avail)
+
+    def test_negative_raises(self):
+        with pytest.raises(ValueError):
+            ResourceSet({"CPU": 1}) - ResourceSet({"CPU": 2})
+
+    def test_task_resources_defaults(self):
+        r = task_resources(None, None, None, None)
+        assert r.get("CPU") == 1.0
+        r = task_resources(2, 4, None, {"custom": 1})
+        assert r.get("TPU") == 4 and r.get("custom") == 1
+
+
+class TestObjectStore:
+    def _oid(self, i=0):
+        return ObjectID.of(TaskID.of(ActorID.of(JobID.from_int(99))), i)
+
+    def test_put_get(self):
+        store = SharedMemoryStore(capacity_bytes=10 << 20)
+        oid = self._oid(1)
+        arr = np.arange(10000, dtype=np.int64)
+        store.put(oid, {"x": arr, "y": "hello"})
+        out = store.get(oid)
+        np.testing.assert_array_equal(out["x"], arr)
+        assert out["y"] == "hello"
+        store.shutdown()
+
+    def test_spill_restore(self):
+        store = SharedMemoryStore(capacity_bytes=1 << 20)
+        arrs = {}
+        for i in range(5):
+            oid = self._oid(i)
+            arrs[oid] = np.full(40000, i, dtype=np.int64)  # 320KB each
+            store.put(oid, arrs[oid])
+        assert store.num_spilled > 0
+        for oid, arr in arrs.items():
+            np.testing.assert_array_equal(store.get(oid), arr)
+        assert store.num_restored > 0
+        store.shutdown()
+
+    def test_delete(self):
+        store = SharedMemoryStore(capacity_bytes=1 << 20)
+        oid = self._oid(7)
+        store.put(oid, b"x" * 1000)
+        assert store.contains(oid)
+        store.delete(oid)
+        assert not store.contains(oid)
+        store.shutdown()
+
+    def test_full_raises(self):
+        from ray_tpu._private.object_store import ObjectStoreFullError
+        store = SharedMemoryStore(capacity_bytes=1000)
+        oid = self._oid(8)
+        store.put(oid, b"a" * 100)
+        store.pin(oid)
+        with pytest.raises(ObjectStoreFullError):
+            store.put(self._oid(9), b"b" * 2000)
+        store.shutdown()

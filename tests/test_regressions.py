@@ -1,0 +1,67 @@
+"""Regression tests for bugs found in review."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+class TestRegressions:
+    def test_two_large_returns_no_shm_collision(self, ray_start):
+        """ObjectIDs differing only in return index must not collide."""
+        @ray_tpu.remote(num_returns=2)
+        def two_big():
+            return (np.zeros(500_000, dtype=np.float64),
+                    np.ones(500_000, dtype=np.float64))
+        a, b = two_big.remote()
+        va, vb = ray_tpu.get([a, b])
+        assert va.sum() == 0 and vb.sum() == 500_000
+
+    def test_two_large_puts(self, ray_start):
+        r1 = ray_tpu.put(np.zeros(1_000_000))
+        r2 = ray_tpu.put(np.ones(1_000_000))
+        assert ray_tpu.get(r1).sum() == 0
+        assert ray_tpu.get(r2).sum() == 1_000_000
+
+    def test_wait_num_returns_validation(self, ray_start):
+        r = ray_tpu.put(1)
+        with pytest.raises(ValueError):
+            ray_tpu.wait([r], num_returns=2)
+
+    def test_pending_pg_schedules_when_capacity_frees(self, ray_start):
+        """A PG that doesn't fit initially must become CREATED once the
+        blocking tasks release their resources."""
+        import time
+
+        @ray_tpu.remote(num_cpus=4)
+        def hog():
+            time.sleep(1.0)
+            return "done"
+        busy = hog.remote()
+        time.sleep(0.2)  # let it get dispatched
+        pg = ray_tpu.placement_group([{"CPU": 3}], strategy="PACK")
+        assert not pg.ready(timeout=0.1)  # still pending while hog runs
+        assert ray_tpu.get(busy, timeout=30) == "done"
+        assert pg.ready(timeout=10)
+        ray_tpu.remove_placement_group(pg)
+
+    def test_actor_death_cause_reported(self, ray_start):
+        @ray_tpu.remote
+        class Broken:
+            def __init__(self):
+                raise KeyError("the-secret-reason")
+
+            def m(self):
+                return 1
+        b = Broken.remote()
+        import time
+        for _ in range(100):
+            states = {a["class_name"]: a for a in
+                      ray_tpu._private.runtime.driver_runtime()
+                      .ctl_list_actors()}
+            if states.get("Broken", {}).get("state") == "DEAD":
+                break
+            time.sleep(0.1)
+        info = ray_tpu._private.runtime.driver_runtime().controller
+        dead = [a for a in info.actors.values() if a.class_name == "Broken"]
+        assert dead and "the-secret-reason" in (dead[0].death_cause or "")

@@ -1,0 +1,231 @@
+"""Task/actor runtime integration tests (multiprocess workers).
+
+Mirrors the reference's core API test surface (reference:
+python/ray/tests/test_basic.py and test_actor.py patterns) against the
+ray_tpu runtime.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def double(x):
+    return x * 2
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, k=1):
+        self.v += k
+        return self.v
+
+    def value(self):
+        return self.v
+
+    def crash(self):
+        import os
+        os._exit(1)
+
+
+class TestTasks:
+    def test_basic(self, ray_start):
+        assert ray_tpu.get(double.remote(21)) == 42
+
+    def test_chained_dependencies(self, ray_start):
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+        z = add.remote(double.remote(1), double.remote(2))
+        assert ray_tpu.get(z) == 6
+
+    def test_many_tasks(self, ray_start):
+        refs = [double.remote(i) for i in range(50)]
+        assert ray_tpu.get(refs) == [2 * i for i in range(50)]
+
+    def test_multiple_returns(self, ray_start):
+        @ray_tpu.remote(num_returns=3)
+        def three():
+            return 1, 2, 3
+        a, b, c = three.remote()
+        assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+    def test_kwargs_and_large_args(self, ray_start):
+        @ray_tpu.remote
+        def norm(x, scale=1.0):
+            return float(np.sum(x)) * scale
+        big = np.ones(500_000, dtype=np.float32)  # 2MB -> shm path
+        assert ray_tpu.get(norm.remote(big, scale=2.0)) == pytest.approx(1e6)
+
+    def test_error_propagation(self, ray_start):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("kaboom")
+        with pytest.raises(ray_tpu.TaskError) as ei:
+            ray_tpu.get(boom.remote())
+        assert isinstance(ei.value.cause, ValueError)
+        assert "kaboom" in str(ei.value)
+
+    def test_error_through_dependency(self, ray_start):
+        @ray_tpu.remote
+        def boom():
+            raise RuntimeError("upstream")
+        with pytest.raises(ray_tpu.TaskError):
+            ray_tpu.get(double.remote(boom.remote()))
+
+    def test_nested_tasks(self, ray_start):
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get(double.remote(100)) + 1
+        assert ray_tpu.get(outer.remote()) == 201
+
+    def test_put_get(self, ray_start):
+        data = {"arr": np.arange(100), "s": "x"}
+        out = ray_tpu.get(ray_tpu.put(data))
+        np.testing.assert_array_equal(out["arr"], data["arr"])
+
+    def test_ref_passed_inside_container(self, ray_start):
+        ref = ray_tpu.put(5)
+
+        @ray_tpu.remote
+        def unwrap(refs):
+            return ray_tpu.get(refs[0]) + 1
+        assert ray_tpu.get(unwrap.remote([ref])) == 6
+
+    def test_wait(self, ray_start):
+        @ray_tpu.remote
+        def slow(t):
+            time.sleep(t)
+            return t
+        fast = slow.remote(0.01)
+        never = slow.remote(5)
+        ready, not_ready = ray_tpu.wait([fast, never], num_returns=1,
+                                        timeout=3)
+        assert ready == [fast] and not_ready == [never]
+
+    def test_get_timeout(self, ray_start):
+        @ray_tpu.remote
+        def sleepy():
+            time.sleep(10)
+        with pytest.raises(ray_tpu.GetTimeoutError):
+            ray_tpu.get(sleepy.remote(), timeout=0.2)
+
+    def test_options_name(self, ray_start):
+        assert ray_tpu.get(double.options(name="renamed").remote(1)) == 2
+
+
+class TestActors:
+    def test_basic_and_ordering(self, ray_start):
+        c = Counter.remote(10)
+        refs = [c.inc.remote() for _ in range(5)]
+        assert ray_tpu.get(refs) == [11, 12, 13, 14, 15]
+
+    def test_actor_with_dep_args(self, ray_start):
+        c = Counter.remote(0)
+        d = double.remote(5)
+        assert ray_tpu.get(c.inc.remote(d)) == 10
+
+    def test_two_actors_parallel(self, ray_start):
+        a, b = Counter.remote(0), Counter.remote(100)
+        ra = [a.inc.remote() for _ in range(3)]
+        rb = [b.inc.remote() for _ in range(3)]
+        assert ray_tpu.get(ra) == [1, 2, 3]
+        assert ray_tpu.get(rb) == [101, 102, 103]
+
+    def test_named_actor(self, ray_start):
+        c = Counter.options(name="the_counter").remote(5)
+        ray_tpu.get(c.value.remote())  # wait alive
+        h = ray_tpu.get_actor("the_counter")
+        assert ray_tpu.get(h.value.remote()) == 5
+
+    def test_get_if_exists(self, ray_start):
+        c1 = Counter.options(name="gie", get_if_exists=True).remote(1)
+        ray_tpu.get(c1.value.remote())
+        c2 = Counter.options(name="gie", get_if_exists=True).remote(999)
+        assert ray_tpu.get(c2.value.remote()) == 1
+
+    def test_actor_method_error(self, ray_start):
+        @ray_tpu.remote
+        class Bad:
+            def fail(self):
+                raise KeyError("nope")
+        b = Bad.remote()
+        with pytest.raises(ray_tpu.TaskError):
+            ray_tpu.get(b.fail.remote())
+
+    def test_actor_ctor_error_fails_methods(self, ray_start):
+        @ray_tpu.remote
+        class Broken:
+            def __init__(self):
+                raise RuntimeError("ctor boom")
+
+            def m(self):
+                return 1
+        b = Broken.remote()
+        with pytest.raises((ray_tpu.TaskError, ray_tpu.ActorError)):
+            ray_tpu.get(b.m.remote(), timeout=10)
+
+    def test_handle_passed_to_task(self, ray_start):
+        c = Counter.remote(0)
+
+        @ray_tpu.remote
+        def bump(counter):
+            return ray_tpu.get(counter.inc.remote(7))
+        assert ray_tpu.get(bump.remote(c)) == 7
+
+    def test_kill(self, ray_start):
+        c = Counter.remote(0)
+        ray_tpu.get(c.inc.remote())
+        ray_tpu.kill(c)
+        with pytest.raises((ray_tpu.ActorError, ray_tpu.WorkerCrashedError)):
+            ray_tpu.get(c.inc.remote(), timeout=10)
+
+
+class TestFaultTolerance:
+    def test_task_retry_on_worker_crash(self, ray_start):
+        attempts = ray_tpu.put(0)
+
+        @ray_tpu.remote(max_retries=2)
+        def flaky(marker):
+            import os
+            # Crash on first attempt only, keyed off a file.
+            path = "/tmp/ray_tpu_flaky_marker_" + marker
+            if not os.path.exists(path):
+                open(path, "w").close()
+                os._exit(1)
+            return "recovered"
+        import uuid
+        assert ray_tpu.get(flaky.remote(uuid.uuid4().hex), timeout=60) == "recovered"
+
+    def test_actor_restart(self, ray_start):
+        @ray_tpu.remote(max_restarts=1)
+        class Phoenix:
+            def __init__(self):
+                self.n = 0
+
+            def die(self):
+                import os
+                os._exit(1)
+
+            def ping(self):
+                return "alive"
+        p = Phoenix.remote()
+        assert ray_tpu.get(p.ping.remote()) == "alive"
+        p.die.remote()
+        time.sleep(1.0)
+        assert ray_tpu.get(p.ping.remote(), timeout=60) == "alive"
+
+    def test_worker_crash_no_retry_raises(self, ray_start):
+        @ray_tpu.remote(max_retries=0)
+        def die():
+            import os
+            os._exit(1)
+        with pytest.raises(ray_tpu.WorkerCrashedError):
+            ray_tpu.get(die.remote(), timeout=60)
