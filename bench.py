@@ -1,0 +1,125 @@
+"""Headline benchmark: LM training throughput on the local TPU chip(s).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: training tokens/sec/chip on a Llama-style decoder sized for the
+available HBM, full train step (fwd + bwd + adamw) under jit.
+
+vs_baseline: the north-star in BASELINE.json (Llama SFT tokens/sec/chip, TPU
+vs H100+NCCL) has no published reference number, so the comparable scalar is
+model FLOPs utilization: vs_baseline = our_MFU / 0.35, where 0.35 is a
+typical published H100+NCCL DDP SFT MFU for Llama-class models.  MFU is
+computed as 6 * params * tokens_per_sec / peak_bf16_flops.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+PEAK_BF16_FLOPS = {
+    # per chip, from published specs
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 1e11,  # nominal, so CPU smoke runs still print a line
+}
+H100_SFT_MFU_BASELINE = 0.35
+
+
+def _detect_gen() -> str:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+    if gen:
+        return gen
+    try:
+        import jax
+        if jax.default_backend() in ("tpu", "axon"):
+            kind = jax.devices()[0].device_kind.lower()
+            for g in ("v6e", "v5p", "v5e", "v4"):
+                if g in kind or ("v5 lite" in kind and g == "v5e"):
+                    return g
+            return "v5e"
+    except Exception:
+        pass
+    return "cpu"
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import LlamaConfig
+    from ray_tpu.models.llama import num_params
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.parallel.spmd import make_lm_train_step
+
+    gen = _detect_gen()
+    on_tpu = gen != "cpu"
+    n_dev = len(jax.devices())
+
+    if on_tpu:
+        # ~350M params: fits one v5e chip with fp32 adam state + remat.
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden=1024, layers=24, heads=16, kv_heads=16,
+            head_dim=64, mlp_dim=2816, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=True, attention_impl="flash")
+        batch_size, seq = 16, 2048
+        warmup, iters = 2, 10
+    else:
+        cfg = LlamaConfig(
+            vocab_size=512, hidden=128, layers=2, heads=4, kv_heads=4,
+            head_dim=32, mlp_dim=256, max_seq_len=256,
+            dtype=jnp.float32, remat=False, attention_impl="reference")
+        batch_size, seq = 4, 256
+        warmup, iters = 1, 3
+
+    mesh = build_mesh(MeshSpec(dp=n_dev))
+    init_fn, step_fn, place = make_lm_train_step(cfg, mesh,
+                                                 learning_rate=1e-4)
+    params, opt = init_fn(jax.random.key(0))
+    rng = np.random.default_rng(0)
+
+    def make_batch(i):
+        return place({"tokens": jnp.asarray(rng.integers(
+            0, cfg.vocab_size, (batch_size, seq), dtype=np.int32))})
+
+    batch = make_batch(0)
+    for _ in range(warmup):
+        params, opt, metrics = step_fn(params, opt, batch)
+    # float() forces a host transfer — a real sync even on experimental
+    # platforms where block_until_ready returns early.
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, opt, metrics = step_fn(params, opt, batch)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * seq
+    tokens_per_sec = tokens_per_step * iters / dt
+    tokens_per_sec_per_chip = tokens_per_sec / n_dev
+
+    p = num_params(cfg)
+    mfu = 6.0 * p * tokens_per_sec / (PEAK_BF16_FLOPS[gen] * n_dev)
+    vs_baseline = mfu / H100_SFT_MFU_BASELINE
+
+    print(json.dumps({
+        "metric": f"llama_{p/1e6:.0f}M_sft_tokens_per_sec_per_chip_{gen}",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+    print(f"# loss={float(metrics['loss']):.4f} mfu={mfu:.3f} "
+          f"params={p/1e6:.0f}M devices={n_dev} step_ms={dt/iters*1e3:.1f}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,12 @@ class WorkerHandle:
     conn: Any
     state: str = IDLE
     actor_id: Optional[ActorID] = None
+    # Chip-holding workers are dedicated: they are killed after their task
+    # and their chips return to the pool only when the process death is
+    # observed (libtpu releases device locks at exit).  Env-only workers
+    # are pooled per env signature instead.
+    dedicated: bool = False
+    env_key: str = ""
     running: Set[TaskID] = field(default_factory=set)
     reader: Optional[threading.Thread] = None
     ready: threading.Event = field(default_factory=threading.Event)
@@ -63,10 +69,13 @@ class NodeManager:
         self.runtime = runtime  # driver Runtime; provides message handlers
         self.store = SharedMemoryStore()
         self._workers: Dict[WorkerID, WorkerHandle] = {}
-        self._idle: List[WorkerID] = []
+        self._idle: Dict[str, List[WorkerID]] = {}
         self._lock = threading.RLock()
         self._chip_pool: List[int] = list(range(num_tpu_chips))
         self._closed = False
+        # exists (not isdir): zip/egg/pyz entries are importable too.
+        self._sys_path_blob = os.pathsep.join(
+            p for p in sys.path if p and os.path.exists(p))
         # Workers are spawned as fresh interpreters that dial back in
         # (reference: worker_pool.h StartWorkerProcess + raylet socket
         # registration) — no fork, no __main__ re-import, no jax inheritance.
@@ -125,6 +134,10 @@ class NodeManager:
             "RAY_TPU_NODE_SOCK": self._sock_path,
             "RAY_TPU_AUTHKEY": self._authkey.hex(),
             "RAY_TPU_CONFIG_BLOB": Config.blob(),
+            # Driver sys.path travels to workers so functions pickled
+            # by reference (importable modules, incl. test files) resolve
+            # (reference: runtime-env working_dir/py_modules propagation).
+            "RAY_TPU_SYS_PATH": self._sys_path_blob,
         })
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
@@ -136,16 +149,25 @@ class NodeManager:
             raise RuntimeError("worker failed to register in time")
         return handle
 
-    def _acquire_worker(self) -> WorkerHandle:
+    def _acquire_worker(self, env_key: str = "",
+                        env: Optional[Dict[str, str]] = None) -> WorkerHandle:
+        """Reuse an idle worker with a matching spawn env, else spawn.
+
+        Workers are pooled per env signature: boot-time env (jax platform,
+        flags) can't change after spawn, but identical-env tasks reuse the
+        same interpreters.
+        """
         with self._lock:
-            while self._idle:
-                wid = self._idle.pop()
+            bucket = self._idle.get(env_key, [])
+            while bucket:
+                wid = bucket.pop()
                 h = self._workers.get(wid)
                 if h is not None and h.state == IDLE:
                     h.state = BUSY
                     return h
-        h = self._spawn_worker()
+        h = self._spawn_worker(env=env)
         h.state = BUSY
+        h.env_key = env_key
         return h
 
     def _release_worker(self, handle: WorkerHandle) -> None:
@@ -153,14 +175,60 @@ class NodeManager:
             if handle.state == DEAD or handle.actor_id is not None:
                 return
             handle.state = IDLE
-            self._idle.append(handle.worker_id)
+            self._idle.setdefault(handle.env_key, []).append(
+                handle.worker_id)
 
     # -- dispatch -----------------------------------------------------------
 
     def dispatch_task(self, spec: TaskSpec,
                       resolved_args, resolved_kwargs,
-                      target_worker: Optional[WorkerID] = None) -> None:
+                      target_worker: Optional[WorkerID] = None,
+                      _retry_deadline: Optional[float] = None) -> None:
         """Send a fully-resolved task to a worker (lease grant + push)."""
+        env_vars: Dict[str, str] = dict(
+            spec.runtime_env.get("env_vars", {})) if spec.runtime_env else {}
+        # TPU chip pinning: integral chip grants get exclusive visibility via
+        # spawn-time env (libtpu/jax read it at process boot).
+        n_chips = int(spec.resources.get(TPU))
+        grant: List[int] = []
+        if n_chips > 0 and target_worker is None:
+            with self._lock:
+                if len(self._chip_pool) >= n_chips:
+                    grant = self._chip_pool[:n_chips]
+                    del self._chip_pool[:n_chips]
+            if not grant:
+                # Chips freed in the scheduler but physically still held by
+                # a dying worker (libtpu locks release at process exit):
+                # retry until the death handler returns them.
+                if _retry_deadline is None:
+                    _retry_deadline = time.monotonic() + \
+                        Config.get("lease_timeout_s")
+                if time.monotonic() > _retry_deadline:
+                    self.runtime.scheduler.release(
+                        self.info.node_id, spec.resources,
+                        spec.placement_group, spec.bundle_index)
+                    self.runtime.on_dispatch_failed(
+                        spec, f"timed out waiting for {n_chips} TPU chips")
+                    return
+
+                def _retry():
+                    try:
+                        self.dispatch_task(spec, resolved_args,
+                                           resolved_kwargs, target_worker,
+                                           _retry_deadline)
+                    except Exception as e:  # noqa: BLE001
+                        self.runtime.scheduler.release(
+                            self.info.node_id, spec.resources,
+                            spec.placement_group, spec.bundle_index)
+                        self.runtime.on_dispatch_failed(spec, repr(e))
+                t = threading.Timer(0.05, _retry)
+                t.daemon = True
+                t.start()
+                return
+            # Always overwrite: a retried task must see its fresh grant,
+            # not the first attempt's chips.
+            env_vars[Config.get("visible_accelerator_env")] = \
+                ",".join(str(c) for c in grant)
         if target_worker is not None:
             with self._lock:
                 handle = self._workers.get(target_worker)
@@ -168,25 +236,44 @@ class NodeManager:
                 self.runtime.on_dispatch_failed(spec, "target worker dead")
                 return
         else:
-            handle = self._acquire_worker()
-            if spec.create_actor_id is not None:
-                handle.actor_id = spec.create_actor_id
-        # TPU chip pinning: integral chip grants get exclusive visibility.
-        n_chips = int(spec.resources.get(TPU))
-        if n_chips > 0:
+            env_key = ""
+            if env_vars:
+                env_key = repr(sorted(env_vars.items()))
+            try:
+                if grant:
+                    # Chip-holding workers are never pooled: the process
+                    # must die before its chips are reusable.
+                    handle = self._spawn_worker(env=env_vars)
+                    handle.state = BUSY
+                    handle.dedicated = True
+                else:
+                    handle = self._acquire_worker(env_key, env_vars or None)
+            except Exception:
+                if grant:
+                    with self._lock:
+                        self._chip_pool.extend(grant)
+                # Propagate: the scheduler's dispatch-error path releases
+                # the booked resources and fails the task.
+                raise
+        if spec.create_actor_id is not None:
+            handle.actor_id = spec.create_actor_id
+        if grant:
             with self._lock:
-                grant = self._chip_pool[:n_chips]
-                del self._chip_pool[:n_chips]
-            handle.assigned_chips[spec.task_id] = grant
-            # Never mutate the caller's spec (retries reuse it) and always
-            # overwrite the chip list: a retried task must see its fresh
-            # grant, not the first attempt's chips.
-            env = dict(spec.runtime_env.get("env_vars", {})) if spec.runtime_env else {}
-            env[Config.get("visible_accelerator_env")] = \
-                ",".join(str(c) for c in grant)
+                if handle.state == DEAD or \
+                        handle.worker_id not in self._workers:
+                    # Worker died between spawn and chip assignment: the
+                    # death handler saw no assigned chips, so return them
+                    # here and fail the task cleanly.
+                    self._chip_pool.extend(grant)
+                    self.runtime.on_dispatch_failed(
+                        spec, "worker died before chip assignment")
+                    return
+                handle.assigned_chips[spec.task_id] = grant
+        if env_vars:
+            # Never mutate the caller's spec (retries rebuild from it).
             import copy as _copy
             spec = _copy.copy(spec)
-            spec.runtime_env = dict(spec.runtime_env or {}, env_vars=env)
+            spec.runtime_env = dict(spec.runtime_env or {}, env_vars=env_vars)
         handle.running.add(spec.task_id)
         self.runtime.note_task_running(spec.task_id, self.info.node_id,
                                        handle.worker_id)
@@ -239,17 +326,29 @@ class NodeManager:
             handle.ready.set()
         elif isinstance(msg, TaskDone):
             handle.running.discard(msg.task_id)
-            if handle.actor_id is None:
-                chips = handle.assigned_chips.pop(msg.task_id, None)
-                if chips:
-                    with self._lock:
-                        self._chip_pool.extend(chips)
-            # else: an actor keeps its creation chips for its lifetime; they
-            # return to the pool on worker death (_on_worker_death).
+            # Chips NEVER return to the pool at TaskDone: libtpu holds the
+            # device locks until process exit, so reuse must wait for
+            # _on_worker_death (actors and dedicated task workers alike).
             is_actor_worker = handle.actor_id is not None
             rt.on_task_done(msg, self.info.node_id)
             if not is_actor_worker:
-                self._release_worker(handle)
+                if handle.dedicated:
+                    # Graceful exit request, with a hard-terminate fallback:
+                    # if the KillWorker message is lost (chaos, broken pipe)
+                    # the process must still die or its chips leak forever.
+                    self._send(handle, KillWorker("dedicated worker done"))
+
+                    def _ensure_dead(h=handle):
+                        if h.proc.poll() is None:
+                            try:
+                                h.proc.terminate()
+                            except Exception:
+                                pass
+                    t = threading.Timer(2.0, _ensure_dead)
+                    t.daemon = True
+                    t.start()
+                else:
+                    self._release_worker(handle)
         elif isinstance(msg, SubmitFromWorker):
             rt.submit_spec(msg.spec)
         elif isinstance(msg, GetRequest):
@@ -271,8 +370,9 @@ class NodeManager:
                 return
             handle.state = DEAD
             self._workers.pop(handle.worker_id, None)
-            if handle.worker_id in self._idle:
-                self._idle.remove(handle.worker_id)
+            bucket = self._idle.get(handle.env_key)
+            if bucket and handle.worker_id in bucket:
+                bucket.remove(handle.worker_id)
             for task_id, chips in handle.assigned_chips.items():
                 self._chip_pool.extend(chips)
             handle.assigned_chips.clear()
@@ -300,7 +400,7 @@ class NodeManager:
         for _ in range(n):
             h = self._spawn_worker()
             with self._lock:
-                self._idle.append(h.worker_id)
+                self._idle.setdefault("", []).append(h.worker_id)
 
     def shutdown(self) -> None:
         self._closed = True

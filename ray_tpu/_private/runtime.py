@@ -441,6 +441,8 @@ class Runtime:
                                        spec.placement_group, spec.bundle_index)
 
     def on_dispatch_failed(self, spec: TaskSpec, reason: str) -> None:
+        with self._running_lock:
+            self._running.pop(spec.task_id, None)
         self._fail_task(spec, WorkerCrashedError(reason))
 
     def _fail_task(self, spec: TaskSpec, exc: Exception) -> None:

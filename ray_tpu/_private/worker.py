@@ -152,6 +152,10 @@ class WorkerLoop:
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task-exec")
         self._actor_lock = threading.Lock()
+        # With max_concurrency > 1 the executor pool may pick up method
+        # tasks while __init__ is still running on another thread; methods
+        # gate on this event (set when construction finishes or fails).
+        self._actor_ready = threading.Event()
         # Shm segments backing zero-copy views that an actor may retain in
         # its state must outlive the task that mapped them.
         self._actor_keepalives: List = []
@@ -180,15 +184,28 @@ class WorkerLoop:
             kwargs = {k: _materialize(d, keepalives)
                       for k, d in msg.resolved_kwargs.items()}
             if spec.create_actor_id is not None:
-                cls = serialization.loads_control(spec.fn_blob)
-                self.actor_instance = cls(*args, **kwargs)
+                try:
+                    cls = serialization.loads_control(spec.fn_blob)
+                    self.actor_instance = cls(*args, **kwargs)
+                except BaseException as init_exc:  # noqa: BLE001
+                    self._actor_init_error = init_exc
+                    raise
+                finally:
+                    self._actor_ready.set()
                 self.actor_id = spec.create_actor_id
                 rt.current_actor_id = spec.create_actor_id
                 rt.send(ActorStateMsg(spec.create_actor_id, "alive"))
                 value_list = [None] * len(spec.return_ids)
             elif spec.actor_id is not None:
                 if self.actor_instance is None:
-                    raise RuntimeError("actor instance not initialized")
+                    # No timeout: __init__ may legitimately take as long as
+                    # a large-model load/compile on a TPU slice.
+                    self._actor_ready.wait()
+                if self.actor_instance is None:
+                    cause = getattr(self, "_actor_init_error", None)
+                    raise RuntimeError(
+                        f"actor __init__ failed: {cause!r}" if cause
+                        else "actor instance not initialized")
                 method = getattr(self.actor_instance, spec.method_name)
                 out = method(*args, **kwargs)
                 value_list = self._split_returns(out, spec)

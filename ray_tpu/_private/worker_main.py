@@ -13,6 +13,9 @@ import sys
 
 
 def main() -> None:
+    for p in os.environ.get("RAY_TPU_SYS_PATH", "").split(os.pathsep):
+        if p and p not in sys.path:
+            sys.path.append(p)
     sock_path = os.environ["RAY_TPU_NODE_SOCK"]
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     worker_id_hex = os.environ["RAY_TPU_WORKER_ID"]

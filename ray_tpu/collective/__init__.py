@@ -1,0 +1,118 @@
+"""Collective communication among actors/tasks (ray.util.collective shape).
+
+API mirrors the reference (reference: python/ray/util/collective/
+collective.py — init_collective_group:149, allreduce:316, barrier:356,
+reduce:369, broadcast:431, allgather:481, reducescatter:530, send:589/recv)
+with the NCCL backend replaced by **XLA collectives**: group members form a
+jax.distributed world (gloo on CPU, ICI/DCN on TPU — the same seam the
+reference's JaxTrainer uses, reference: train/v2/jax/config.py:120), a
+global mesh over all member devices, and each op jits to the corresponding
+XLA collective.  Rendezvous runs through the runtime KV store instead of a
+named store actor (reference: nccl_collective_group.py:36 Rendezvous).
+
+A pure-Python "kv" backend (control-plane transfers through the KV store)
+is the gloo-equivalent fallback for API tests without jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .backends import KVBackend, XlaBackend
+
+_lock = threading.Lock()
+_groups: Dict[str, Any] = {}
+
+SUM = "sum"
+PROD = "prod"
+MIN = "min"
+MAX = "max"
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "xla",
+                          group_name: str = "default") -> None:
+    """Join a collective group; blocks until all members rendezvous."""
+    with _lock:
+        if group_name in _groups:
+            raise ValueError(f"group {group_name!r} already initialized")
+    if backend in ("xla", "gloo", "tpu", "auto"):
+        g = XlaBackend(world_size, rank, group_name)
+    elif backend in ("kv", "cpu"):
+        g = KVBackend(world_size, rank, group_name)
+    else:
+        raise ValueError(f"unknown collective backend {backend!r}")
+    g.setup()
+    with _lock:
+        _groups[group_name] = g
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    with _lock:
+        return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        g = _groups.pop(group_name, None)
+    if g is not None:
+        g.teardown()
+
+
+def _group(group_name: str):
+    with _lock:
+        g = _groups.get(group_name)
+    if g is None:
+        raise ValueError(f"collective group {group_name!r} not initialized; "
+                         "call init_collective_group() first")
+    return g
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = "default", op: str = SUM):
+    return _group(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = SUM):
+    return _group(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group(group_name).broadcast(tensor, src_rank)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = SUM):
+    return _group(group_name).reduce(tensor, dst_rank, op)
+
+
+def barrier(group_name: str = "default") -> None:
+    _group(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    _group(group_name).send(tensor, dst_rank)
+
+
+def recv(shape, dtype, src_rank: int, group_name: str = "default"):
+    return _group(group_name).recv(shape, dtype, src_rank)
+
+
+__all__ = [
+    "init_collective_group", "destroy_collective_group",
+    "is_group_initialized", "get_rank", "get_collective_group_size",
+    "allreduce", "allgather", "reducescatter", "broadcast", "reduce",
+    "barrier", "send", "recv", "SUM", "PROD", "MIN", "MAX",
+]

@@ -1,0 +1,314 @@
+"""Collective backends: XLA (jax.distributed) and KV (control-plane).
+
+Rendezvous protocol (both backends): rank 0 publishes group metadata at
+``collective/<group>/meta`` in the runtime KV store; every member then
+checks in at ``collective/<group>/join/<rank>``.  This replaces the
+reference's named-store-actor NCCL-unique-id exchange (reference:
+python/ray/util/collective/collective_group/nccl_collective_group.py:36).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import time
+from typing import Any
+
+_RENDEZVOUS_TIMEOUT_S = 120.0
+_POLL_S = 0.02
+
+
+def _kv_put(key: str, value: bytes) -> None:
+    from .._private.api import _control
+    _control("kv_put", key, value)
+
+
+def _kv_get(key: str):
+    from .._private.api import _control
+    return _control("kv_get", key)
+
+
+def _kv_del(key: str) -> None:
+    from .._private.api import _control
+    _control("kv_del", key)
+
+
+def _wait_for(key: str, timeout: float = _RENDEZVOUS_TIMEOUT_S) -> bytes:
+    deadline = time.monotonic() + timeout
+    while True:
+        v = _kv_get(key)
+        if v is not None:
+            return v
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"rendezvous timed out waiting for {key}")
+        time.sleep(_POLL_S)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class XlaBackend:
+    """Group ops lower to XLA collectives over a jax.distributed world.
+
+    On CPU the world uses gloo; on TPU the mesh forms over ICI/DCN via
+    libtpu (the JaxTrainer seam, reference: train/v2/jax/config.py:115-133).
+    jax.distributed supports one world per process: one XlaBackend group
+    may be active at a time in a given worker.
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._mesh = None
+        self._np = None
+
+    def setup(self) -> None:
+        key = f"collective/{self.group_name}/addr"
+        if self.rank == 0:
+            addr = f"127.0.0.1:{_free_port()}"
+            _kv_put(key, addr.encode())
+        else:
+            addr = _wait_for(key).decode()
+
+        import os
+
+        import jax
+        # Must not touch the backend (jax.devices/default_backend) before
+        # distributed.initialize.  Platform comes from env only.
+        if "tpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass
+        jax.distributed.initialize(addr, num_processes=self.world_size,
+                                   process_id=self.rank)
+        import numpy as np
+        from jax.sharding import Mesh
+        self._np = np
+        devs = jax.devices()
+        self._mesh = Mesh(np.array(devs), ("world",))
+        self._devices_per_proc = len(jax.local_devices())
+
+    def teardown(self) -> None:
+        try:
+            import jax
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        if self.rank == 0:
+            _kv_del(f"collective/{self.group_name}/addr")
+
+    # -- helpers ------------------------------------------------------------
+
+    def _global(self, local):
+        """Local [*, ...] -> global [n_devices, ...] sharded on axis 0.
+
+        With d devices per process the local row is repeated d times;
+        reductions de-duplicate with a stride-d slice so multi-device
+        processes contribute once.
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        local = np.asarray(local)
+        sharding = NamedSharding(self._mesh, P("world"))
+        return jax.make_array_from_process_local_data(
+            sharding, np.repeat(local[None], self._devices_per_proc, 0))
+
+    def _replicated_result(self, computation, arr):
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        out = jax.jit(computation,
+                      out_shardings=NamedSharding(self._mesh, P()))(arr)
+        return np.asarray(out.addressable_shards[0].data)
+
+    @staticmethod
+    def _op_fn(op: str):
+        import jax.numpy as jnp
+        return {"sum": jnp.sum, "prod": jnp.prod, "min": jnp.min,
+                "max": jnp.max}[op]
+
+    # -- ops ----------------------------------------------------------------
+
+    def allreduce(self, tensor, op: str = "sum"):
+        fn = self._op_fn(op)
+        arr = self._global(tensor)
+        k = self._devices_per_proc
+        return self._replicated_result(lambda a: fn(a[::k], axis=0), arr)
+
+    def allgather(self, tensor):
+        arr = self._global(tensor)
+        k = self._devices_per_proc
+        return self._replicated_result(lambda a: a[::k], arr)
+
+    def reducescatter(self, tensor, op: str = "sum"):
+        """Input per rank: [world * chunk, ...]; returns this rank's chunk."""
+        full = self.allreduce(tensor, op)
+        n = full.shape[0]
+        if n % self.world_size:
+            raise ValueError(
+                f"reducescatter dim {n} not divisible by {self.world_size}")
+        chunk = n // self.world_size
+        return full[self.rank * chunk:(self.rank + 1) * chunk]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        import numpy as np
+        local = np.asarray(tensor)
+        masked = local if self.rank == src_rank else np.zeros_like(local)
+        return self.allreduce(masked, "sum")
+
+    def reduce(self, tensor, dst_rank: int = 0, op: str = "sum"):
+        out = self.allreduce(tensor, op)
+        import numpy as np
+        return out if self.rank == dst_rank else np.asarray(tensor)
+
+    def barrier(self) -> None:
+        import numpy as np
+        self.allreduce(np.zeros(1, np.float32), "sum")
+
+    def send(self, tensor, dst_rank: int) -> None:
+        if not hasattr(self, "_p2p_out"):
+            self._p2p_out = {}
+        seq = self._p2p_out[dst_rank] = self._p2p_out.get(dst_rank, 0) + 1
+        _kv_put(
+            f"collective/{self.group_name}/p2p/"
+            f"{self.rank}->{dst_rank}/{seq}",
+            pickle.dumps(self._np.asarray(tensor)))
+
+    def recv(self, shape, dtype, src_rank: int):
+        if not hasattr(self, "_p2p_in"):
+            self._p2p_in = {}
+        seq = self._p2p_in[src_rank] = self._p2p_in.get(src_rank, 0) + 1
+        key = (f"collective/{self.group_name}/p2p/"
+               f"{src_rank}->{self.rank}/{seq}")
+        data = _wait_for(key)
+        _kv_del(key)
+        return pickle.loads(data)
+
+
+class KVBackend:
+    """Pure-Python collective over the runtime KV store.
+
+    The gloo-equivalent control-plane fallback (SURVEY §2.4 collectives
+    row): correct for any picklable numpy payload, no jax required.  Each
+    op round gets a sequence number so groups can run many ops.
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._seq = 0
+        self._nonce = ""
+
+    def setup(self) -> None:
+        # Rank 0 publishes a fresh incarnation nonce so a recreated group
+        # with the same name can never read a previous incarnation's rounds.
+        meta_key = f"collective/{self.group_name}/meta"
+        if self.rank == 0:
+            import uuid
+            self._nonce = uuid.uuid4().hex[:8]
+            _kv_put(meta_key, self._nonce.encode())
+        else:
+            self._nonce = _wait_for(meta_key).decode()
+        base = f"collective/{self.group_name}/{self._nonce}"
+        _kv_put(f"{base}/join/{self.rank}", b"1")
+        deadline = time.monotonic() + _RENDEZVOUS_TIMEOUT_S
+        for r in range(self.world_size):
+            _wait_for(f"{base}/join/{r}", deadline - time.monotonic())
+
+    def teardown(self) -> None:
+        base = f"collective/{self.group_name}/{self._nonce}"
+        _kv_del(f"{base}/join/{self.rank}")
+        for s in (self._seq, self._seq - 1):
+            if s > 0:
+                _kv_del(f"{base}/r{s}/{self.rank}")
+        if self.rank == 0:
+            _kv_del(f"collective/{self.group_name}/meta")
+
+    def _round(self, tensor) -> list:
+        """Exchange: everyone publishes, everyone reads all.
+
+        Garbage collection: entering round n proves every rank finished
+        round n-1 (we read all its keys), which proves every rank had
+        finished reading round n-2 — so each rank deletes its own n-2 key
+        here, bounding KV growth to two rounds.
+        """
+        import numpy as np
+        self._seq += 1
+        base = f"collective/{self.group_name}/{self._nonce}"
+        if self._seq >= 3:
+            _kv_del(f"{base}/r{self._seq - 2}/{self.rank}")
+        _kv_put(f"{base}/r{self._seq}/{self.rank}",
+                pickle.dumps(np.asarray(tensor)))
+        parts = []
+        for r in range(self.world_size):
+            parts.append(pickle.loads(
+                _wait_for(f"{base}/r{self._seq}/{r}")))
+        return parts
+
+    @staticmethod
+    def _reduce(parts: list, op: str):
+        import numpy as np
+        fns = {"sum": np.add, "prod": np.multiply, "min": np.minimum,
+               "max": np.maximum}
+        out = parts[0].copy()
+        for p in parts[1:]:
+            out = fns[op](out, p)
+        return out
+
+    def allreduce(self, tensor, op: str = "sum"):
+        return self._reduce(self._round(tensor), op)
+
+    def allgather(self, tensor):
+        import numpy as np
+        return np.stack(self._round(tensor))
+
+    def reducescatter(self, tensor, op: str = "sum"):
+        full = self.allreduce(tensor, op)
+        if full.shape[0] % self.world_size:
+            raise ValueError(
+                f"reducescatter dim {full.shape[0]} not divisible by "
+                f"{self.world_size}")
+        chunk = full.shape[0] // self.world_size
+        return full[self.rank * chunk:(self.rank + 1) * chunk]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        parts = self._round(tensor)
+        return parts[src_rank]
+
+    def reduce(self, tensor, dst_rank: int = 0, op: str = "sum"):
+        out = self.allreduce(tensor, op)
+        import numpy as np
+        return out if self.rank == dst_rank else np.asarray(tensor)
+
+    def barrier(self) -> None:
+        import numpy as np
+        self._round(np.zeros(1))
+
+    def send(self, tensor, dst_rank: int) -> None:
+        import numpy as np
+        if not hasattr(self, "_p2p_out"):
+            self._p2p_out = {}
+        seq = self._p2p_out[dst_rank] = self._p2p_out.get(dst_rank, 0) + 1
+        _kv_put(f"collective/{self.group_name}/p2p/"
+                f"{self.rank}->{dst_rank}/{seq}",
+                pickle.dumps(np.asarray(tensor)))
+
+    def recv(self, shape, dtype, src_rank: int):
+        if not hasattr(self, "_p2p_in"):
+            self._p2p_in = {}
+        seq = self._p2p_in[src_rank] = self._p2p_in.get(src_rank, 0) + 1
+        key = (f"collective/{self.group_name}/p2p/"
+               f"{src_rank}->{self.rank}/{seq}")
+        data = _wait_for(key)
+        _kv_del(key)
+        return pickle.loads(data)

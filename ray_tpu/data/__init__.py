@@ -1,0 +1,13 @@
+"""ray_tpu.data — streaming datasets feeding TPU meshes (Ray Data
+equivalent)."""
+
+from .block import Block, BlockAccessor
+from .dataset import (Dataset, from_items, from_numpy, from_pandas, range,
+                      read_csv, read_json, read_parquet)
+from .iterator import device_put_iterator, iter_batches
+
+__all__ = [
+    "Dataset", "Block", "BlockAccessor", "range", "from_items",
+    "from_numpy", "from_pandas", "read_parquet", "read_csv", "read_json",
+    "iter_batches", "device_put_iterator",
+]

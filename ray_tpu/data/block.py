@@ -1,0 +1,80 @@
+"""Blocks: the unit of distributed data.
+
+Reference analog: python/ray/data/block.py + _internal/arrow_block.py.
+A block is a column dict of numpy arrays (the TPU-friendly layout — feeds
+``jax.device_put`` with zero conversion); pyarrow handles file IO at the
+edges.  BlockAccessor mirrors the reference's accessor pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+
+
+def _normalize(item: Any) -> Dict[str, Any]:
+    if isinstance(item, dict):
+        return item
+    return {"item": item}
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self._b = block
+
+    @staticmethod
+    def from_rows(rows: Sequence[Dict[str, Any]]) -> Block:
+        if not rows:
+            return {}
+        cols: Dict[str, List[Any]] = {k: [] for k in rows[0]}
+        for r in rows:
+            for k in cols:
+                cols[k].append(r[k])
+        return {k: np.asarray(v) for k, v in cols.items()}
+
+    @staticmethod
+    def from_arrow(table) -> Block:
+        return {name: np.asarray(col)
+                for name, col in zip(table.column_names, table.columns)}
+
+    def to_arrow(self):
+        import pyarrow as pa
+        return pa.table({k: v for k, v in self._b.items()})
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame({k: list(v) if v.ndim > 1 else v
+                             for k, v in self._b.items()})
+
+    def num_rows(self) -> int:
+        if not self._b:
+            return 0
+        return len(next(iter(self._b.values())))
+
+    def size_bytes(self) -> int:
+        return sum(v.nbytes for v in self._b.values())
+
+    def slice(self, start: int, end: int) -> Block:
+        return {k: v[start:end] for k, v in self._b.items()}
+
+    def take(self, indices: np.ndarray) -> Block:
+        return {k: v[indices] for k, v in self._b.items()}
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        n = self.num_rows()
+        for i in range(n):
+            yield {k: v[i] for k, v in self._b.items()}
+
+    def schema(self) -> Dict[str, str]:
+        return {k: str(v.dtype) for k, v in self._b.items()}
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b and BlockAccessor(b).num_rows() > 0]
+        if not blocks:
+            return {}
+        keys = blocks[0].keys()
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}

@@ -1,0 +1,267 @@
+"""Dataset: lazy logical plan -> streamed execution over runtime tasks.
+
+Reference analog: python/ray/data/dataset.py:196 Dataset (logical plan
+_internal/logical/, StreamingExecutor _internal/execution/
+streaming_executor.py:76).  The plan here is a source + a chain of
+block-transform stages; consecutive map-like stages fuse into one task
+(the reference's operator-fusion rule), and execution streams blocks
+through worker tasks with bounded in-flight backpressure.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
+                    Union)
+
+import numpy as np
+
+from .block import Block, BlockAccessor, _normalize
+
+
+@dataclass
+class Stage:
+    name: str
+    fn: Callable[[Block], Block]          # block -> block
+    # map-like stages fuse; all-to-all stages (shuffle/repartition) barrier
+    kind: str = "map"
+
+
+class Dataset:
+    """Lazy, immutable; transforms return new Datasets."""
+
+    def __init__(self, source_blocks: List[Any], stages: List[Stage],
+                 parallelism: int):
+        # source_blocks: list of ObjectRefs or in-memory Blocks
+        self._source = source_blocks
+        self._stages = stages
+        self._parallelism = parallelism
+
+    # ------------------------------------------------------------------ #
+    # sources (reference: data/read_api.py)
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def from_items(items: Sequence[Any], parallelism: int = 8) -> "Dataset":
+        items = list(items)
+        n = max(1, min(parallelism, len(items) or 1))
+        chunks = np.array_split(np.arange(len(items)), n)
+        blocks = []
+        for c in chunks:
+            rows = [_normalize(items[i]) for i in c]
+            blocks.append(BlockAccessor.from_rows(rows))
+        return Dataset(blocks, [], n)
+
+    @staticmethod
+    def range(n: int, parallelism: int = 8) -> "Dataset":
+        bounds = np.linspace(0, n, max(1, parallelism) + 1, dtype=np.int64)
+        blocks = [{"id": np.arange(a, b)} for a, b in
+                  zip(bounds[:-1], bounds[1:]) if b > a]
+        return Dataset(blocks, [], parallelism)
+
+    @staticmethod
+    def from_numpy(arrays: Dict[str, np.ndarray],
+                   parallelism: int = 8) -> "Dataset":
+        n = len(next(iter(arrays.values())))
+        bounds = np.linspace(0, n, max(1, parallelism) + 1, dtype=np.int64)
+        blocks = [{k: v[a:b] for k, v in arrays.items()}
+                  for a, b in zip(bounds[:-1], bounds[1:]) if b > a]
+        return Dataset(blocks, [], parallelism)
+
+    @staticmethod
+    def from_pandas(df, parallelism: int = 8) -> "Dataset":
+        return Dataset.from_numpy(
+            {c: df[c].to_numpy() for c in df.columns}, parallelism)
+
+    @staticmethod
+    def read_parquet(paths: Union[str, List[str]],
+                     parallelism: int = 8) -> "Dataset":
+        import glob as g
+        if isinstance(paths, str):
+            paths = sorted(g.glob(paths)) or [paths]
+
+        def load(path):
+            import pyarrow.parquet as pq
+            return BlockAccessor.from_arrow(pq.read_table(path))
+        return _read_files(paths, load, parallelism)
+
+    @staticmethod
+    def read_csv(paths: Union[str, List[str]],
+                 parallelism: int = 8) -> "Dataset":
+        import glob as g
+        if isinstance(paths, str):
+            paths = sorted(g.glob(paths)) or [paths]
+
+        def load(path):
+            import pyarrow.csv as pc
+            return BlockAccessor.from_arrow(pc.read_csv(path))
+        return _read_files(paths, load, parallelism)
+
+    @staticmethod
+    def read_json(paths: Union[str, List[str]],
+                  parallelism: int = 8) -> "Dataset":
+        import glob as g
+        if isinstance(paths, str):
+            paths = sorted(g.glob(paths)) or [paths]
+
+        def load(path):
+            import pyarrow.json as pj
+            return BlockAccessor.from_arrow(pj.read_json(path))
+        return _read_files(paths, load, parallelism)
+
+    # ------------------------------------------------------------------ #
+    # transforms
+    # ------------------------------------------------------------------ #
+
+    def _with_stage(self, stage: Stage) -> "Dataset":
+        return Dataset(self._source, self._stages + [stage],
+                       self._parallelism)
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
+            return BlockAccessor.from_rows(rows)
+        return self._with_stage(Stage(f"map({fn.__name__})", apply))
+
+    def map_batches(self, fn: Callable[[Block], Block],
+                    **_compat) -> "Dataset":
+        return self._with_stage(Stage(f"map_batches({fn.__name__})", fn))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            rows = [o for r in BlockAccessor(block).iter_rows()
+                    for o in fn(r)]
+            return BlockAccessor.from_rows(rows)
+        return self._with_stage(Stage(f"flat_map({fn.__name__})", apply))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            keep = np.array([bool(fn(r)) for r in acc.iter_rows()],
+                            dtype=bool)
+            return acc.take(np.nonzero(keep)[0])
+        return self._with_stage(Stage(f"filter({fn.__name__})", apply))
+
+    def add_column(self, name: str, fn: Callable[[Block], np.ndarray]) -> "Dataset":
+        def apply(block: Block) -> Block:
+            out = dict(block)
+            out[name] = np.asarray(fn(block))
+            return out
+        return self._with_stage(Stage(f"add_column({name})", apply))
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        return self._with_stage(Stage("random_shuffle", None,  # type: ignore
+                                      kind=f"shuffle:{seed}"))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_stage(Stage("repartition", None,  # type: ignore
+                                      kind=f"repartition:{num_blocks}"))
+
+    # ------------------------------------------------------------------ #
+    # consumption
+    # ------------------------------------------------------------------ #
+
+    def materialize(self) -> "Dataset":
+        from .executor import execute
+        blocks = execute(self)
+        return Dataset(blocks, [], self._parallelism)
+
+    def _blocks(self) -> List[Block]:
+        from .executor import execute, fetch
+        return [fetch(b) for b in execute(self)]
+
+    def count(self) -> int:
+        return sum(BlockAccessor(b).num_rows() for b in self._blocks())
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for b in self._blocks():
+            for row in BlockAccessor(b).iter_rows():
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return self.take(1 << 62)
+
+    def schema(self) -> Dict[str, str]:
+        for b in self._blocks():
+            if BlockAccessor(b).num_rows():
+                return BlockAccessor(b).schema()
+        return {}
+
+    def to_pandas(self):
+        return BlockAccessor(
+            BlockAccessor.concat(self._blocks())).to_pandas()
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for b in self._blocks():
+            yield from BlockAccessor(b).iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False,
+                     shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Block]:
+        from .iterator import iter_batches
+        return iter_batches(self, batch_size=batch_size,
+                            drop_last=drop_last, shuffle_seed=shuffle_seed)
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Split into n datasets by row count (for per-worker shards;
+        reference: Dataset.split / streaming_split)."""
+        blocks = self._blocks()
+        full = BlockAccessor.concat(blocks)
+        total = BlockAccessor(full).num_rows()
+        bounds = np.linspace(0, total, n + 1, dtype=np.int64)
+        out = []
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            out.append(Dataset([BlockAccessor(full).slice(int(a), int(b))],
+                               [], 1))
+        return out
+
+    def num_blocks(self) -> int:
+        return len(self._source)
+
+    def stats(self) -> str:
+        return (f"Dataset(blocks={len(self._source)}, "
+                f"stages={[s.name for s in self._stages]})")
+
+    def __repr__(self):
+        return self.stats()
+
+
+def _read_files(paths: List[str], loader: Callable[[str], Block],
+                parallelism: int) -> "Dataset":
+    # One read task per file; the loader runs remotely at execution.
+    blocks: List[Any] = [("__read__", loader, p) for p in paths]
+    return Dataset(blocks, [], parallelism)
+
+
+def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return Dataset.range(n, parallelism)
+
+
+def from_items(items, parallelism: int = 8) -> Dataset:
+    return Dataset.from_items(items, parallelism)
+
+
+def from_numpy(arrays, parallelism: int = 8) -> Dataset:
+    return Dataset.from_numpy(arrays, parallelism)
+
+
+def from_pandas(df, parallelism: int = 8) -> Dataset:
+    return Dataset.from_pandas(df, parallelism)
+
+
+def read_parquet(paths, parallelism: int = 8) -> Dataset:
+    return Dataset.read_parquet(paths, parallelism)
+
+
+def read_csv(paths, parallelism: int = 8) -> Dataset:
+    return Dataset.read_csv(paths, parallelism)
+
+
+def read_json(paths, parallelism: int = 8) -> Dataset:
+    return Dataset.read_json(paths, parallelism)

@@ -1,0 +1,84 @@
+"""Batch iteration + device feeding.
+
+Reference analog: iter_batches on DataIterator
+(python/ray/data/iterator.py) and Train's per-worker dataset shards
+(SURVEY §3.4 step 4).  ``device_put_iterator`` double-buffers host->HBM
+transfers so the next batch uploads while the current step runs — the
+host-side half of the HBM-bandwidth story.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .block import Block, BlockAccessor
+
+
+def iter_batches(ds, *, batch_size: int = 256, drop_last: bool = False,
+                 shuffle_seed: Optional[int] = None) -> Iterator[Block]:
+    carry: Optional[Block] = None
+    rng = (np.random.default_rng(shuffle_seed)
+           if shuffle_seed is not None else None)
+    for block in map(_maybe_shuffle(rng), _blocks_of(ds)):
+        if carry is not None and BlockAccessor(carry).num_rows():
+            block = BlockAccessor.concat([carry, block])
+            carry = None
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        start = 0
+        while n - start >= batch_size:
+            yield acc.slice(start, start + batch_size)
+            start += batch_size
+        if start < n:
+            carry = acc.slice(start, n)
+    if carry is not None and BlockAccessor(carry).num_rows() and not drop_last:
+        yield carry
+
+
+def _blocks_of(ds):
+    from .executor import execute, fetch
+    for b in execute(ds):
+        yield fetch(b)
+
+
+def _maybe_shuffle(rng):
+    def apply(block: Block) -> Block:
+        if rng is None:
+            return block
+        acc = BlockAccessor(block)
+        return acc.take(rng.permutation(acc.num_rows()))
+    return apply
+
+
+def device_put_iterator(batches: Iterator[Block], sharding=None,
+                        prefetch: int = 2) -> Iterator:
+    """Host batch dicts -> device arrays, double-buffered.
+
+    ``sharding`` is a jax Sharding (e.g. the train step's batch sharding);
+    transfers for up to ``prefetch`` future batches are issued before the
+    current one is consumed, overlapping H2D DMA with device compute.
+    """
+    import collections
+
+    import jax
+
+    def put(b):
+        return {k: (jax.device_put(v, sharding) if sharding is not None
+                    else jax.device_put(v)) for k, v in b.items()}
+
+    q: collections.deque = collections.deque()
+    it = iter(batches)
+    try:
+        for _ in range(prefetch):
+            q.append(put(next(it)))
+    except StopIteration:
+        pass
+    while q:
+        out = q.popleft()
+        try:
+            q.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

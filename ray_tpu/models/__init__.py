@@ -1,0 +1,12 @@
+"""Model zoo: pure-jax pytree models with logical-axis sharding annotations."""
+
+from .llama import (LlamaConfig, init_params, forward, loss_fn,
+                    param_logical_axes, llama_tiny, llama_125m, llama_1b,
+                    llama_7b)
+from .mlp import MLPConfig, init_mlp, mlp_forward, mlp_loss
+
+__all__ = [
+    "LlamaConfig", "init_params", "forward", "loss_fn", "param_logical_axes",
+    "llama_tiny", "llama_125m", "llama_1b", "llama_7b",
+    "MLPConfig", "init_mlp", "mlp_forward", "mlp_loss",
+]

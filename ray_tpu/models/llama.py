@@ -1,0 +1,282 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Design notes (vs the reference, which has no in-repo model — it wraps HF
+torch models in Train workers, reference: release/train_tests/huggingface):
+
+- Pure pytree params + functions — everything jit/pjit-able, no module
+  framework in the hot path.
+- Every param/activation dim carries a *logical* axis name; the
+  parallel/sharding rule table maps those to mesh axes, so dp/fsdp/tp/sp/ep
+  are layout choices, not model edits.
+- Layers are stacked and iterated with ``lax.scan`` (one compiled block,
+  layer-count-independent compile time) with optional ``jax.checkpoint``
+  rematerialization to trade MXU FLOPs for HBM.
+- bfloat16 activations/weights with fp32 master params handled by the
+  optimizer; matmuls accumulate fp32 via preferred_element_type (MXU-native).
+- Attention dispatches to the ops layer: pallas flash on-chip, ring/Ulysses
+  over the ``sp`` axis for long context.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention as _attention
+from ..ops.attention import reference_attention
+from ..ops.moe import moe_layer
+from ..ops.norms import rms_norm
+from ..ops.ring_attention import ring_attention
+from ..ops.rope import apply_rope, rope_frequencies
+from ..ops.ulysses import ulysses_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 32
+    head_dim: int = 128
+    mlp_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # MoE: 0 experts = dense model.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    # "auto" (flash on TPU / reference on CPU), "reference", "flash",
+    # "flash_interpret", "ring", "ulysses"
+    attention_impl: str = "auto"
+    # Mesh axis used by ring/ulysses attention.
+    seq_axis: str = "sp"
+    remat: bool = True
+
+    def replace(self, **kw) -> "LlamaConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def llama_tiny() -> LlamaConfig:
+    return LlamaConfig(vocab_size=512, hidden=128, layers=2, heads=4,
+                       kv_heads=2, head_dim=32, mlp_dim=256, max_seq_len=256)
+
+
+def llama_125m() -> LlamaConfig:
+    return LlamaConfig(vocab_size=32000, hidden=768, layers=12, heads=12,
+                       kv_heads=12, head_dim=64, mlp_dim=2048,
+                       max_seq_len=2048)
+
+
+def llama_1b() -> LlamaConfig:
+    return LlamaConfig(vocab_size=32000, hidden=2048, layers=16, heads=16,
+                       kv_heads=8, head_dim=128, mlp_dim=5504,
+                       max_seq_len=2048)
+
+
+def llama_7b() -> LlamaConfig:
+    return LlamaConfig()  # defaults are 7B
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Pytree (matching init_params) of logical axis tuples."""
+    block: Dict[str, Any] = {
+        "attn_norm": ("layers", None),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", None),
+    }
+    if cfg.num_experts:
+        block.update({
+            "router": ("layers", "embed", None),
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        })
+    else:
+        block.update({
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        })
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": block,
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array,
+                param_dtype=jnp.float32) -> Dict[str, Any]:
+    ks = jax.random.split(key, 10)
+    L, E, H, Hkv, D, M = (cfg.layers, cfg.hidden, cfg.heads, cfg.kv_heads,
+                          cfg.head_dim, cfg.mlp_dim)
+
+    def trunc(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(param_dtype)
+
+    blocks: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, E), param_dtype),
+        "wq": trunc(ks[1], (L, E, H, D), E),
+        "wk": trunc(ks[2], (L, E, Hkv, D), E),
+        "wv": trunc(ks[3], (L, E, Hkv, D), E),
+        "wo": trunc(ks[4], (L, H, D, E), H * D),
+        "mlp_norm": jnp.ones((L, E), param_dtype),
+    }
+    if cfg.num_experts:
+        X = cfg.num_experts
+        blocks.update({
+            "router": trunc(ks[5], (L, E, X), E),
+            "w_gate": trunc(ks[6], (L, X, E, M), E),
+            "w_up": trunc(ks[7], (L, X, E, M), E),
+            "w_down": trunc(ks[8], (L, X, M, E), M),
+        })
+    else:
+        blocks.update({
+            "w_gate": trunc(ks[6], (L, E, M), E),
+            "w_up": trunc(ks[7], (L, E, M), E),
+            "w_down": trunc(ks[8], (L, M, E), M),
+        })
+    return {
+        "embed": trunc(ks[0], (cfg.vocab_size, E), E),
+        "blocks": blocks,
+        "final_norm": jnp.ones((E,), param_dtype),
+        "lm_head": trunc(ks[9], (E, cfg.vocab_size), E),
+    }
+
+
+def _attend(cfg: LlamaConfig, q, k, v, positions):
+    """q: [B, H, S, D]. Dispatch per configured impl.
+
+    ring/ulysses run as shard_map islands inside the GSPMD forward: the
+    logically-full q/k/v keep their (dp,fsdp)/tp/sp layout, the island
+    rotates K/V (ring) or all-to-alls heads<->seq (ulysses) over the sp
+    axis only.
+    """
+    if cfg.attention_impl in ("ring", "ulysses"):
+        from jax.sharding import PartitionSpec as P
+        from ..ops.ring_attention import ring_attention_sharded
+        from ..ops.ulysses import ulysses_attention_sharded
+        from ..parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_TENSOR
+        spec = P((AXIS_DATA, AXIS_FSDP), AXIS_TENSOR, cfg.seq_axis, None)
+        fn = (ring_attention_sharded if cfg.attention_impl == "ring"
+              else ulysses_attention_sharded)
+        return fn(q, k, v, axis_name=cfg.seq_axis, causal=True, in_spec=spec)
+    if cfg.attention_impl in ("auto", "flash", "flash_interpret",
+                              "reference"):
+        impl = None if cfg.attention_impl == "auto" else cfg.attention_impl
+        return _attention(q, k, v, causal=True, impl=impl)
+    raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
+
+
+def _block(cfg: LlamaConfig, cos, sin, positions, x, layer):
+    """One transformer block. x: [B, S, E]."""
+    dt = cfg.dtype
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bse,ehd->bhsd", h, layer["wq"].astype(dt),
+                   preferred_element_type=dt)
+    k = jnp.einsum("bse,ehd->bhsd", h, layer["wk"].astype(dt),
+                   preferred_element_type=dt)
+    v = jnp.einsum("bse,ehd->bhsd", h, layer["wv"].astype(dt),
+                   preferred_element_type=dt)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    attn = _attend(cfg, q, k, v, positions)
+    attn_out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"].astype(dt),
+                          preferred_element_type=dt)
+    x = x + attn_out
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    if cfg.num_experts:
+        mlp_out, aux = moe_layer(h, layer["router"].astype(dt),
+                                 layer["w_gate"].astype(dt),
+                                 layer["w_up"].astype(dt),
+                                 layer["w_down"].astype(dt),
+                                 k=cfg.moe_top_k)
+    else:
+        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(dt),
+                          preferred_element_type=dt)
+        up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(dt),
+                        preferred_element_type=dt)
+        mlp_out = jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
+                             layer["w_down"].astype(dt),
+                             preferred_element_type=dt)
+        aux = jnp.zeros((), jnp.float32)
+    return x + mlp_out, aux
+
+
+def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
+                     cfg: LlamaConfig,
+                     positions: Optional[jax.Array] = None):
+    """tokens: [B, S] int32 -> (logits [B, S, vocab] f32, moe aux loss).
+
+    ``positions``: absolute positions [S] (defaults to arange; sequence-
+    sharded callers pass their shard's global positions).
+    """
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+
+    block = partial(_block, cfg, cos, sin, positions)
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(x, layer):
+        x, aux = block(x, layer)
+        return x, aux
+
+    x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(dt),
+                        preferred_element_type=jnp.float32)
+    return logits, jnp.sum(auxes)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    return forward_with_aux(params, tokens, cfg, positions)[0]
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            cfg: LlamaConfig,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token cross-entropy.  batch: tokens [B,S], loss_mask [B,S]."""
+    tokens = batch["tokens"]
+    logits, aux = forward_with_aux(params, tokens, cfg, positions)
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is None:
+        mask = jnp.concatenate(
+            [jnp.ones_like(tokens[:, 1:]), jnp.zeros_like(tokens[:, :1])],
+            axis=1)
+    mask = mask.astype(jnp.float32)
+    loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if cfg.num_experts:
+        loss = loss + 0.01 * aux / cfg.layers
+    return loss
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    L, E, H, Hkv, D, M, V = (cfg.layers, cfg.hidden, cfg.heads, cfg.kv_heads,
+                             cfg.head_dim, cfg.mlp_dim, cfg.vocab_size)
+    per_layer = E * H * D + 2 * E * Hkv * D + H * D * E + 2 * E
+    if cfg.num_experts:
+        per_layer += E * cfg.num_experts + 3 * cfg.num_experts * E * M
+    else:
+        per_layer += 3 * E * M
+    return V * E + L * per_layer + E + E * V

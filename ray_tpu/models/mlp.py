@@ -1,0 +1,44 @@
+"""Small MLP model for train-loop tests (the reference's test workloads use
+toy torch models similarly, reference: python/ray/train/examples)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 32
+    hidden: int = 64
+    out_dim: int = 10
+    layers: int = 2
+
+
+def init_mlp(cfg: MLPConfig, key: jax.Array) -> Dict[str, Any]:
+    params = {}
+    dims = [cfg.in_dim] + [cfg.hidden] * (cfg.layers - 1) + [cfg.out_dim]
+    keys = jax.random.split(key, len(dims) - 1)
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f"w{i}"] = jax.random.normal(keys[i], (a, b)) * (a ** -0.5)
+        params[f"b{i}"] = jnp.zeros((b,))
+    return params
+
+
+def mlp_forward(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    n = len(params) // 2
+    for i in range(n):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params: Dict[str, Any], batch: Dict[str, jax.Array]) -> jax.Array:
+    logits = mlp_forward(params, batch["x"])
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(
+        jnp.take_along_axis(logp, batch["y"][:, None], axis=-1))

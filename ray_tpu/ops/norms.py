@@ -1,0 +1,19 @@
+"""Normalization ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """RMSNorm in fp32 accumulation, cast back to input dtype.
+
+    Elementwise chain (square, mean, rsqrt, mul) fuses into neighboring
+    matmuls under XLA; no pallas needed at current sizes.
+    """
+    import jax.lax as lax
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,35 @@
+"""Rotary position embeddings."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float = 10000.0):
+    """Returns (cos, sin) tables of shape [max_seq_len, head_dim//2]."""
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin, positions=None):
+    """Rotate pairs of channels. x: [..., seq, head_dim].
+
+    ``positions`` ([..., seq] int) selects rows of the tables — required when
+    the sequence dim is sharded (ring/Ulysses shards pass absolute positions).
+    """
+    if positions is not None:
+        cos = cos[positions]
+        sin = sin[positions]
+    else:
+        cos = cos[: x.shape[-2]]
+        sin = sin[: x.shape[-2]]
+    # Broadcast tables over leading batch/head dims.
+    while cos.ndim < x.ndim:
+        cos = cos[None]
+        sin = sin[None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,26 @@
+"""Parallelism layer: device meshes, sharding rules, SPMD helpers.
+
+This layer has no direct analog in the reference — Ray delegates model
+parallelism to engines (vLLM TP/PP sizes via placement bundles,
+reference: python/ray/llm/_internal/common/placement.py:47; DDP/FSDP via
+torch inside the train fn, reference:
+python/ray/train/torch/train_loop_utils.py:153).  Here it is first-class:
+a mesh over TPU chips with named axes (dp/fsdp/tp/sp/ep/pp), logical-axis
+sharding rules that map model dimensions onto mesh axes, and helpers that
+turn a plain jax step function into a pjit SPMD program with XLA
+collectives over ICI/DCN.
+"""
+
+from .mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_FSDP, AXIS_PIPELINE,
+                   AXIS_SEQ, AXIS_TENSOR, MeshSpec, build_mesh,
+                   local_mesh_devices)
+from .sharding import (ShardingRules, default_rules, logical_to_pspec,
+                       named_sharding, shard_pytree, constrain)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "local_mesh_devices",
+    "AXIS_DATA", "AXIS_FSDP", "AXIS_TENSOR", "AXIS_SEQ", "AXIS_EXPERT",
+    "AXIS_PIPELINE",
+    "ShardingRules", "default_rules", "logical_to_pspec", "named_sharding",
+    "shard_pytree", "constrain",
+]

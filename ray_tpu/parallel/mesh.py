@@ -1,0 +1,130 @@
+"""Device mesh construction with TPU topology awareness.
+
+The mesh is the scheduling substrate for all SPMD parallelism ("How to Scale
+Your Model" recipe: pick a mesh, annotate shardings, let XLA insert the
+collectives).  Axis order matters physically: the innermost axes map to ICI
+neighbors (fast, torus links) and the outermost axis is the DCN boundary for
+multi-slice jobs — so `dp` goes outermost (gradient allreduce tolerates DCN
+latency via overlap) and `tp`/`sp` innermost (latency-critical collectives
+ride ICI).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_GLOBAL_MESH = None
+
+
+def set_global_mesh(mesh) -> None:
+    """Install the ambient mesh used by ops that need shard_map (ring/
+    ulysses attention inside a GSPMD forward)."""
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_global_mesh():
+    return _GLOBAL_MESH
+
+
+AXIS_DATA = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tp"
+AXIS_SEQ = "sp"
+AXIS_EXPERT = "ep"
+AXIS_PIPELINE = "pp"
+
+# Outer-to-inner physical ordering (DCN-most to ICI-most).
+CANONICAL_ORDER = (AXIS_PIPELINE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT,
+                   AXIS_SEQ, AXIS_TENSOR)
+
+
+@dataclass
+class MeshSpec:
+    """Named mesh-axis sizes.  -1 on one axis means "absorb the rest"."""
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+    # Number of DCN-connected slices; dp must be divisible by it.
+    num_slices: int = 1
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {AXIS_DATA: self.dp, AXIS_FSDP: self.fsdp,
+                AXIS_TENSOR: self.tp, AXIS_SEQ: self.sp,
+                AXIS_EXPERT: self.ep, AXIS_PIPELINE: self.pp}
+
+    def resolved(self, n_devices: int) -> "MeshSpec":
+        sizes = self.axis_sizes()
+        unknown = [a for a, s in sizes.items() if s == -1]
+        if len(unknown) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        known = 1
+        for a, s in sizes.items():
+            if s != -1:
+                known *= s
+        if unknown:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {known}")
+            sizes[unknown[0]] = n_devices // known
+        else:
+            total = known
+            if total != n_devices:
+                raise ValueError(
+                    f"mesh {sizes} needs {total} devices, got {n_devices}")
+        return MeshSpec(dp=sizes[AXIS_DATA], fsdp=sizes[AXIS_FSDP],
+                        tp=sizes[AXIS_TENSOR], sp=sizes[AXIS_SEQ],
+                        ep=sizes[AXIS_EXPERT], pp=sizes[AXIS_PIPELINE],
+                        num_slices=self.num_slices)
+
+    def shape(self) -> Tuple[Tuple[str, int], ...]:
+        sizes = self.axis_sizes()
+        return tuple((a, sizes[a]) for a in CANONICAL_ORDER)
+
+
+def local_mesh_devices(devices=None):
+    import jax
+    return list(devices) if devices is not None else jax.devices()
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build a jax.sharding.Mesh laying axes onto the physical topology.
+
+    Uses mesh_utils.create_device_mesh for ICI-aware placement on real TPU
+    slices, and create_hybrid_device_mesh when num_slices > 1 so the
+    outermost axes span DCN (reference seam: the JaxTrainer's MEGASCALE
+    plumbing, train/v2/jax/config.py:95-103, forms the multi-slice world
+    this mesh then carves up).
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    devices = local_mesh_devices(devices)
+    spec = spec.resolved(len(devices))
+    names = [a for a, _ in spec.shape()]
+    sizes = [s for _, s in spec.shape()]
+
+    if spec.num_slices > 1:
+        if sizes[names.index(AXIS_DATA)] % spec.num_slices:
+            raise ValueError("dp axis must be divisible by num_slices")
+        dcn_shape = [1] * len(sizes)
+        ici_shape = list(sizes)
+        dcn_shape[names.index(AXIS_DATA)] = spec.num_slices
+        ici_shape[names.index(AXIS_DATA)] //= spec.num_slices
+        try:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+            return Mesh(dev_array, axis_names=tuple(names))
+        except (ValueError, AssertionError):
+            pass  # fall through to flat reshape (CPU/test substrate)
+    try:
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    except (ValueError, AssertionError, NotImplementedError):
+        dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=tuple(names))

@@ -1,0 +1,22 @@
+"""ray_tpu.serve — model serving (Ray Serve equivalent).
+
+Reference analog: serve.run/@serve.deployment (reference:
+python/ray/serve/api.py:902,471), controller + deployment reconciliation
+(_private/controller.py:126, deployment_state.py), router with
+power-of-two-choices replica selection (_private/request_router/
+pow_2_router.py), replicas (_private/replica.py), dynamic batching
+(serve/batching.py), HTTP proxy (_private/proxy.py).
+
+TPU angle: replicas are actors that can hold chip reservations
+(``num_tpus`` in deployment options), so a batched-inference deployment
+gets exclusive chips per replica.
+"""
+
+from .api import (Application, Deployment, DeploymentHandle, deployment,
+                  get_deployment_handle, run, shutdown, status)
+from .batching import batch
+
+__all__ = [
+    "deployment", "run", "shutdown", "status", "Deployment", "Application",
+    "DeploymentHandle", "get_deployment_handle", "batch",
+]

@@ -1,0 +1,298 @@
+"""Serve core: deployments, replicas, router, handles, HTTP ingress.
+
+The controller lives in the driver process (reference runs it as an actor,
+_private/controller.py:126 — the single-host round-1 simplification);
+replicas are runtime actors; the router does power-of-two-choices over
+per-replica in-flight counts (reference: pow_2_router.py); the optional
+HTTP proxy is an aiohttp app on a daemon thread (reference: proxy.py
+uvicorn ingress).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+_app_lock = threading.Lock()
+_deployments: Dict[str, "_DeploymentState"] = {}
+_http_server = None
+
+
+@dataclass
+class Deployment:
+    cls_or_fn: Any
+    name: str
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    num_cpus: float = 0.0
+    num_tpus: int = 0
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def options(self, **kw) -> "Deployment":
+        import dataclasses
+        known = {f.name for f in dataclasses.fields(Deployment)}
+        return dataclasses.replace(
+            self, **{k: v for k, v in kw.items() if k in known})
+
+    def bind(self, *args, **kwargs) -> "Application":
+        import dataclasses
+        d = dataclasses.replace(self, init_args=args, init_kwargs=kwargs)
+        return Application(d)
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+
+
+def deployment(_cls=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 8,
+               num_cpus: float = 0.0, num_tpus: int = 0,
+               ray_actor_options: Optional[Dict[str, Any]] = None):
+    """@serve.deployment (reference: serve/api.py:471)."""
+    def wrap(cls):
+        return Deployment(cls, name or cls.__name__,
+                          num_replicas=num_replicas,
+                          max_ongoing_requests=max_ongoing_requests,
+                          num_cpus=num_cpus, num_tpus=num_tpus,
+                          ray_actor_options=ray_actor_options or {})
+    if _cls is not None:
+        return wrap(_cls)
+    return wrap
+
+
+class _ReplicaActor:
+    """Hosts the user callable (reference: replica.py UserCallableWrapper)."""
+
+    def __init__(self, cls_blob: bytes, init_args, init_kwargs):
+        from .._private import serialization
+        target = serialization.loads_control(cls_blob)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            self._callable = target
+
+    def handle_request(self, method: str, args, kwargs):
+        fn = (self._callable if method == "__call__"
+              and not hasattr(self._callable, "__call__.__self__")
+              else None)
+        target = getattr(self._callable, method, None)
+        if target is None and method == "__call__":
+            target = self._callable
+        if target is None:
+            raise AttributeError(f"deployment has no method {method!r}")
+        return target(*args, **kwargs)
+
+    def ping(self):
+        return "ok"
+
+
+class _DeploymentState:
+    def __init__(self, dep: Deployment):
+        self.deployment = dep
+        self.replicas: List[Any] = []
+        self.inflight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+
+    def start(self):
+        import ray_tpu
+        from .._private import serialization
+        cls_blob = serialization.dumps_control(self.deployment.cls_or_fn)
+        actor_cls = ray_tpu.remote(_ReplicaActor)
+        opts: Dict[str, Any] = {
+            "max_concurrency": self.deployment.max_ongoing_requests,
+            "num_cpus": self.deployment.num_cpus,
+        }
+        if self.deployment.num_tpus:
+            opts["num_tpus"] = self.deployment.num_tpus
+        opts.update(self.deployment.ray_actor_options)
+        for i in range(self.deployment.num_replicas):
+            r = actor_cls.options(**opts).remote(
+                cls_blob, self.deployment.init_args,
+                self.deployment.init_kwargs)
+            self.replicas.append(r)
+            self.inflight[i] = 0
+        ray_tpu.get([r.ping.remote() for r in self.replicas], timeout=120)
+
+    def pick_replica(self) -> int:
+        """Power-of-two-choices on in-flight counts (reference:
+        pow_2_router.py)."""
+        with self._lock:
+            n = len(self.replicas)
+            if n == 1:
+                return 0
+            a, b = random.sample(range(n), 2)
+            return a if self.inflight[a] <= self.inflight[b] else b
+
+    def stop(self):
+        import ray_tpu
+        for r in self.replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.replicas = []
+
+
+class DeploymentHandle:
+    """reference: serve/handle.py:1041 — .remote() routes a request."""
+
+    def __init__(self, name: str, method: str = "__call__"):
+        self._name = name
+        self._method = method
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._name, method_name)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return DeploymentHandle(self._name, item)
+
+    def remote(self, *args, **kwargs):
+        with _app_lock:
+            state = _deployments.get(self._name)
+        if state is None:
+            raise ValueError(f"no deployment named {self._name!r}")
+        idx = state.pick_replica()
+        with state._lock:
+            state.inflight[idx] += 1
+        replica = state.replicas[idx]
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+
+        def _done():
+            with state._lock:
+                state.inflight[idx] = max(0, state.inflight[idx] - 1)
+        # Decrement when the result materializes.
+        threading.Thread(target=lambda: (_wait_quiet(ref), _done()),
+                         daemon=True).start()
+        return ref
+
+
+def _wait_quiet(ref):
+    import ray_tpu
+    try:
+        ray_tpu.wait([ref], num_returns=1, timeout=3600)
+    except Exception:
+        pass
+
+
+def run(app: Application, *, name: Optional[str] = None,
+        route_prefix: Optional[str] = None,
+        http_port: Optional[int] = None) -> DeploymentHandle:
+    """Deploy and return a handle (reference: serve/api.py:902)."""
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    dep = app.deployment if isinstance(app, Application) else app
+    with _app_lock:
+        old = _deployments.get(dep.name)
+        if old is not None:
+            old.stop()
+        state = _DeploymentState(dep)
+        _deployments[dep.name] = state
+    state.start()
+    if http_port is not None:
+        _ensure_http(http_port)
+    return DeploymentHandle(dep.name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    with _app_lock:
+        if name not in _deployments:
+            raise ValueError(f"no deployment named {name!r}")
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Dict[str, Any]]:
+    with _app_lock:
+        return {name: {
+            "num_replicas": len(s.replicas),
+            "inflight": dict(s.inflight),
+        } for name, s in _deployments.items()}
+
+
+def shutdown() -> None:
+    global _http_server
+    with _app_lock:
+        for s in _deployments.values():
+            s.stop()
+        _deployments.clear()
+    if _http_server is not None:
+        _http_server.stop()
+        _http_server = None
+
+
+# --------------------------------------------------------------------- #
+# HTTP ingress (reference: _private/proxy.py; aiohttp instead of uvicorn)
+# --------------------------------------------------------------------- #
+
+class _HttpServer:
+    def __init__(self, port: int):
+        self.port = port
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._started = threading.Event()
+        self._runner = None
+        self._loop = None
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("serve http ingress failed to start")
+
+    def _serve(self):
+        import asyncio
+
+        from aiohttp import web
+
+        async def handle(request: "web.Request"):
+            name = request.match_info["deployment"]
+            try:
+                body = await request.json()
+            except Exception:
+                body = {}
+            try:
+                handle_ = get_deployment_handle(name)
+                ref = handle_.remote(body)
+                import ray_tpu
+                result = await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: ray_tpu.get(ref, timeout=300))
+                return web.json_response({"result": result})
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": repr(e)}, status=500)
+
+        async def main():
+            app = web.Application()
+            app.router.add_post("/{deployment}", handle)
+            app.router.add_get("/-/healthz",
+                               lambda r: web.Response(text="ok"))
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", self.port)
+            await site.start()
+            self._runner = runner
+            self._started.set()
+            while True:
+                await asyncio.sleep(3600)
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(main())
+        except Exception:
+            pass
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+def _ensure_http(port: int) -> None:
+    global _http_server
+    if _http_server is None:
+        _http_server = _HttpServer(port)

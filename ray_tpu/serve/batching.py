@@ -1,0 +1,105 @@
+"""Dynamic request batching (reference: python/ray/serve/batching.py
+@serve.batch — accumulate calls until max_batch_size or timeout, run the
+wrapped method once on the list, scatter results)."""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, List
+
+
+class _Batcher:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._items: List[Any] = []
+        self._events: List[threading.Event] = []
+        self._results: List[Any] = []
+        self._flush_timer: threading.Timer = None  # type: ignore
+
+    def submit(self, instance, item):
+        ev = threading.Event()
+        with self._lock:
+            self._items.append(item)
+            self._events.append(ev)
+            idx = len(self._items) - 1
+            if len(self._items) >= self.max_batch_size:
+                batch, events = self._take()
+                self._run(instance, batch, events)
+            elif self._flush_timer is None:
+                t = threading.Timer(
+                    self.timeout, self._flush_due, args=(instance,))
+                t.daemon = True
+                self._flush_timer = t
+                t.start()
+        ev.wait()
+        return ev.result  # type: ignore[attr-defined]
+
+    def _take(self):
+        batch, self._items = self._items, []
+        events, self._events = self._events, []
+        if self._flush_timer is not None:
+            self._flush_timer.cancel()
+            self._flush_timer = None
+        return batch, events
+
+    def _flush_due(self, instance):
+        with self._lock:
+            if not self._items:
+                self._flush_timer = None
+                return
+            batch, events = self._take()
+        self._run_outside(instance, batch, events)
+
+    def _run(self, instance, batch, events):
+        # Called with lock held for the size-trigger path; do the work
+        # outside the lock.
+        threading.Thread(target=self._run_outside,
+                         args=(instance, batch, events), daemon=True).start()
+
+    def _run_outside(self, instance, batch, events):
+        try:
+            outs = (self.fn(instance, batch) if instance is not None
+                    else self.fn(batch))
+            if len(outs) != len(batch):
+                raise ValueError(
+                    f"batched fn returned {len(outs)} results for "
+                    f"{len(batch)} inputs")
+        except Exception as e:  # noqa: BLE001
+            outs = [e] * len(batch)
+        for ev, out in zip(events, outs):
+            ev.result = out  # type: ignore[attr-defined]
+            ev.set()
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: methods receive List[item] instead of item.
+
+    The batcher (which holds locks/timers) is created lazily per replica
+    process so decorated classes stay picklable.
+    """
+    def wrap(fn):
+        attr = f"_ray_tpu_batcher_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def method(self, item):
+            batcher = getattr(self, attr, None)
+            if batcher is None:
+                batcher = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
+                try:
+                    setattr(self, attr, batcher)
+                except AttributeError:
+                    pass
+            out = batcher.submit(self, item)
+            if isinstance(out, Exception):
+                raise out
+            return out
+        return method
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,13 @@
+"""ray_tpu.train — SPMD training orchestration (Ray Train v2 equivalent)."""
+
+from ._checkpoint import (Checkpoint, CheckpointManager, load_pytree,
+                          save_pytree)
+from ._context import TrainContext, get_context, report
+from .trainer import (CheckpointConfig, FailureConfig, JaxTrainer, Result,
+                      RunConfig, ScalingConfig)
+
+__all__ = [
+    "JaxTrainer", "ScalingConfig", "RunConfig", "FailureConfig",
+    "CheckpointConfig", "Result", "Checkpoint", "CheckpointManager",
+    "get_context", "report", "TrainContext", "save_pytree", "load_pytree",
+]

@@ -1,0 +1,89 @@
+"""Per-worker training context + report API.
+
+Reference analog: ray.train.get_context()/report
+(reference: python/ray/train/v2/api/train_fn_utils.py:23 report,
+.../execution/context.py).  report() publishes metrics (and optionally a
+checkpoint) to the controller through the runtime KV store; the rank-0
+checkpoint is committed by the CheckpointManager.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+from ._checkpoint import Checkpoint
+
+_context: Optional["TrainContext"] = None
+
+
+class TrainContext:
+    def __init__(self, run_id: str, rank: int, world_size: int,
+                 local_rank: int, storage_path: str,
+                 experiment_name: str,
+                 latest_checkpoint: Optional[str] = None,
+                 slice_id: int = 0, num_slices: int = 1):
+        self.run_id = run_id
+        self._rank = rank
+        self._world_size = world_size
+        self._local_rank = local_rank
+        self.storage_path = storage_path
+        self.experiment_name = experiment_name
+        self._latest_checkpoint = latest_checkpoint
+        self.slice_id = slice_id
+        self.num_slices = num_slices
+        self._report_seq = 0
+        # Unique per worker incarnation: keeps report keys distinct across
+        # failure-recovery restarts (seq restarts at 0 in a fresh worker).
+        import uuid as _uuid
+        self._incarnation = _uuid.uuid4().hex[:8]
+
+    def get_world_rank(self) -> int:
+        return self._rank
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def get_local_rank(self) -> int:
+        return self._local_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        if self._latest_checkpoint and os.path.exists(self._latest_checkpoint):
+            return Checkpoint(self._latest_checkpoint)
+        return None
+
+
+def set_context(ctx: Optional[TrainContext]) -> None:
+    global _context
+    _context = ctx
+
+
+def get_context() -> TrainContext:
+    if _context is None:
+        raise RuntimeError(
+            "ray_tpu.train.get_context() called outside a train worker")
+    return _context
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (+ checkpoint) from inside the train fn."""
+    ctx = get_context()
+    ctx._report_seq += 1
+    from .._private.api import _control
+    payload = {
+        "metrics": dict(metrics),
+        "rank": ctx.get_world_rank(),
+        "seq": ctx._report_seq,
+        "time": time.time(),
+        "checkpoint_dir": checkpoint.path if checkpoint else None,
+    }
+    _control("kv_put",
+             f"train/{ctx.run_id}/report/{ctx.get_world_rank()}/"
+             f"{ctx._incarnation}/{ctx._report_seq}",
+             pickle.dumps(payload))

@@ -1,0 +1,97 @@
+"""JaxTrainer: the user-facing Train API.
+
+Reference analog: DataParallelTrainer/JaxTrainer (reference:
+python/ray/train/v2/api/data_parallel_trainer.py:159 fit,
+python/ray/train/v2/jax/jax_trainer.py:20) with configs modeled on
+ScalingConfig/RunConfig (reference: python/ray/air/config.py, re-exported by
+train v2 with use_tpu/topology/num_slices fields,
+python/ray/train/v2/api/config.py).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ._checkpoint import Checkpoint
+from .controller import TrainController
+
+
+@dataclass
+class FailureConfig:
+    """reference: train/v2/_internal/execution/failure_handling."""
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "min"
+
+
+@dataclass
+class ScalingConfig:
+    """reference: air/config.py ScalingConfig + TPU fields of
+    train/v2/api/config.py (use_tpu, topology, num_slices)."""
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    topology: Optional[str] = None
+    num_slices: int = 1
+    resources_per_worker: Optional[Dict[str, float]] = None
+    env_per_worker: Optional[Dict[str, str]] = None
+    # Form a jax.distributed world even for num_workers == 1.
+    force_distributed: bool = False
+
+
+@dataclass
+class RunConfig:
+    name: str = "ray_tpu_experiment"
+    storage_path: str = ""
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+
+    def __post_init__(self):
+        if not self.storage_path:
+            self.storage_path = os.path.join(
+                tempfile.gettempdir(), "ray_tpu_results")
+
+
+@dataclass
+class Result:
+    """reference: python/ray/air/result.py."""
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    error: Optional[Exception] = None
+    all_reports: List[Dict[str, Any]] = field(default_factory=list)
+    num_failures: int = 0
+
+
+class JaxTrainer:
+    """SPMD data-parallel trainer over a gang-scheduled worker group.
+
+    ``train_loop_per_worker`` runs once per worker with the jax.distributed
+    world already formed; inside it, use ``ray_tpu.train.get_context()``
+    and ``ray_tpu.train.report(...)``.
+    """
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._train_fn = train_loop_per_worker
+        self._config = train_loop_config
+        self._scaling = scaling_config or ScalingConfig()
+        self._run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        controller = TrainController(
+            self._train_fn, self._config, self._scaling, self._run_config)
+        return controller.run()

@@ -1,0 +1,20 @@
+"""ray_tpu.tune — hyperparameter search (Ray Tune equivalent).
+
+Reference analog: Tuner.fit (reference: python/ray/tune/tuner.py:43,319) ->
+TuneController (tune/execution/tune_controller.py:68) managing Trainable
+actors; search spaces (tune/search/), schedulers (tune/schedulers/ — ASHA,
+median-stopping).  Here trials are runtime tasks; intermediate reports and
+early-stop signals flow through the KV store.
+"""
+
+from .search import choice, grid_search, loguniform, randint, uniform
+from .tuner import (ResultGrid, TrialResult, TuneConfig, Tuner, report,
+                    TuneStopException)
+from .schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
+    "TuneStopException",
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
+]

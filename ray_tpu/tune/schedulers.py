@@ -1,0 +1,89 @@
+"""Trial schedulers: FIFO, ASHA, median-stopping.
+
+Reference analog: python/ray/tune/schedulers/ (async_hyperband.py
+ASHAScheduler, median_stopping_rule.py).  The controller calls
+``on_result(trial_id, step, value)`` for every intermediate report; CONTINUE
+or STOP comes back.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving (reference: async_hyperband.py).
+
+    Rungs at grace_period * reduction_factor**k; a trial reaching a rung
+    stops unless its metric is in the top 1/reduction_factor of completed
+    rung entries.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 1, reduction_factor: int = 3,
+                 max_t: int = 100):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self._rungs: Dict[int, List[float]] = collections.defaultdict(list)
+
+    def _rung_levels(self) -> List[int]:
+        levels = []
+        t = self.grace
+        while t < self.max_t:
+            levels.append(t)
+            t *= self.rf
+        return levels
+
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        if self.mode == "max":
+            value = -value
+        for rung in self._rung_levels():
+            if step == rung:
+                peers = self._rungs[rung]
+                peers.append(value)
+                k = max(1, len(peers) // self.rf)
+                cutoff = sorted(peers)[k - 1]
+                if value > cutoff:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-best is worse than the median of other
+    trials' running means (reference: median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._history: Dict[str, List[float]] = collections.defaultdict(list)
+
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        if self.mode == "max":
+            value = -value
+        self._history[trial_id].append(value)
+        if step < self.grace:
+            return CONTINUE
+        others = [sum(v) / len(v) for t, v in self._history.items()
+                  if t != trial_id and v]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others_sorted = sorted(others)
+        median = others_sorted[len(others_sorted) // 2]
+        best = min(self._history[trial_id])
+        return STOP if best > median else CONTINUE

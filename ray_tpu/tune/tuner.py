@@ -1,0 +1,200 @@
+"""Tuner + trial execution.
+
+Reference analog: python/ray/tune/tuner.py:43 Tuner / tuner.fit:319 ->
+TuneController (tune/execution/tune_controller.py:68).  Trials run as
+runtime tasks with bounded concurrency; ``tune.report`` inside a trial
+publishes intermediate metrics through the KV store and polls its stop
+flag, so schedulers (ASHA/median) can kill laggards mid-flight.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .schedulers import CONTINUE, STOP, FIFOScheduler
+from .search import generate_variants
+
+
+class TuneStopException(Exception):
+    """Raised inside a trial when the scheduler stops it early."""
+
+
+_trial_ctx: Optional[Dict[str, Any]] = None
+
+
+def report(metrics: Dict[str, Any]) -> None:
+    """Report intermediate metrics from inside a trial; raises
+    TuneStopException when the scheduler has stopped this trial."""
+    if _trial_ctx is None:
+        raise RuntimeError("tune.report() called outside a tune trial")
+    from .._private.api import _control
+    _trial_ctx["seq"] += 1
+    _control("kv_put",
+             f"tune/{_trial_ctx['run_id']}/report/{_trial_ctx['trial_id']}/"
+             f"{_trial_ctx['seq']}",
+             pickle.dumps({"metrics": dict(metrics),
+                           "seq": _trial_ctx["seq"],
+                           "time": time.time()}))
+    stop = _control(
+        "kv_get", f"tune/{_trial_ctx['run_id']}/stop/"
+                  f"{_trial_ctx['trial_id']}")
+    if stop is not None:
+        raise TuneStopException()
+
+
+def _run_trial(fn_blob: bytes, config: Dict[str, Any], run_id: str,
+               trial_id: str):
+    global _trial_ctx
+    from .._private import serialization
+    fn = serialization.loads_control(fn_blob)
+    _trial_ctx = {"run_id": run_id, "trial_id": trial_id, "seq": 0}
+    try:
+        out = fn(config)
+        return {"final": out if isinstance(out, dict) else {},
+                "stopped": False}
+    except TuneStopException:
+        return {"final": {}, "stopped": True}
+    finally:
+        _trial_ctx = None
+
+
+@dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: int = 0
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    error: Optional[str] = None
+    stopped_early: bool = False
+    history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: str, mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __len__(self):
+        return len(self._results)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        pick = min if mode == "min" else max
+        return pick(scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for r in self._results:
+            row = {"trial_id": r.trial_id, **{f"config/{k}": v
+                                              for k, v in r.config.items()}}
+            row.update(r.metrics)
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    """reference: tune/tuner.py:43 — trainable is a function taking a
+    config dict (function-trainable API)."""
+
+    def __init__(self, trainable: Callable,
+                 *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._cfg = tune_config or TuneConfig()
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+        from .._private import serialization
+        from .._private.api import _control
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        run_id = uuid.uuid4().hex[:12]
+        scheduler = self._cfg.scheduler or FIFOScheduler()
+        variants = generate_variants(self._param_space,
+                                     self._cfg.num_samples, self._cfg.seed)
+        fn_blob = serialization.dumps_control(self._trainable)
+        run_remote = ray_tpu.remote(_run_trial)
+
+        trials: Dict[str, Dict[str, Any]] = {}
+        queue = []
+        for cfg in variants:
+            tid = uuid.uuid4().hex[:8]
+            trials[tid] = {"config": cfg, "ref": None, "history": [],
+                           "seen": set()}
+            queue.append(tid)
+
+        in_flight: Dict[Any, str] = {}
+        results: List[TrialResult] = []
+
+        def poll_reports():
+            for key in _control("kv_keys", f"tune/{run_id}/report/"):
+                parts = key.split("/")
+                tid, seq = parts[-2], int(parts[-1])
+                t = trials.get(tid)
+                if t is None or seq in t["seen"]:
+                    continue
+                t["seen"].add(seq)
+                payload = pickle.loads(_control("kv_get", key))
+                t["history"].append(payload["metrics"])
+                metric_val = payload["metrics"].get(self._cfg.metric)
+                if metric_val is not None:
+                    decision = scheduler.on_result(tid, seq,
+                                                   float(metric_val))
+                    if decision == STOP:
+                        _control("kv_put", f"tune/{run_id}/stop/{tid}",
+                                 b"1")
+
+        while queue or in_flight:
+            while queue and len(in_flight) < self._cfg.max_concurrent_trials:
+                tid = queue.pop(0)
+                ref = run_remote.options(
+                    name=f"trial-{tid}").remote(
+                        fn_blob, trials[tid]["config"], run_id, tid)
+                trials[tid]["ref"] = ref
+                in_flight[ref] = tid
+            done, _ = ray_tpu.wait(list(in_flight.keys()), num_returns=1,
+                                   timeout=0.2)
+            poll_reports()
+            for ref in done:
+                tid = in_flight.pop(ref)
+                t = trials[tid]
+                error = None
+                stopped = False
+                final: Dict[str, Any] = {}
+                try:
+                    out = ray_tpu.get(ref)
+                    final = out["final"]
+                    stopped = out["stopped"]
+                except Exception as e:  # noqa: BLE001
+                    error = repr(e)
+                last = t["history"][-1] if t["history"] else {}
+                metrics = {**last, **final}
+                results.append(TrialResult(tid, t["config"], metrics,
+                                           error, stopped, t["history"]))
+        poll_reports()
+        return ResultGrid(results, self._cfg.metric, self._cfg.mode)

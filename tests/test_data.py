@@ -1,0 +1,113 @@
+"""Data library tests (reference pattern: python/ray/data/tests — local
+ray.init + operator unit tests)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data import (BlockAccessor, Dataset, from_items, from_numpy,
+                          range as ds_range)
+
+
+class TestDatasetBasics:
+    def test_range_count(self, ray_start):
+        assert ds_range(100, parallelism=4).count() == 100
+
+    def test_from_items_take(self, ray_start):
+        ds = from_items([{"a": i} for i in range(10)], parallelism=3)
+        assert [r["a"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
+
+    def test_map_batches(self, ray_start):
+        def double(batch):
+            return {"id": batch["id"] * 2}
+        out = ds_range(10, parallelism=2).map_batches(double).take_all()
+        assert sorted(r["id"] for r in out) == [2 * i for i in range(10)]
+
+    def test_map_and_filter(self, ray_start):
+        ds = (ds_range(20, parallelism=2)
+              .map(lambda r: {"id": r["id"], "sq": int(r["id"]) ** 2})
+              .filter(lambda r: r["sq"] % 2 == 0))
+        rows = ds.take_all()
+        assert all(r["sq"] == r["id"] ** 2 for r in rows)
+        assert all(r["sq"] % 2 == 0 for r in rows)
+
+    def test_fused_stage_chain(self, ray_start):
+        ds = (ds_range(12, parallelism=3)
+              .map_batches(lambda b: {"id": b["id"] + 1})
+              .map_batches(lambda b: {"id": b["id"] * 10}))
+        assert sorted(r["id"] for r in ds.take_all()) == \
+            [10 * (i + 1) for i in range(12)]
+
+    def test_flat_map(self, ray_start):
+        ds = from_items([1, 2], parallelism=1).flat_map(
+            lambda r: [{"v": r["item"]}, {"v": r["item"] * 100}])
+        assert sorted(r["v"] for r in ds.take_all()) == [1, 2, 100, 200]
+
+    def test_repartition_and_shuffle(self, ray_start):
+        ds = ds_range(100, parallelism=2).repartition(5).materialize()
+        assert ds.num_blocks() == 5
+        shuffled = ds_range(100, parallelism=2).random_shuffle(seed=0)
+        ids = [r["id"] for r in shuffled.take_all()]
+        assert sorted(ids) == list(range(100))
+        assert ids != list(range(100))
+
+    def test_schema(self, ray_start):
+        s = from_numpy({"x": np.zeros((5, 3), np.float32)}).schema()
+        assert s["x"] == "float32"
+
+    def test_split(self, ray_start):
+        shards = ds_range(90, parallelism=4).split(3)
+        counts = [s.count() for s in shards]
+        assert counts == [30, 30, 30]
+        all_ids = sorted(r["id"] for s in shards for r in s.take_all())
+        assert all_ids == list(range(90))
+
+
+class TestIterBatches:
+    def test_exact_batches(self, ray_start):
+        batches = list(ds_range(64, parallelism=4).iter_batches(
+            batch_size=16))
+        assert len(batches) == 4
+        assert all(len(b["id"]) == 16 for b in batches)
+
+    def test_remainder(self, ray_start):
+        batches = list(ds_range(70, parallelism=4).iter_batches(
+            batch_size=16))
+        assert sum(len(b["id"]) for b in batches) == 70
+        batches = list(ds_range(70, parallelism=4).iter_batches(
+            batch_size=16, drop_last=True))
+        assert all(len(b["id"]) == 16 for b in batches)
+
+    def test_device_put_iterator(self, ray_start):
+        import jax
+        from ray_tpu.data import device_put_iterator
+        it = ds_range(32, parallelism=2).iter_batches(batch_size=16)
+        dev_batches = list(device_put_iterator(it))
+        assert len(dev_batches) == 2
+        assert all(isinstance(b["id"], jax.Array) for b in dev_batches)
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, ray_start):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        with tempfile.TemporaryDirectory() as tmp:
+            for i in range(3):
+                pq.write_table(
+                    pa.table({"x": list(np.arange(i * 10, (i + 1) * 10))}),
+                    os.path.join(tmp, f"part{i}.parquet"))
+            ds = Dataset.read_parquet(os.path.join(tmp, "*.parquet"))
+            assert ds.count() == 30
+            out = ds.map_batches(lambda b: {"x": b["x"] * 2}).take_all()
+            assert sorted(r["x"] for r in out) == [2 * i for i in range(30)]
+
+    def test_csv(self, ray_start):
+        with tempfile.TemporaryDirectory() as tmp:
+            p = os.path.join(tmp, "t.csv")
+            with open(p, "w") as f:
+                f.write("a,b\n1,x\n2,y\n")
+            rows = Dataset.read_csv(p).take_all()
+            assert [int(r["a"]) for r in rows] == [1, 2]

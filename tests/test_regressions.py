@@ -65,3 +65,25 @@ class TestRegressions:
         info = ray_tpu._private.runtime.driver_runtime().controller
         dead = [a for a in info.actors.values() if a.class_name == "Broken"]
         assert dead and "the-secret-reason" in (dead[0].death_cause or "")
+
+
+class TestChipLifecycle:
+    def test_chip_env_and_pool_recovery(self, ray_start_isolated):
+        """Sequential TPU tasks each get a full fresh grant; chips return
+        to the pool only after the dedicated worker dies."""
+        import ray_tpu as rt
+
+        @rt.remote(num_tpus=2, num_cpus=0)
+        def chips():
+            import os
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+        # ray_start_isolated has no TPU resource; make a fresh runtime.
+        rt.shutdown()
+        rt.init(num_cpus=4, num_tpus=4)
+        g1 = rt.get(chips.remote(), timeout=120)
+        g2 = rt.get(chips.remote(), timeout=120)
+        g3 = rt.get(chips.remote(), timeout=120)
+        for g in (g1, g2, g3):
+            assert g is not None and len(g.split(",")) == 2, g
+        # Three sequential 2-chip grants out of 4 chips only work if the
+        # dispatch retry waits for dying workers to free their chips.

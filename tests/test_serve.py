@@ -1,0 +1,94 @@
+"""Serve tests (reference pattern: python/ray/serve/tests)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=2)
+class Doubler:
+    def __call__(self, payload):
+        if isinstance(payload, dict):
+            return {"doubled": payload.get("x", 0) * 2}
+        return payload * 2
+
+    def describe(self):
+        import os
+        return os.getpid()
+
+
+class TestServeCore:
+    def test_deploy_and_call(self, ray_start):
+        handle = serve.run(Doubler.bind())
+        out = ray_tpu.get(handle.remote(21), timeout=60)
+        assert out == 42
+        serve.shutdown()
+
+    def test_two_replicas_distinct_processes(self, ray_start):
+        handle = serve.run(Doubler.bind())
+        pids = set()
+        for _ in range(20):
+            pids.add(ray_tpu.get(handle.describe.remote(), timeout=60))
+        assert len(pids) == 2
+        serve.shutdown()
+
+    def test_function_deployment(self, ray_start):
+        @serve.deployment
+        def greeter(payload):
+            return f"hello {payload}"
+        handle = serve.run(greeter.bind())
+        assert ray_tpu.get(handle.remote("tpu"), timeout=60) == "hello tpu"
+        serve.shutdown()
+
+    def test_redeploy_replaces(self, ray_start):
+        h1 = serve.run(Doubler.bind())
+        ray_tpu.get(h1.remote(1), timeout=60)
+        h2 = serve.run(Doubler.options(num_replicas=1).bind())
+        assert ray_tpu.get(h2.remote(2), timeout=60) == 4
+        assert serve.status()["Doubler"]["num_replicas"] == 1
+        serve.shutdown()
+
+    def test_init_args(self, ray_start):
+        @serve.deployment
+        class Scaler:
+            def __init__(self, k):
+                self.k = k
+
+            def __call__(self, payload):
+                return payload * self.k
+        handle = serve.run(Scaler.bind(10))
+        assert ray_tpu.get(handle.remote(4), timeout=60) == 40
+        serve.shutdown()
+
+    def test_http_ingress(self, ray_start):
+        import json
+        import urllib.request
+        handle = serve.run(Doubler.bind(), http_port=18123)
+        req = urllib.request.Request(
+            "http://127.0.0.1:18123/Doubler",
+            data=json.dumps({"x": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = json.loads(resp.read())
+        assert body["result"] == {"doubled": 10}
+        serve.shutdown()
+
+
+class TestBatching:
+    def test_batch_accumulates(self, ray_start):
+        @serve.deployment
+        class BatchAdder:
+            @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+            def __call__(self, items):
+                # Whole batch processed at once.
+                return [i + 100 for i in items]
+
+        handle = serve.run(BatchAdder.bind())
+        refs = [handle.remote(i) for i in range(8)]
+        out = sorted(ray_tpu.get(refs, timeout=60))
+        assert out == [100 + i for i in range(8)]
+        serve.shutdown()

@@ -1,0 +1,91 @@
+"""Tune tests (reference pattern: python/ray/tune/tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.tune import (ASHAScheduler, MedianStoppingRule, TuneConfig,
+                          Tuner, choice, grid_search, loguniform, uniform)
+
+
+def _quadratic(config):
+    import ray_tpu.tune as tune
+    x = config["x"]
+    for step in range(1, 6):
+        loss = (x - 3.0) ** 2 + 1.0 / step
+        tune.report({"loss": loss, "step": step})
+    return {"loss": (x - 3.0) ** 2, "x": x}
+
+
+class TestTuner:
+    def test_grid_search(self, ray_start):
+        grid = Tuner(
+            _quadratic,
+            param_space={"x": grid_search([0.0, 1.0, 3.0, 5.0])},
+            tune_config=TuneConfig(metric="loss", mode="min",
+                                   max_concurrent_trials=2)).fit()
+        assert len(grid) == 4
+        best = grid.get_best_result()
+        assert best.config["x"] == 3.0
+        assert best.metrics["loss"] == 0.0
+
+    def test_random_sampling(self, ray_start):
+        grid = Tuner(
+            _quadratic,
+            param_space={"x": uniform(0, 6)},
+            tune_config=TuneConfig(num_samples=5)).fit()
+        assert len(grid) == 5
+        xs = [r.config["x"] for r in grid]
+        assert len(set(xs)) == 5  # distinct draws
+
+    def test_variant_expansion(self):
+        from ray_tpu.tune.search import generate_variants
+        vs = generate_variants(
+            {"a": grid_search([1, 2]), "b": grid_search(["x", "y"]),
+             "c": 7}, num_samples=1)
+        assert len(vs) == 4
+        assert all(v["c"] == 7 for v in vs)
+
+    def test_asha_stops_bad_trials(self, ray_start):
+        def slow_trial(config):
+            import time
+            import ray_tpu.tune as tune
+            for step in range(1, 10):
+                tune.report({"loss": config["base"] + step * 0.0,
+                             "step": step})
+                time.sleep(0.05)
+            return {"loss": config["base"]}
+
+        sched = ASHAScheduler(metric="loss", mode="min", grace_period=2,
+                              reduction_factor=2, max_t=10)
+        grid = Tuner(
+            slow_trial,
+            param_space={"base": grid_search([0.0, 1.0, 2.0, 3.0])},
+            tune_config=TuneConfig(metric="loss", mode="min",
+                                   scheduler=sched,
+                                   max_concurrent_trials=4)).fit()
+        stopped = [r for r in grid if r.stopped_early]
+        assert len(stopped) >= 1
+        best = grid.get_best_result()
+        assert best.config["base"] == 0.0
+
+    def test_errored_trial_recorded(self, ray_start):
+        def sometimes_fails(config):
+            if config["x"] == 1:
+                raise RuntimeError("bad trial")
+            return {"loss": config["x"]}
+        grid = Tuner(sometimes_fails,
+                     param_space={"x": grid_search([0, 1, 2])}).fit()
+        errs = [r for r in grid if r.error]
+        assert len(errs) == 1
+        assert grid.get_best_result().config["x"] == 0
+
+    def test_schedulers_unit(self):
+        s = ASHAScheduler(grace_period=1, reduction_factor=2, max_t=8)
+        # Two trials reach rung 1; the worse one stops.
+        assert s.on_result("a", 1, 0.1) == "CONTINUE"
+        assert s.on_result("b", 1, 0.9) == "STOP"
+        m = MedianStoppingRule(grace_period=1, min_samples_required=2)
+        m.on_result("a", 1, 0.1)
+        m.on_result("b", 1, 0.2)
+        assert m.on_result("c", 2, 5.0) == "STOP"
