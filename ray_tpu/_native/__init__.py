@@ -1,0 +1,91 @@
+"""Native (C++) runtime components, built on demand and loaded via ctypes.
+
+The reference ships its runtime hot paths as C++ (plasma store, raylet,
+GCS — see SURVEY.md §2.1); here the native pieces are compiled from the
+sources in this directory with the system toolchain the first time they are
+needed and cached by content hash, so a source edit transparently rebuilds.
+Loading is best-effort: when no C++ toolchain is available the callers fall
+back to pure-Python implementations (same behavior, slower path).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_lock = threading.Lock()
+_cache = {}
+
+
+def _build(source: str, libname: str, extra_flags=()) -> Optional[str]:
+    src_path = os.path.join(_HERE, source)
+    with open(src_path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    out_path = os.path.join(_BUILD_DIR, f"{libname}-{digest}.so")
+    if os.path.exists(out_path):
+        return out_path
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp_path = out_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src_path,
+           "-o", tmp_path, "-lrt", "-pthread", *extra_flags]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(tmp_path, out_path)  # atomic: concurrent builders race safely
+    return out_path
+
+
+def load_library(source: str, libname: str) -> Optional[ctypes.CDLL]:
+    """Build (if needed) and dlopen a native component; None if unavailable."""
+    with _lock:
+        if libname in _cache:
+            return _cache[libname]
+        lib = None
+        try:
+            path = _build(source, libname)
+            if path is not None:
+                lib = ctypes.CDLL(path)
+        except OSError:
+            lib = None
+        _cache[libname] = lib
+        return lib
+
+
+def load_store_library() -> Optional[ctypes.CDLL]:
+    lib = load_library("store.cc", "ray_tpu_store")
+    if lib is None:
+        return None
+    if not hasattr(lib, "_rts_configured"):
+        c = ctypes
+        lib.rts_create.restype = c.c_void_p
+        lib.rts_create.argtypes = [c.c_char_p, c.c_uint64, c.c_char_p]
+        lib.rts_segment_name.restype = c.c_char_p
+        lib.rts_segment_name.argtypes = [c.c_void_p]
+        lib.rts_allocate.restype = c.c_int64
+        lib.rts_allocate.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32,
+                                     c.c_uint64]
+        lib.rts_seal.restype = c.c_int
+        lib.rts_seal.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
+        lib.rts_lookup_pin.restype = c.c_int
+        lib.rts_lookup_pin.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32,
+                                       c.c_int, c.POINTER(c.c_uint64),
+                                       c.POINTER(c.c_uint64)]
+        lib.rts_unpin.restype = c.c_int
+        lib.rts_unpin.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
+        lib.rts_contains.restype = c.c_int
+        lib.rts_contains.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
+        lib.rts_delete.restype = c.c_int
+        lib.rts_delete.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
+        lib.rts_stats.restype = None
+        lib.rts_stats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64 * 8)]
+        lib.rts_destroy.restype = None
+        lib.rts_destroy.argtypes = [c.c_void_p]
+        lib._rts_configured = True
+    return lib

@@ -1,0 +1,398 @@
+// Native shared-memory object store (plasma equivalent).
+//
+// Reference analog: src/ray/object_manager/plasma/ — PlasmaStore (store.h:55),
+// dlmalloc shm arena (dlmalloc.cc), LRU eviction (eviction_policy.cc), and the
+// raylet's spill/restore path (src/ray/raylet/local_object_manager.h:46).
+//
+// Design (TPU-native): one POSIX shm arena per node process, managed by a
+// best-fit free-list allocator with offset coalescing.  Objects are immutable
+// once sealed; any process on the host maps the arena by name and reads a
+// sealed object zero-copy at its offset.  Readers are protected by plasma
+// style client pinning: the owner pins an object while a descriptor to it is
+// outstanding, and pinned objects are never evicted, so offsets handed out
+// stay valid.  Under memory pressure, sealed unpinned objects spill to disk
+// in LRU order and restore on demand (possibly at a new offset — which is why
+// descriptors are always refreshed through lookup_pin at hand-out time).
+//
+// The store index and allocator metadata live in the owner process only; the
+// arena itself is the shared medium.  Exposed as a C ABI for ctypes.
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <list>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kAlign = 64;  // cache-line alignment for payload starts
+
+inline uint64_t align_up(uint64_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+using Key = std::string;  // raw object-id bytes
+
+std::string hex(const Key &k) {
+  static const char *digits = "0123456789abcdef";
+  std::string out;
+  out.reserve(k.size() * 2);
+  for (unsigned char c : k) {
+    out.push_back(digits[c >> 4]);
+    out.push_back(digits[c & 0xf]);
+  }
+  return out;
+}
+
+struct Entry {
+  uint64_t offset = 0;
+  uint64_t nbytes = 0;
+  bool sealed = false;
+  bool in_memory = true;  // false => spilled to disk
+  bool deleted = false;   // delete requested while pinned; freed on last unpin
+  int64_t pinned = 0;
+  std::list<Key>::iterator lru_it;
+  bool in_lru = false;
+};
+
+class Allocator {
+  // Best-fit free-list with coalescing. free_by_size_ is the search index,
+  // free_by_off_ the coalescing index; they mirror each other.
+ public:
+  explicit Allocator(uint64_t capacity) : capacity_(capacity) {
+    insert_free(0, capacity);
+  }
+
+  int64_t allocate(uint64_t nbytes) {
+    nbytes = align_up(std::max<uint64_t>(nbytes, 1));
+    auto it = free_by_size_.lower_bound(nbytes);
+    if (it == free_by_size_.end()) return -1;
+    uint64_t size = it->first, off = it->second;
+    erase_free(off, size);
+    if (size > nbytes) insert_free(off + nbytes, size - nbytes);
+    used_ += nbytes;
+    return static_cast<int64_t>(off);
+  }
+
+  void deallocate(uint64_t off, uint64_t nbytes) {
+    nbytes = align_up(std::max<uint64_t>(nbytes, 1));
+    used_ -= nbytes;
+    // coalesce with next
+    auto next = free_by_off_.find(off + nbytes);
+    if (next != free_by_off_.end()) {
+      uint64_t nsize = next->second;
+      erase_free(off + nbytes, nsize);
+      nbytes += nsize;
+    }
+    // coalesce with prev
+    auto prev = free_by_off_.lower_bound(off);
+    if (prev != free_by_off_.begin()) {
+      --prev;
+      if (prev->first + prev->second == off) {
+        uint64_t poff = prev->first, psize = prev->second;
+        erase_free(poff, psize);
+        off = poff;
+        nbytes += psize;
+      }
+    }
+    insert_free(off, nbytes);
+  }
+
+  uint64_t used() const { return used_; }
+  uint64_t capacity() const { return capacity_; }
+
+ private:
+  void insert_free(uint64_t off, uint64_t size) {
+    free_by_off_[off] = size;
+    free_by_size_.emplace(size, off);
+  }
+  void erase_free(uint64_t off, uint64_t size) {
+    free_by_off_.erase(off);
+    auto range = free_by_size_.equal_range(size);
+    for (auto it = range.first; it != range.second; ++it) {
+      if (it->second == off) {
+        free_by_size_.erase(it);
+        break;
+      }
+    }
+  }
+
+  uint64_t capacity_;
+  uint64_t used_ = 0;
+  std::map<uint64_t, uint64_t> free_by_off_;
+  std::multimap<uint64_t, uint64_t> free_by_size_;
+};
+
+}  // namespace
+
+struct RtsStore {
+  std::string seg_name;   // without leading '/'
+  std::string spill_dir;
+  int fd = -1;
+  uint8_t *base = nullptr;
+  Allocator alloc;
+  std::unordered_map<Key, Entry> table;
+  std::list<Key> lru;  // front = coldest
+  std::mutex mu;
+  uint64_t num_spilled = 0, num_restored = 0, num_evictions = 0;
+  std::string last_error;
+
+  explicit RtsStore(uint64_t cap) : alloc(cap) {}
+
+  std::string spill_path(const Key &k) const { return spill_dir + "/" + hex(k); }
+
+  void lru_touch(Entry &e, const Key &k) {
+    if (e.in_lru) lru.erase(e.lru_it);
+    lru.push_back(k);
+    e.lru_it = std::prev(lru.end());
+    e.in_lru = true;
+  }
+
+  void lru_remove(Entry &e) {
+    if (e.in_lru) {
+      lru.erase(e.lru_it);
+      e.in_lru = false;
+    }
+  }
+
+  bool spill_one() {
+    // Spill the coldest sealed, unpinned, in-memory object. Returns false if
+    // nothing is evictable.
+    for (auto it = lru.begin(); it != lru.end(); ++it) {
+      auto t = table.find(*it);
+      if (t == table.end()) continue;
+      Entry &e = t->second;
+      if (!e.sealed || e.pinned > 0 || !e.in_memory) continue;
+      if (spill_dir.empty()) return false;
+      std::string path = spill_path(*it);
+      FILE *f = std::fopen(path.c_str(), "wb");
+      if (!f) return false;
+      size_t n = std::fwrite(base + e.offset, 1, e.nbytes, f);
+      std::fclose(f);
+      if (n != e.nbytes) {
+        std::remove(path.c_str());
+        return false;
+      }
+      alloc.deallocate(e.offset, e.nbytes);
+      e.in_memory = false;
+      Key key = *it;
+      lru_remove(e);
+      ++num_spilled;
+      ++num_evictions;
+      (void)key;
+      return true;
+    }
+    return false;
+  }
+
+  int64_t allocate_locked(uint64_t nbytes) {
+    int64_t off = alloc.allocate(nbytes);
+    while (off < 0) {
+      if (!spill_one()) return -1;
+      off = alloc.allocate(nbytes);
+    }
+    return off;
+  }
+
+  // Returns 0 ok; -3 on restore failure.
+  int ensure_in_memory(Entry &e, const Key &k) {
+    if (e.in_memory) return 0;
+    int64_t off = allocate_locked(e.nbytes);
+    if (off < 0) return -3;
+    std::string path = spill_path(k);
+    FILE *f = std::fopen(path.c_str(), "rb");
+    if (!f) {
+      alloc.deallocate(off, e.nbytes);
+      return -3;
+    }
+    size_t n = std::fread(base + off, 1, e.nbytes, f);
+    std::fclose(f);
+    if (n != e.nbytes) {
+      alloc.deallocate(off, e.nbytes);
+      return -3;
+    }
+    std::remove(path.c_str());
+    e.offset = static_cast<uint64_t>(off);
+    e.in_memory = true;
+    ++num_restored;
+    return 0;
+  }
+};
+
+extern "C" {
+
+// Create the arena. `name` is the shm segment name without leading slash
+// (must be unique per store); `spill_dir` may be "" to disable spilling.
+RtsStore *rts_create(const char *name, uint64_t capacity, const char *spill_dir) {
+  auto *s = new RtsStore(capacity);
+  s->seg_name = name;
+  s->spill_dir = spill_dir ? spill_dir : "";
+  std::string path = "/" + s->seg_name;
+  shm_unlink(path.c_str());  // stale segment from a crashed predecessor
+  s->fd = shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (s->fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  if (ftruncate(s->fd, static_cast<off_t>(capacity)) != 0) {
+    close(s->fd);
+    shm_unlink(path.c_str());
+    delete s;
+    return nullptr;
+  }
+  void *p = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, s->fd, 0);
+  if (p == MAP_FAILED) {
+    close(s->fd);
+    shm_unlink(path.c_str());
+    delete s;
+    return nullptr;
+  }
+  s->base = static_cast<uint8_t *>(p);
+  if (!s->spill_dir.empty()) {
+    ::mkdir(s->spill_dir.c_str(), 0700);
+  }
+  return s;
+}
+
+const char *rts_segment_name(RtsStore *s) { return s->seg_name.c_str(); }
+
+// Offset >= 0 on success; -1 = out of memory (after eviction); -2 = exists.
+int64_t rts_allocate(RtsStore *s, const uint8_t *id, uint32_t idlen, uint64_t nbytes) {
+  Key k(reinterpret_cast<const char *>(id), idlen);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->table.count(k)) return -2;
+  int64_t off = s->allocate_locked(nbytes);
+  if (off < 0) return -1;
+  Entry e;
+  e.offset = static_cast<uint64_t>(off);
+  e.nbytes = nbytes;
+  auto res = s->table.emplace(std::move(k), e);
+  s->lru_touch(res.first->second, res.first->first);
+  return off;
+}
+
+int rts_seal(RtsStore *s, const uint8_t *id, uint32_t idlen) {
+  Key k(reinterpret_cast<const char *>(id), idlen);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->table.find(k);
+  if (it == s->table.end() || it->second.deleted) return -1;
+  it->second.sealed = true;
+  return 0;
+}
+
+// 0 ok (offset/nbytes filled; pinned if do_pin); -1 missing; -2 unsealed;
+// -3 restore failed (spill file lost or arena too full of pinned objects).
+int rts_lookup_pin(RtsStore *s, const uint8_t *id, uint32_t idlen, int do_pin,
+                   uint64_t *offset, uint64_t *nbytes) {
+  Key k(reinterpret_cast<const char *>(id), idlen);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->table.find(k);
+  if (it == s->table.end() || it->second.deleted) return -1;
+  Entry &e = it->second;
+  if (!e.sealed) return -2;
+  int rc = s->ensure_in_memory(e, it->first);
+  if (rc != 0) return rc;
+  if (do_pin) {
+    e.pinned += 1;
+  }
+  s->lru_touch(e, it->first);
+  *offset = e.offset;
+  *nbytes = e.nbytes;
+  return 0;
+}
+
+int rts_unpin(RtsStore *s, const uint8_t *id, uint32_t idlen) {
+  Key k(reinterpret_cast<const char *>(id), idlen);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->table.find(k);
+  if (it == s->table.end()) return -1;
+  Entry &e = it->second;
+  if (e.pinned > 0) e.pinned -= 1;
+  if (e.pinned == 0 && e.deleted) {
+    // Deferred delete: the last reader is gone, reclaim now.
+    if (e.in_memory) {
+      s->alloc.deallocate(e.offset, e.nbytes);
+    } else {
+      std::remove(s->spill_path(k).c_str());
+    }
+    s->lru_remove(e);
+    s->table.erase(it);
+  }
+  return 0;
+}
+
+int rts_contains(RtsStore *s, const uint8_t *id, uint32_t idlen) {
+  Key k(reinterpret_cast<const char *>(id), idlen);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->table.find(k);
+  return (it != s->table.end() && it->second.sealed &&
+          !it->second.deleted) ? 1 : 0;
+}
+
+// Delete. If readers hold pins the entry is hidden immediately (lookups
+// fail) but the block is reclaimed only on the last unpin, so live
+// zero-copy views never see the slot reused under them.
+int rts_delete(RtsStore *s, const uint8_t *id, uint32_t idlen) {
+  Key k(reinterpret_cast<const char *>(id), idlen);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->table.find(k);
+  if (it == s->table.end() || it->second.deleted) return -1;
+  Entry &e = it->second;
+  if (e.pinned > 0) {
+    e.deleted = true;
+    s->lru_remove(e);
+    return 0;
+  }
+  if (e.in_memory) {
+    s->alloc.deallocate(e.offset, e.nbytes);
+  } else {
+    std::remove(s->spill_path(k).c_str());
+  }
+  s->lru_remove(e);
+  s->table.erase(it);
+  return 0;
+}
+
+// out: [num_objects, used, capacity, spilled, restored, evictions,
+//       num_in_memory, pinned_count]
+void rts_stats(RtsStore *s, uint64_t out[8]) {
+  std::lock_guard<std::mutex> g(s->mu);
+  uint64_t in_mem = 0, pinned = 0;
+  for (auto &kv : s->table) {
+    if (kv.second.in_memory) ++in_mem;
+    if (kv.second.pinned > 0) ++pinned;
+  }
+  out[0] = s->table.size();
+  out[1] = s->alloc.used();
+  out[2] = s->alloc.capacity();
+  out[3] = s->num_spilled;
+  out[4] = s->num_restored;
+  out[5] = s->num_evictions;
+  out[6] = in_mem;
+  out[7] = pinned;
+}
+
+void rts_destroy(RtsStore *s) {
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    for (auto &kv : s->table) {
+      if (!kv.second.in_memory) std::remove(s->spill_path(kv.first).c_str());
+    }
+    s->table.clear();
+  }
+  if (s->base) munmap(s->base, s->alloc.capacity());
+  if (s->fd >= 0) close(s->fd);
+  shm_unlink(("/" + s->seg_name).c_str());
+  delete s;
+}
+
+}  // extern "C"

@@ -125,6 +125,9 @@ D("object_store_memory", int, 2 * 1024 ** 3,
 D("object_spill_dir", str, "",
   "Directory for spilling objects when the store exceeds its cap "
   "(empty = <session_dir>/spill).")
+D("use_native_store", bool, True,
+  "Use the C++ arena object store (ray_tpu/_native/store.cc) when a "
+  "toolchain is available; falls back to the Python per-segment store.")
 
 # --- Scheduler -------------------------------------------------------------
 D("scheduler_spread_threshold", float, 0.5,

@@ -32,10 +32,11 @@ from typing import Any, Callable, Dict, List, Optional, Set
 from .config import Config
 from .controller import NodeInfo
 from .ids import ActorID, NodeID, TaskID, WorkerID
-from .object_store import SharedMemoryStore
-from .protocol import (ActorStateMsg, GetRequest, KillWorker, PutFromWorker,
-                       RpcCall, RunTask, SubmitFromWorker, TaskDone,
-                       TaskSpec, WaitRequest, WorkerReady)
+from .object_store import NativeArenaStore, create_store
+from .protocol import (ActorStateMsg, AllocReply, AllocRequest, GetRequest,
+                       KillWorker, PutFromWorker, ReadDone, RpcCall, RunTask,
+                       SealObject, SubmitFromWorker, TaskDone, TaskSpec,
+                       WaitRequest, WorkerReady)
 from .resources import ResourceSet, TPU
 
 IDLE = "idle"
@@ -61,13 +62,21 @@ class WorkerHandle:
     ready: threading.Event = field(default_factory=threading.Event)
     send_lock: threading.Lock = field(default_factory=threading.Lock)
     assigned_chips: Dict[TaskID, List[int]] = field(default_factory=dict)
+    # Arena-store pin bookkeeping (native store only; see object_store.py):
+    # args pinned for in-flight tasks, pins from outstanding GetReplies, pins
+    # promoted to worker lifetime (actor-retained views), unsealed allocs.
+    arg_pins: Dict[TaskID, List[bytes]] = field(default_factory=dict)
+    get_pins: Dict[int, List[bytes]] = field(default_factory=dict)
+    lifetime_pins: List[bytes] = field(default_factory=list)
+    unsealed: Set[Any] = field(default_factory=set)
 
 
 class NodeManager:
     def __init__(self, node_info: NodeInfo, runtime, num_tpu_chips: int = 0):
         self.info = node_info
         self.runtime = runtime  # driver Runtime; provides message handlers
-        self.store = SharedMemoryStore()
+        self.store = create_store()
+        self._native_store = isinstance(self.store, NativeArenaStore)
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: Dict[str, List[WorkerID]] = {}
         self._lock = threading.RLock()
@@ -138,6 +147,10 @@ class NodeManager:
             # by reference (importable modules, incl. test files) resolve
             # (reference: runtime-env working_dir/py_modules propagation).
             "RAY_TPU_SYS_PATH": self._sys_path_blob,
+            # Arena segment name: workers write large results straight into
+            # the node's C++ store (empty = fall back to per-object segments).
+            "RAY_TPU_ARENA_SEG":
+                self.store.segment_name if self._native_store else "",
         })
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
@@ -274,6 +287,13 @@ class NodeManager:
             import copy as _copy
             spec = _copy.copy(spec)
             spec.runtime_env = dict(spec.runtime_env or {}, env_vars=env_vars)
+        if self._native_store:
+            # Refresh + pin arena-resident args so their offsets stay valid
+            # for the task's lifetime (plasma client-pin semantics).
+            ok, resolved_args, resolved_kwargs = self._pin_args(
+                handle, spec, resolved_args, resolved_kwargs)
+            if not ok:
+                return
         handle.running.add(spec.task_id)
         self.runtime.note_task_running(spec.task_id, self.info.node_id,
                                        handle.worker_id)
@@ -283,6 +303,72 @@ class NodeManager:
             # method calls can never overtake __init__ on the worker pipe.
             self.runtime.bind_actor_worker(
                 spec.create_actor_id, self.info.node_id, handle.worker_id)
+
+    def _pin_args(self, handle: WorkerHandle, spec: TaskSpec,
+                  resolved_args, resolved_kwargs):
+        """Refresh + pin every arena descriptor among the resolved args.
+
+        Pinning under the store lock guarantees the offsets we ship stay
+        valid until the matching unpin (TaskDone for normal tasks, worker
+        death for actor workers, which may retain zero-copy views in state).
+        """
+        pinned: List[bytes] = []
+
+        def refresh(d):
+            if isinstance(d, tuple) and d and d[0] == "shma":
+                nd = self.store.pin_desc_by_key(d[4])
+                if nd is not None:
+                    pinned.append(nd[4])
+                return nd
+            return d
+
+        ok = True
+        new_args = []
+        for d in resolved_args:
+            nd = refresh(d)
+            if nd is None:
+                ok = False
+                break
+            new_args.append(nd)
+        new_kwargs = {}
+        if ok:
+            for k, d in resolved_kwargs.items():
+                nd = refresh(d)
+                if nd is None:
+                    ok = False
+                    break
+                new_kwargs[k] = nd
+        if not ok:
+            for key in pinned:
+                self.store.unpin_key(key)
+            if handle.dedicated:
+                # Chips stay in assigned_chips: they return to the pool only
+                # when the process death is observed (libtpu lock release).
+                self._send(handle, KillWorker("dispatch aborted"))
+            elif handle.actor_id is None:
+                self._release_worker(handle)
+            if not spec.resources.is_empty() or spec.placement_group is not None:
+                self.runtime.scheduler.release(
+                    self.info.node_id, spec.resources,
+                    spec.placement_group, spec.bundle_index)
+            self.runtime.on_dispatch_failed(
+                spec, "arena object freed while dispatching")
+            return False, resolved_args, resolved_kwargs
+        if pinned:
+            handle.arg_pins[spec.task_id] = pinned
+        return True, new_args, new_kwargs
+
+    def track_get_pins(self, worker_id: WorkerID, request_id: int,
+                       keys: List[bytes]) -> None:
+        with self._lock:
+            handle = self._workers.get(worker_id)
+            if handle is not None and handle.state != DEAD:
+                # Insert under the lock so _on_worker_death's pin drain
+                # cannot interleave and strand these pins.
+                handle.get_pins[request_id] = keys
+                return
+        for k in keys:
+            self.store.unpin_key(k)
 
     def _send(self, handle: WorkerHandle, msg) -> None:
         name = type(msg).__name__
@@ -326,6 +412,16 @@ class NodeManager:
             handle.ready.set()
         elif isinstance(msg, TaskDone):
             handle.running.discard(msg.task_id)
+            if self._native_store:
+                keys = handle.arg_pins.pop(msg.task_id, [])
+                if keys:
+                    if handle.actor_id is not None:
+                        # Actor may hold zero-copy views of its args in state;
+                        # keep them pinned for the worker's lifetime.
+                        handle.lifetime_pins.extend(keys)
+                    else:
+                        for k in keys:
+                            self.store.unpin_key(k)
             # Chips NEVER return to the pool at TaskDone: libtpu holds the
             # device locks until process exit, so reuse must wait for
             # _on_worker_death (actors and dedicated task workers alike).
@@ -359,6 +455,25 @@ class NodeManager:
             rt.on_put_from_worker(msg)
         elif isinstance(msg, ActorStateMsg):
             rt.on_actor_state(msg, self.info.node_id, handle.worker_id)
+        elif isinstance(msg, AllocRequest):
+            res = self.store.allocate_for_worker(msg.object_id, msg.nbytes) \
+                if self._native_store else None
+            if res is None:
+                self._send(handle, AllocReply(msg.request_id, None))
+            else:
+                handle.unsealed.add(msg.object_id)
+                self._send(handle, AllocReply(msg.request_id, res[0], res[1]))
+        elif isinstance(msg, SealObject):
+            if self._native_store:
+                self.store.seal(msg.object_id)
+                handle.unsealed.discard(msg.object_id)
+        elif isinstance(msg, ReadDone):
+            keys = handle.get_pins.pop(msg.request_id, [])
+            if msg.retain:
+                handle.lifetime_pins.extend(keys)
+            else:
+                for k in keys:
+                    self.store.unpin_key(k)
         elif isinstance(msg, RpcCall):
             rt.on_rpc_call(self, msg)
 
@@ -377,6 +492,24 @@ class NodeManager:
                 self._chip_pool.extend(chips)
             handle.assigned_chips.clear()
             running = list(handle.running)
+            pin_keys: List[bytes] = list(handle.lifetime_pins)
+            for keys in handle.arg_pins.values():
+                pin_keys.extend(keys)
+            for keys in handle.get_pins.values():
+                pin_keys.extend(keys)
+            handle.arg_pins.clear()
+            handle.get_pins.clear()
+            handle.lifetime_pins.clear()
+            unsealed = list(handle.unsealed)
+            handle.unsealed.clear()
+        if self._native_store:
+            for k in pin_keys:
+                self.store.unpin_key(k)
+            for oid in unsealed:
+                try:
+                    self.store.delete(oid)
+                except KeyError:
+                    pass
         self.runtime.on_worker_died(handle.worker_id, self.info.node_id,
                                     running, handle.actor_id)
 

@@ -63,11 +63,11 @@ def _open_untracked(name: str, create: bool, size: int = 0) -> shared_memory.Sha
     owns unlink explicitly.
     """
     shm = _SafeSharedMemory(name=name, create=create, size=size)
-    if create:
-        try:
-            resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
-        except Exception:
-            pass
+    # Python <=3.12 registers on attach too, so always unregister.
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
     return shm
 
 
@@ -179,6 +179,13 @@ class SharedMemoryStore:
     def shm_name(self, object_id: ObjectID) -> str:
         return _shm_name(object_id)
 
+    def descriptor(self, object_id: ObjectID) -> Optional[tuple]:
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed:
+                return None
+            return ("shm", _shm_name(object_id), e.nbytes)
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {"num_objects": len(self._entries), "used_bytes": self._used,
@@ -238,6 +245,200 @@ class SharedMemoryStore:
         self.num_restored += 1
 
 
+class NativeArenaStore:
+    """ctypes wrapper over the C++ arena store (ray_tpu/_native/store.cc).
+
+    One shm arena per node process; best-fit allocation, LRU spill/restore and
+    plasma-style pinning live in C++.  This class adds the python-side mapping
+    for zero-copy reads/writes from the owner process and the payload codec.
+    Descriptors are ("shma", segment, offset, nbytes, id_bytes); offsets are
+    only valid while the object is pinned, so hand-outs must go through
+    ``pin_desc_by_key`` (which refreshes the offset under the store lock).
+    """
+
+    def __init__(self, capacity_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        from .. import _native
+        lib = _native.load_store_library()
+        if lib is None:
+            raise RuntimeError("native store library unavailable")
+        self._lib = lib
+        capacity = capacity_bytes or Config.get("object_store_memory")
+        spill = spill_dir or Config.get("object_spill_dir") or os.path.join(
+            "/tmp", "ray_tpu_spill", f"arena_{os.getpid()}")
+        name = f"rta_{os.getpid()}_{os.urandom(4).hex()}"
+        self._h = lib.rts_create(name.encode(), capacity, spill.encode())
+        if not self._h:
+            raise RuntimeError("native store arena creation failed")
+        self.segment_name = name
+        self._shm = _open_untracked(name, create=False)
+        self._closed = False
+
+    # -- write path ---------------------------------------------------------
+
+    def allocate(self, object_id: ObjectID, nbytes: int) -> int:
+        off = self._lib.rts_allocate(self._h, object_id.binary(),
+                                     len(object_id.binary()), nbytes)
+        if off == -2:
+            raise ValueError(f"object {object_id} already exists")
+        if off < 0:
+            raise ObjectStoreFullError(
+                f"arena cannot fit {nbytes} bytes (all pinned or unsealed)")
+        return off
+
+    def seal(self, object_id: ObjectID) -> None:
+        self._lib.rts_seal(self._h, object_id.binary(),
+                           len(object_id.binary()))
+
+    def put_serialized(self, object_id: ObjectID, meta: bytes, buffers) -> int:
+        nbytes = serialization.payload_nbytes(meta, buffers)
+        off = self.allocate(object_id, nbytes)
+        serialization.write_payload_into(
+            self._shm.buf[off: off + nbytes], meta, buffers)
+        self.seal(object_id)
+        return nbytes
+
+    def put(self, object_id: ObjectID, value: Any) -> int:
+        meta, buffers = serialization.serialize_payload(value)
+        return self.put_serialized(object_id, meta, buffers)
+
+    def allocate_for_worker(self, object_id: ObjectID,
+                            nbytes: int) -> Optional[Tuple[str, int]]:
+        """Grant an arena slot to a worker process (plasma Create RPC)."""
+        try:
+            off = self.allocate(object_id, nbytes)
+        except (ObjectStoreFullError, ValueError):
+            return None
+        return self.segment_name, off
+
+    # -- read path ----------------------------------------------------------
+
+    def _lookup(self, key: bytes, pin: bool) -> Optional[Tuple[int, int]]:
+        import ctypes
+        off = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        rc = self._lib.rts_lookup_pin(self._h, key, len(key), 1 if pin else 0,
+                                      ctypes.byref(off), ctypes.byref(n))
+        if rc != 0:
+            return None
+        return off.value, n.value
+
+    def contains(self, object_id: ObjectID) -> bool:
+        key = object_id.binary()
+        return bool(self._lib.rts_contains(self._h, key, len(key)))
+
+    def descriptor(self, object_id: ObjectID) -> Optional[tuple]:
+        """Unpinned descriptor snapshot (for the object directory); consumers
+        must refresh through pin_desc_by_key before dereferencing."""
+        key = object_id.binary()
+        res = self._lookup(key, pin=False)
+        if res is None:
+            return None
+        return ("shma", self.segment_name, res[0], res[1], key)
+
+    def pin_desc_by_key(self, key: bytes) -> Optional[tuple]:
+        res = self._lookup(key, pin=True)
+        if res is None:
+            return None
+        return ("shma", self.segment_name, res[0], res[1], key)
+
+    def unpin_key(self, key: bytes) -> None:
+        self._lib.rts_unpin(self._h, key, len(key))
+
+    def read_by_key(self, key: bytes, pin: bool) -> Optional[Any]:
+        """Owner-process zero-copy read (views into the arena mapping)."""
+        res = self._lookup(key, pin=pin)
+        if res is None:
+            return None
+        off, nbytes = res
+        return serialization.read_payload_from(self._shm.buf[off: off + nbytes])
+
+    def get(self, object_id: ObjectID) -> Any:
+        value = self.read_by_key(object_id.binary(), pin=False)
+        if value is None:
+            raise KeyError(f"object {object_id} not in store")
+        return value
+
+    def pin(self, object_id: ObjectID) -> None:
+        key = object_id.binary()
+        self._lookup(key, pin=True)
+
+    def unpin(self, object_id: ObjectID) -> None:
+        self.unpin_key(object_id.binary())
+
+    def delete(self, object_id: ObjectID) -> None:
+        key = object_id.binary()
+        if self._lib.rts_delete(self._h, key, len(key)) != 0:
+            raise KeyError(f"object {object_id} not in store")
+
+    def stats(self) -> Dict[str, int]:
+        import ctypes
+        out = (ctypes.c_uint64 * 8)()
+        self._lib.rts_stats(self._h, ctypes.byref(out))
+        return {"num_objects": int(out[0]), "used_bytes": int(out[1]),
+                "capacity_bytes": int(out[2]), "num_spilled": int(out[3]),
+                "num_restored": int(out[4]), "num_evictions": int(out[5]),
+                "num_in_memory": int(out[6]), "num_pinned": int(out[7]),
+                "native": 1}
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._shm.close()
+        except Exception:
+            pass
+        self._lib.rts_destroy(self._h)
+        self._h = None
+
+
+def create_store(capacity_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+    """Node store factory: native C++ arena when buildable, else Python."""
+    if Config.get("use_native_store"):
+        try:
+            return NativeArenaStore(capacity_bytes, spill_dir)
+        except Exception as e:
+            import logging
+            logging.getLogger("ray_tpu").warning(
+                "native arena store unavailable (%s); falling back to the "
+                "Python per-segment store", e)
+    return SharedMemoryStore(capacity_bytes, spill_dir)
+
+
+class ArenaReader:
+    """Maps arena segments by name in non-owner processes (one mapping per
+    segment, cached for the process lifetime)."""
+
+    _maps: Dict[str, shared_memory.SharedMemory] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def mapping(cls, segment: str) -> shared_memory.SharedMemory:
+        with cls._lock:
+            shm = cls._maps.get(segment)
+            if shm is None:
+                shm = _open_untracked(segment, create=False)
+                cls._maps[segment] = shm
+            return shm
+
+    @classmethod
+    def read(cls, desc) -> Tuple[Any, Any]:
+        _, segment, off, nbytes = desc[0], desc[1], desc[2], desc[3]
+        shm = cls.mapping(segment)
+        value = serialization.read_payload_from(shm.buf[off: off + nbytes])
+        return value, shm
+
+    @classmethod
+    def write(cls, segment: str, off: int, meta: bytes, buffers) -> int:
+        shm = cls.mapping(segment)
+        nbytes = serialization.payload_nbytes(meta, buffers)
+        serialization.write_payload_into(
+            shm.buf[off: off + nbytes], meta, buffers)
+        return nbytes
+
+
 class RemoteObjectReader:
     """Maps sealed objects created by other processes on this host by name."""
 
@@ -263,6 +464,11 @@ class RemoteObjectReader:
     def write(shm_name_unused: str, object_id: ObjectID, value: Any) -> Tuple[str, int]:
         """Create + seal an object segment from a non-owner process."""
         meta, buffers = serialization.serialize_payload(value)
+        return RemoteObjectReader.write_payload(object_id, meta, buffers)
+
+    @staticmethod
+    def write_payload(object_id: ObjectID, meta: bytes,
+                      buffers) -> Tuple[str, int]:
         nbytes = serialization.payload_nbytes(meta, buffers)
         shm = _open_untracked(_shm_name(object_id), create=True,
                               size=max(nbytes, 1))

@@ -9,7 +9,10 @@ underneath without touching the scheduler or API layers.
 
 Value descriptors (how an argument/return travels):
     ("inline", payload_bytes)            — packed payload, small objects
-    ("shm", name, nbytes)                — host shared-memory segment
+    ("shm", name, nbytes)                — dedicated shared-memory segment
+    ("shma", segment, offset, nbytes, id_bytes)
+                                         — slot in the node's C++ arena store;
+                                           offset valid only while pinned
     ("err", payload_bytes)               — serialized exception
 """
 
@@ -143,6 +146,40 @@ class WorkerReady:
 @dataclass
 class FreeObjects:
     object_ids: List[ObjectID] = field(default_factory=list)
+
+
+@dataclass
+class AllocRequest:
+    """worker -> node: reserve an arena slot for a large result (plasma
+    Create RPC equivalent)."""
+    request_id: int
+    worker_id: WorkerID
+    object_id: ObjectID
+    nbytes: int
+
+
+@dataclass
+class AllocReply:
+    """node -> worker: (segment, offset) grant, or segment=None on failure
+    (worker falls back to a dedicated shm segment)."""
+    request_id: int
+    segment: Optional[str]
+    offset: int = -1
+
+
+@dataclass
+class SealObject:
+    """worker -> node: arena slot fully written; object now readable."""
+    object_id: ObjectID
+
+
+@dataclass
+class ReadDone:
+    """worker -> node: descriptors from a GetReply are no longer referenced.
+    retain=True (actor context) transfers the pins to the worker's lifetime
+    instead of releasing them, since the actor may hold zero-copy views."""
+    request_id: int
+    retain: bool = False
 
 
 @dataclass

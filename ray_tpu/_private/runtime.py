@@ -147,6 +147,9 @@ class Runtime:
         self.directory: Dict[ObjectID, ObjectState] = {}
         self._dir_lock = threading.RLock()
         self._mapped_segments: Dict[ObjectID, Any] = {}
+        # Arena objects pinned on behalf of driver-held zero-copy views;
+        # released at free() (plasma client-pin semantics).
+        self._arena_pins: set = set()
 
         self.scheduler = ClusterScheduler(self.controller, self._object_ready)
         self.scheduler.on_dispatch_error = self._fail_task
@@ -194,6 +197,17 @@ class Runtime:
                 self._mapped_segments[object_id] = shm
                 return value
             return serialization.read_payload_from(shm.buf[: desc[2]])
+        if kind == "shma":
+            # Pin once per driver-held object so the arena offset stays valid
+            # for any zero-copy views the caller retains; released at free().
+            pin = object_id not in self._arena_pins
+            value = self.node.store.read_by_key(desc[4], pin=pin)
+            if value is None:
+                raise ObjectLostError(
+                    f"object {object_id} was evicted or freed")
+            if pin:
+                self._arena_pins.add(object_id)
+            return value
         if kind == "err":
             raise serialization.unpack_payload(desc[1])
         raise ValueError(f"bad descriptor {desc!r}")
@@ -215,8 +229,7 @@ class Runtime:
             self.mark_ready(object_id, ("inline", bytes(buf)))
         else:
             self.node.store.put_serialized(object_id, meta, buffers)
-            self.mark_ready(
-                object_id, ("shm", self.node.store.shm_name(object_id), nbytes))
+            self.mark_ready(object_id, self.node.store.descriptor(object_id))
         return object_id
 
     def get(self, object_ids: List[ObjectID],
@@ -268,6 +281,15 @@ class Runtime:
                     shm.close()
                 except Exception:
                     pass
+            if st is not None and st.desc and st.desc[0] == "shma":
+                if oid in self._arena_pins:
+                    self._arena_pins.discard(oid)
+                    self.node.store.unpin_key(st.desc[4])
+                try:
+                    self.node.store.delete(oid)
+                except KeyError:
+                    pass
+                continue
             if st is not None and st.desc and st.desc[0] == "shm":
                 try:
                     self.node.store.delete(oid)
@@ -559,8 +581,27 @@ class Runtime:
                 if replied["done"]:
                     return
                 replied["done"] = True
-            values = [st.desc if st.event.is_set() else ("err", b"")
-                      for st in states]
+            values = []
+            pinned_keys = []
+            for st in states:
+                if not st.event.is_set():
+                    values.append(("err", b""))
+                    continue
+                d = st.desc
+                if isinstance(d, tuple) and d and d[0] == "shma":
+                    # Refresh + pin so the offset stays valid until the
+                    # worker's ReadDone (plasma client-pin semantics).
+                    nd = node.store.pin_desc_by_key(d[4])
+                    if nd is None:
+                        d = ("err", serialization.pack_payload(
+                            ObjectLostError("object was evicted or freed")))
+                    else:
+                        d = nd
+                        pinned_keys.append(nd[4])
+                values.append(d)
+            if pinned_keys:
+                node.track_get_pins(msg.worker_id, msg.request_id,
+                                    pinned_keys)
             node.send_to_worker(msg.worker_id,
                                 GetReply(msg.request_id, values, timed_out))
 

@@ -24,9 +24,10 @@ from . import serialization
 from .config import Config
 from .exceptions import TaskError
 from .ids import ActorID, ObjectID, TaskID, WorkerID
-from .object_store import RemoteObjectReader
-from .protocol import (ActorStateMsg, GetReply, GetRequest, KillWorker,
-                       PutFromWorker, RpcCall, RpcReply, RunTask,
+from .object_store import ArenaReader, RemoteObjectReader
+from .protocol import (ActorStateMsg, AllocReply, AllocRequest, GetReply,
+                       GetRequest, KillWorker, PutFromWorker, ReadDone,
+                       RpcCall, RpcReply, RunTask, SealObject,
                        SubmitFromWorker, TaskDone, WaitReply, WaitRequest,
                        WorkerReady)
 
@@ -39,19 +40,33 @@ def _materialize(desc, keepalives: List) -> Any:
         value, shm = RemoteObjectReader.read(desc[1], desc[2])
         keepalives.append(shm)
         return value
+    if kind == "shma":
+        value, shm = ArenaReader.read(desc)
+        keepalives.append(shm)
+        return value
     if kind == "err":
         raise serialization.unpack_payload(desc[1])
     raise ValueError(f"unknown value descriptor {kind!r}")
 
 
-def _serialize_result(object_id: ObjectID, value: Any):
+def _serialize_result(rt: "WorkerRuntime", object_id: ObjectID, value: Any):
     meta, buffers = serialization.serialize_payload(value)
     nbytes = serialization.payload_nbytes(meta, buffers)
     if nbytes <= Config.get("max_inline_object_size"):
         out = bytearray(nbytes)
         serialization.write_payload_into(memoryview(out), meta, buffers)
         return ("inline", bytes(out))
-    shm_name, nbytes = RemoteObjectReader.write("", object_id, value)
+    # Preferred path: zero-copy write into the node's C++ arena store
+    # (plasma Create/Seal protocol). Fallback: dedicated shm segment.
+    if rt.arena_segment:
+        grant = rt.alloc_arena(object_id, nbytes)
+        if grant is not None:
+            seg, off = grant
+            ArenaReader.write(seg, off, meta, buffers)
+            rt.send(SealObject(object_id))
+            return ("shma", seg, off, nbytes, object_id.binary())
+    shm_name, nbytes = RemoteObjectReader.write_payload(object_id, meta,
+                                                        buffers)
     return ("shm", shm_name, nbytes)
 
 
@@ -74,6 +89,11 @@ class WorkerRuntime:
         self.current_actor_id: Optional[ActorID] = None
         self._obj_index_lock = threading.Lock()
         self._obj_index = 1 << 20  # put-objects live above return indices
+        self.arena_segment = os.environ.get("RAY_TPU_ARENA_SEG") or None
+        # Per-task deferred pin releases for GetReply descriptors: released
+        # when the task that materialized them finishes (its zero-copy views
+        # die with it). Thread-local so concurrent tasks don't cross-release.
+        self._tls = threading.local()
 
     # -- plumbing -----------------------------------------------------------
 
@@ -109,11 +129,91 @@ class WorkerRuntime:
         reply: GetReply = self._call(
             lambda rid: GetRequest(rid, self.worker_id, object_ids, timeout),
             timeout=None)
+        has_arena = any(isinstance(d, tuple) and d and d[0] == "shma"
+                        for d in reply.values)
         if reply.timed_out:
+            # The node pinned the ready arena objects before replying; no
+            # views were created, so release immediately.
+            if has_arena:
+                self._send_read_done(reply.request_id, retain=False)
             from .exceptions import GetTimeoutError
             raise GetTimeoutError(f"get timed out on {object_ids}")
         keepalives: List = []
-        return [_materialize(d, keepalives) for d in reply.values]
+        values = None
+        try:
+            values = [_materialize(d, keepalives) for d in reply.values]
+            return values
+        finally:
+            if has_arena:
+                arena_values = None
+                if values is not None:
+                    arena_values = [v for d, v in zip(reply.values, values)
+                                    if isinstance(d, tuple) and d
+                                    and d[0] == "shma"]
+                self._note_arena_read(reply.request_id, arena_values)
+
+    def _send_read_done(self, request_id: int, retain: bool) -> None:
+        try:
+            self.send(ReadDone(request_id, retain))
+        except (BrokenPipeError, OSError):
+            pass  # node gone; pins die with it
+
+    def _note_arena_read(self, request_id: int, arena_values) -> None:
+        """Schedule the pin release for a GetReply holding arena descriptors.
+
+        Task context: released when the task ends (its views die with it).
+        Actor context: the actor may retain zero-copy views in its state, so
+        release when the *values* are garbage-collected (plasma buffer
+        release semantics); values that can't carry a weakref fall back to
+        worker-lifetime pins. No context / materialize error: release now.
+        """
+        if self.current_actor_id is None:
+            deferred = getattr(self._tls, "read_dones", None)
+            if deferred is not None:
+                deferred.append(request_id)
+            else:
+                self._send_read_done(request_id, retain=False)
+            return
+        if not arena_values:
+            self._send_read_done(request_id, retain=False)
+            return
+        import weakref
+        remaining = {"n": len(arena_values)}
+        rlock = threading.Lock()
+
+        def one_collected():
+            with rlock:
+                remaining["n"] -= 1
+                done = remaining["n"] == 0
+            if done:
+                self._send_read_done(request_id, retain=False)
+
+        finalizers = []
+        try:
+            for v in arena_values:
+                finalizers.append(weakref.finalize(v, one_collected))
+        except TypeError:
+            # Some value can't be weakly referenced: pin for the worker's
+            # lifetime instead (node releases at worker death).
+            for f in finalizers:
+                f.detach()
+            self._send_read_done(request_id, retain=True)
+
+    def begin_task_reads(self) -> None:
+        self._tls.read_dones = []
+
+    def flush_task_reads(self) -> None:
+        deferred = getattr(self._tls, "read_dones", None)
+        self._tls.read_dones = None
+        for rid in deferred or ():
+            self.send(ReadDone(rid, retain=False))
+
+    def alloc_arena(self, object_id: ObjectID, nbytes: int):
+        reply: AllocReply = self._call(
+            lambda rid: AllocRequest(rid, self.worker_id, object_id, nbytes))
+        if reply.segment is None:
+            return None
+        return reply.segment, reply.offset
 
     def wait(self, object_ids: List[ObjectID], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True):
@@ -131,7 +231,7 @@ class WorkerRuntime:
             self._obj_index += 1
             idx = self._obj_index
         object_id = ObjectID.of(task_id, idx)
-        desc = _serialize_result(object_id, value)
+        desc = _serialize_result(self, object_id, value)
         self.send(PutFromWorker(object_id, desc))
         return object_id
 
@@ -168,10 +268,17 @@ class WorkerLoop:
         rt.current_task_id = spec.task_id
         # Actor tasks may stash zero-copy arg views in actor state, so their
         # backing shm segments live as long as the actor.
-        if spec.create_actor_id is not None or spec.actor_id is not None:
+        is_actor_task = (spec.create_actor_id is not None
+                         or spec.actor_id is not None)
+        if is_actor_task:
             keepalives = self._actor_keepalives
+            # Set before __init__ runs so gets inside the constructor pin
+            # with actor-lifetime (retain) semantics.
+            if spec.create_actor_id is not None:
+                rt.current_actor_id = spec.create_actor_id
         else:
             keepalives = []
+            rt.begin_task_reads()
         results: List[Tuple[ObjectID, tuple]] = []
         error = None
         is_app_error = False
@@ -214,7 +321,7 @@ class WorkerLoop:
                 out = fn(*args, **kwargs)
                 value_list = self._split_returns(out, spec)
             for oid, value in zip(spec.return_ids, value_list):
-                results.append((oid, _serialize_result(oid, value)))
+                results.append((oid, _serialize_result(rt, oid, value)))
         except BaseException as exc:  # noqa: BLE001 - forwarded to caller
             is_app_error = True
             wrapped = TaskError(exc, spec.name, traceback.format_exc())
@@ -228,6 +335,10 @@ class WorkerLoop:
                 rt.send(ActorStateMsg(spec.create_actor_id, "error", error))
         finally:
             rt.current_task_id = None
+            if not is_actor_task:
+                # Results are serialized (copied) by now; arg/get views are
+                # dead, so release their arena pins before TaskDone.
+                rt.flush_task_reads()
         rt.send(TaskDone(spec.task_id, rt.worker_id, results, error,
                          is_app_error, spec.actor_id or spec.create_actor_id,
                          _time.monotonic() - t0))
@@ -264,7 +375,7 @@ class WorkerLoop:
                         max_workers=msg.spec.max_concurrency,
                         thread_name_prefix="task-exec")
                 self._executor.submit(self._run_task, msg)
-            elif isinstance(msg, (GetReply, WaitReply, RpcReply)):
+            elif isinstance(msg, (GetReply, WaitReply, RpcReply, AllocReply)):
                 rt.deliver_reply(msg.request_id, msg)
             elif isinstance(msg, KillWorker):
                 break

@@ -1,0 +1,167 @@
+"""Native C++ arena object store tests (ray_tpu/_native/store.cc).
+
+Covers the plasma-equivalent surface (reference:
+src/ray/object_manager/plasma/store.h:55, eviction_policy.cc,
+raylet/local_object_manager.h:46 spill/restore): allocation, seal, zero-copy
+reads, LRU spill + restore, pinning, and the end-to-end worker path where
+large task results travel through the arena.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu._native import load_store_library
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+from ray_tpu._private.object_store import (ArenaReader, NativeArenaStore,
+                                           ObjectStoreFullError)
+
+pytestmark = pytest.mark.skipif(load_store_library() is None,
+                                reason="no C++ toolchain")
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID.of(TaskID.for_driver(JobID.next()), i)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = NativeArenaStore(capacity_bytes=1 << 20,
+                         spill_dir=str(tmp_path / "spill"))
+    yield s
+    s.shutdown()
+
+
+class TestArenaStore:
+    def test_put_get_roundtrip(self, store):
+        oid = _oid(1)
+        arr = np.arange(1000, dtype=np.float64)
+        store.put(oid, {"x": arr, "tag": "hello"})
+        out = store.get(oid)
+        assert out["tag"] == "hello"
+        np.testing.assert_array_equal(out["x"], arr)
+
+    def test_zero_copy_read(self, store):
+        oid = _oid(2)
+        arr = np.arange(4096, dtype=np.uint8)
+        store.put(oid, arr)
+        out = store.get(oid)
+        # The deserialized array must view arena memory, not a copy.
+        assert not out.flags["OWNDATA"]
+
+    def test_cross_process_reader_mapping(self, store):
+        oid = _oid(3)
+        arr = np.arange(512, dtype=np.int32)
+        store.put(oid, arr)
+        desc = store.descriptor(oid)
+        assert desc[0] == "shma"
+        value, _keepalive = ArenaReader.read(desc)
+        np.testing.assert_array_equal(value, arr)
+
+    def test_lru_spill_and_restore(self, store):
+        big = np.zeros(300_000, dtype=np.uint8)
+        oids = [_oid(10 + i) for i in range(4)]
+        for i, oid in enumerate(oids):
+            store.put(oid, big + i)
+        # 4 x ~300KB > 1MB: the earliest objects must have spilled.
+        stats = store.stats()
+        assert stats["num_spilled"] >= 1
+        assert stats["num_objects"] == 4
+        # Restoring the coldest object works and round-trips bytes.
+        out = store.get(oids[0])
+        assert out[0] == 0 and out.shape == big.shape
+        assert store.stats()["num_restored"] >= 1
+
+    def test_pinned_objects_never_evict(self, store):
+        pinned_oid = _oid(20)
+        store.put(pinned_oid, np.ones(300_000, dtype=np.uint8))
+        desc = store.pin_desc_by_key(pinned_oid.binary())
+        assert desc is not None
+        # Fill the arena; the pinned object must survive in memory.
+        for i in range(4):
+            store.put(_oid(21 + i), np.zeros(200_000, dtype=np.uint8))
+        stats = store.stats()
+        assert stats["num_pinned"] == 1
+        fresh = store.pin_desc_by_key(pinned_oid.binary())
+        assert fresh[2] == desc[2]  # same offset: it never moved
+        store.unpin_key(pinned_oid.binary())
+        store.unpin_key(pinned_oid.binary())
+
+    def test_arena_full_of_pins_raises(self, store):
+        oid = _oid(30)
+        store.put(oid, np.zeros(600_000, dtype=np.uint8))
+        assert store.pin_desc_by_key(oid.binary()) is not None
+        with pytest.raises(ObjectStoreFullError):
+            store.allocate(_oid(31), 600_000)
+        store.unpin_key(oid.binary())
+
+    def test_delete_frees_space(self, store):
+        oid = _oid(40)
+        store.put(oid, np.zeros(600_000, dtype=np.uint8))
+        used = store.stats()["used_bytes"]
+        store.delete(oid)
+        assert store.stats()["used_bytes"] < used
+        assert not store.contains(oid)
+        # Freed space is reusable immediately.
+        store.put(_oid(41), np.zeros(900_000, dtype=np.uint8))
+
+    def test_descriptor_refresh_after_restore(self, store):
+        """Spilled objects may restore at a new offset; pin_desc refreshes."""
+        a, b = _oid(50), _oid(51)
+        store.put(a, np.zeros(400_000, dtype=np.uint8))
+        first = store.descriptor(a)
+        store.put(b, np.zeros(500_000, dtype=np.uint8))
+        # Force a out, then b out, then a back in at (likely) a new offset.
+        store.put(_oid(52), np.zeros(500_000, dtype=np.uint8))
+        fresh = store.pin_desc_by_key(a.binary())
+        assert fresh is not None
+        value = store.read_by_key(a.binary(), pin=False)
+        assert value.nbytes == 400_000
+        store.unpin_key(a.binary())
+        assert first[0] == "shma"
+
+
+class TestArenaEndToEnd:
+    """Large values flowing driver <-> workers through the arena."""
+
+    def test_large_task_args_and_results(self, ray_start):
+        import ray_tpu
+
+        arr = np.random.default_rng(0).standard_normal(200_000)
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2.0
+
+        ref = double.remote(ray_tpu.put(arr))
+        np.testing.assert_allclose(ray_tpu.get(ref), arr * 2.0)
+
+    def test_actor_retains_large_state(self, ray_start):
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Holder:
+            def __init__(self, x):
+                self.x = x
+
+            def total(self):
+                return float(self.x.sum())
+
+        arr = np.ones(300_000)
+        h = Holder.remote(ray_tpu.put(arr))
+        assert ray_tpu.get(h.total.remote()) == pytest.approx(300_000.0)
+        # Repeated calls keep reading the retained (pinned) state.
+        assert ray_tpu.get(h.total.remote()) == pytest.approx(300_000.0)
+
+    def test_worker_to_worker_large_transfer(self, ray_start):
+        import ray_tpu
+
+        @ray_tpu.remote
+        def produce():
+            return np.full(250_000, 7.0)
+
+        @ray_tpu.remote
+        def consume(x):
+            return float(x.sum())
+
+        assert ray_tpu.get(consume.remote(produce.remote())) == \
+            pytest.approx(250_000 * 7.0)
