@@ -1,0 +1,29 @@
+"""Utility layer: actor pools, distributed queue, TPU slice reservation,
+user metrics, and the state API.
+
+Reference analogs: python/ray/util/actor_pool.py, util/queue.py,
+util/tpu.py, util/metrics.py, util/state/.
+"""
+
+from __future__ import annotations
+
+from .actor_pool import ActorPool
+from .queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Queue", "Empty", "Full"]
+
+
+def __getattr__(name: str):
+    import importlib
+    if name in ("tpu", "state", "metrics", "collective"):
+        try:
+            if name == "collective":
+                mod = importlib.import_module("ray_tpu.collective")
+            else:
+                mod = importlib.import_module(f".{name}", __name__)
+        except ImportError as e:
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r}") from e
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

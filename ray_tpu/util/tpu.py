@@ -1,0 +1,103 @@
+"""TPU slice reservation: gang-reserve every host of one or more pod slices.
+
+Reference: python/ray/util/tpu.py — SlicePlacementGroup:414,
+slice_placement_group:662, get_tpu_worker_resources:135,
+get_tpu_coordinator_env_vars:206 (MEGASCALE_* plumbing).
+
+A slice reservation is a placement group with one bundle per TPU host in the
+slice: bundle 0 additionally requests the ``TPU-{gen}-head`` marker resource
+so exactly one reservation can claim a given slice's rank-0 host, and every
+bundle requests that host's full chip count — the gang either gets the whole
+slice or nothing (STRICT_SPREAD over hosts).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.accelerators.tpu import (_CHIPS_PER_HOST, TPUAcceleratorManager,
+                                      get_tpu_coordinator_env_vars)
+
+
+def get_num_tpu_chips_per_host(accelerator_type: str) -> int:
+    gen = TPUAcceleratorManager.generation_from_type(accelerator_type)
+    return _CHIPS_PER_HOST.get(gen, 4)
+
+
+def get_tpu_worker_resources(accelerator_type: str) -> List[Dict[str, float]]:
+    """Per-host bundle list for one slice of ``accelerator_type``
+    (reference: util/tpu.py:135)."""
+    num_hosts = TPUAcceleratorManager.num_hosts_for_type(accelerator_type)
+    chips = get_num_tpu_chips_per_host(accelerator_type)
+    gen = TPUAcceleratorManager.generation_from_type(accelerator_type)
+    bundles: List[Dict[str, float]] = []
+    for host in range(num_hosts):
+        bundle: Dict[str, float] = {"TPU": float(chips)}
+        if host == 0:
+            bundle[f"TPU-{gen}-head"] = 1.0
+        bundles.append(bundle)
+    return bundles
+
+
+@dataclass
+class SlicePlacementGroup:
+    """A reserved TPU slice (or multi-slice set) ready for gang scheduling.
+
+    Reference: util/tpu.py:414.  ``placement_groups[i]`` reserves slice i;
+    ``coordinator_env(slice_id)`` returns the MEGASCALE env for multi-slice
+    jax.distributed formation over DCN.
+    """
+
+    accelerator_type: str
+    num_slices: int = 1
+    name: str = field(default_factory=lambda: f"tpu-slice-{uuid.uuid4().hex[:8]}")
+    placement_groups: List[ray_tpu.PlacementGroup] = field(default_factory=list)
+    _coordinator_port: int = 8476
+
+    @property
+    def num_hosts_per_slice(self) -> int:
+        return TPUAcceleratorManager.num_hosts_for_type(self.accelerator_type)
+
+    @property
+    def chips_per_host(self) -> int:
+        return get_num_tpu_chips_per_host(self.accelerator_type)
+
+    @property
+    def total_hosts(self) -> int:
+        return self.num_hosts_per_slice * self.num_slices
+
+    def ready(self, timeout: Optional[float] = 60.0) -> bool:
+        return all(pg.ready(timeout=timeout) for pg in self.placement_groups)
+
+    def coordinator_env(self, slice_id: int,
+                        coordinator_host: str = "localhost") -> Dict[str, str]:
+        return get_tpu_coordinator_env_vars(
+            slice_id, self.num_slices,
+            f"{coordinator_host}:{self._coordinator_port}")
+
+    def remove(self) -> None:
+        for pg in self.placement_groups:
+            ray_tpu.remove_placement_group(pg)
+        self.placement_groups = []
+
+
+def slice_placement_group(accelerator_type: str, num_slices: int = 1,
+                          strategy: str = "STRICT_SPREAD",
+                          ) -> SlicePlacementGroup:
+    """Reserve ``num_slices`` whole slices of ``accelerator_type``
+    (reference: util/tpu.py:662).
+
+    Each slice becomes one placement group so preempting/resizing one slice
+    never tears down the others (the multi-slice elastic story).
+    """
+    pgs = [
+        ray_tpu.placement_group(
+            get_tpu_worker_resources(accelerator_type), strategy=strategy)
+        for _ in range(num_slices)
+    ]
+    return SlicePlacementGroup(
+        accelerator_type=accelerator_type, num_slices=num_slices,
+        placement_groups=pgs)

@@ -1,0 +1,6 @@
+"""Workflows are deprecated, matching the reference tombstone
+(reference: python/ray/workflow/__init__.py — 4 LoC)."""
+
+raise ImportError(
+    "ray_tpu.workflow has been deprecated, mirroring Ray's removal of the "
+    "workflow library. Use tasks + actors with checkpointing instead.")

@@ -1,0 +1,168 @@
+"""ray_tpu.util tests: ActorPool, Queue, TPU slice reservation.
+
+Reference analogs: python/ray/tests/test_actor_pool.py, test_queue.py,
+python/ray/tests/accelerators/test_tpu.py (env-mocked slice logic).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Queue
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, v):
+        return v * 2
+
+
+class TestActorPool:
+    def test_map_ordered(self, ray_start):
+        pool = ActorPool([Doubler.remote() for _ in range(2)])
+        assert list(pool.map(lambda a, v: a.double.remote(v), range(5))) == \
+            [0, 2, 4, 6, 8]
+
+    def test_map_unordered(self, ray_start):
+        pool = ActorPool([Doubler.remote() for _ in range(2)])
+        out = list(pool.map_unordered(
+            lambda a, v: a.double.remote(v), range(5)))
+        assert sorted(out) == [0, 2, 4, 6, 8]
+
+    def test_submit_get_next(self, ray_start):
+        pool = ActorPool([Doubler.remote()])
+        pool.submit(lambda a, v: a.double.remote(v), 10)
+        pool.submit(lambda a, v: a.double.remote(v), 11)
+        assert pool.get_next() == 20
+        assert pool.get_next() == 22
+        assert not pool.has_next()
+
+    def test_push_pop(self, ray_start):
+        a = Doubler.remote()
+        pool = ActorPool([a])
+        popped = pool.pop_idle()
+        assert popped is a
+        assert not pool.has_free()
+        pool.push(a)
+        assert pool.has_free()
+
+
+class TestQueue:
+    def test_put_get(self, ray_start):
+        q = Queue()
+        q.put(1)
+        q.put("two")
+        assert q.get() == 1
+        assert q.get() == "two"
+        assert q.empty()
+
+    def test_get_nowait_empty(self, ray_start):
+        q = Queue()
+        with pytest.raises(Empty):
+            q.get_nowait()
+
+    def test_batch_and_size(self, ray_start):
+        q = Queue()
+        q.put_nowait_batch([1, 2, 3])
+        assert q.qsize() == 3
+        assert q.get_nowait_batch(2) == [1, 2]
+        assert q.qsize() == 1
+
+    def test_queue_passed_to_task(self, ray_start):
+        q = Queue()
+
+        @ray_tpu.remote
+        def producer(queue, n):
+            for i in range(n):
+                queue.put(i)
+            return n
+
+        assert ray_tpu.get(producer.remote(q, 3)) == 3
+        assert [q.get(timeout=10) for _ in range(3)] == [0, 1, 2]
+
+
+class TestSliceUtils:
+    def test_worker_resources_v5e(self):
+        from ray_tpu.util.tpu import get_tpu_worker_resources
+        bundles = get_tpu_worker_resources("v5litepod-16")
+        assert len(bundles) == 2  # 16 chips / 8 per host
+        assert bundles[0]["TPU"] == 8.0
+        assert bundles[0]["TPU-v5e-head"] == 1.0
+        assert "TPU-v5e-head" not in bundles[1]
+
+    def test_worker_resources_v4(self):
+        from ray_tpu.util.tpu import get_tpu_worker_resources
+        bundles = get_tpu_worker_resources("v4-16")
+        assert len(bundles) == 4  # 16 chips / 4 per host
+        assert all(b["TPU"] == 4.0 for b in bundles)
+
+    def test_slice_placement_group_single_host(self):
+        # A v5e-8 slice is one host: reserve it against a runtime that
+        # advertises 8 TPU chips + the head marker.
+        ray_tpu.shutdown()
+        try:
+            ray_tpu.init(num_cpus=4, num_tpus=8,
+                         resources={"TPU-v5e-head": 1.0})
+            from ray_tpu.util.tpu import slice_placement_group
+            spg = slice_placement_group("v5litepod-8")
+            assert spg.num_hosts_per_slice == 1
+            assert spg.chips_per_host == 8
+            assert spg.ready(timeout=30)
+            spg.remove()
+        finally:
+            ray_tpu.shutdown()
+            ray_tpu.init(num_cpus=4)  # restore for later ray_start users
+
+    def test_coordinator_env(self):
+        from ray_tpu.util.tpu import SlicePlacementGroup
+        spg = SlicePlacementGroup(accelerator_type="v5litepod-16",
+                                  num_slices=2)
+        env = spg.coordinator_env(1, "10.0.0.1")
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith("10.0.0.1")
+        # single slice → no megascale env
+        spg1 = SlicePlacementGroup(accelerator_type="v5litepod-16")
+        assert spg1.coordinator_env(0) == {}
+
+
+def test_workflow_tombstone():
+    with pytest.raises(ImportError):
+        import ray_tpu.workflow  # noqa: F401
+
+
+class TestReviewRegressions:
+    def test_actor_pool_survives_task_error(self, ray_start):
+        @ray_tpu.remote
+        def boom(a, v):
+            raise ValueError("boom")
+
+        pool = ActorPool([Doubler.remote()])
+        pool.submit(lambda a, v: a.double.remote(v), 1)
+        pool.submit(lambda a, v: a.double.options().remote(v) if v != 2
+                    else _err_ref(a), 2)
+        assert pool.get_next() == 2
+        with pytest.raises(Exception):
+            pool.get_next()
+        # Pool still usable after the error.
+        pool.submit(lambda a, v: a.double.remote(v), 5)
+        assert pool.get_next() == 10
+
+    def test_queue_batch_all_or_nothing(self, ray_start):
+        q = Queue(maxsize=2)
+        with pytest.raises(Exception):
+            q.put_nowait_batch([1, 2, 3])
+        assert q.qsize() == 0
+        q.put_nowait_batch([1, 2])
+        assert q.qsize() == 2
+
+
+@ray_tpu.remote
+class _Erroring:
+    def fail(self):
+        raise ValueError("task failed")
+
+
+def _err_ref(a):
+    # Submit a method that raises, standing in for a failed task.
+    h = _Erroring.remote()
+    return h.fail.remote()
