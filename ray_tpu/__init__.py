@@ -68,6 +68,12 @@ def is_initialized() -> bool:
     return _runtime_mod.current_runtime() is not None
 
 
+def timeline(filename: Optional[str] = None) -> str:
+    """Chrome-trace dump of task execution (reference: ray.timeline)."""
+    from .util.state import timeline as _timeline
+    return _timeline(filename)
+
+
 def shutdown() -> None:
     rt = _runtime_mod.driver_runtime()
     if rt is not None:
@@ -92,7 +98,8 @@ def __getattr__(name: str):
 
 
 __all__ = [
-    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "init", "shutdown", "is_initialized", "timeline",
+    "remote", "get", "put", "wait",
     "kill", "get_actor", "cluster_resources", "available_resources", "nodes",
     "placement_group", "remove_placement_group", "PlacementGroup",
     "ObjectRef", "ActorHandle", "ActorClass", "ActorMethod", "RemoteFunction",

@@ -172,6 +172,11 @@ D("visible_accelerator_env", str, "TPU_VISIBLE_CHIPS",
   "Env var used to pin a worker to its granted chips (reference: "
   "python/ray/_private/accelerators/tpu.py NOSET/VISIBLE chips plumbing).")
 
+# --- Observability ---------------------------------------------------------
+D("task_events_max_num_task_in_gcs", int, 10000,
+  "Bounded task-event history size (reference: ray_config_def.h "
+  "task_events_max_num_task_in_gcs).")
+
 # --- Logging ---------------------------------------------------------------
 D("log_level", str, "INFO", "Runtime log level.")
 D("session_dir", str, "", "Session directory (empty = /tmp/ray_tpu/session_*).")

@@ -1,0 +1,165 @@
+"""Task lifecycle event buffer feeding the state API and the timeline.
+
+Reference: src/ray/core_worker/task_event_buffer.h:304 (TaskEventBuffer
+batching task state transitions to the GCS) + src/ray/gcs/gcs_task_manager.h:97
+(bounded task-event history served to the dashboard/state API) +
+profile events (src/ray/core_worker/profile_event.h) that become the
+``ray timeline`` chrome trace (python/ray/_private/state.py:471
+chrome_tracing_dump).
+
+Single-process control plane → one bounded buffer on the driver runtime; the
+worker side reports through the existing TaskDone/note_task_running paths so
+no extra RPC is needed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# Task states, mirroring the reference's TaskStatus enum (common.proto).
+PENDING_ARGS = "PENDING_ARGS_AVAIL"
+SUBMITTED_TO_NODE = "SUBMITTED_TO_WORKER"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+
+@dataclass
+class TaskEvent:
+    task_id: str
+    name: str
+    state: str = PENDING_ARGS
+    type: str = "NORMAL_TASK"  # NORMAL_TASK | ACTOR_CREATION_TASK | ACTOR_TASK
+    actor_id: Optional[str] = None
+    node_id: Optional[str] = None
+    worker_id: Optional[str] = None
+    error_message: Optional[str] = None
+    # state -> unix seconds of first entry into that state
+    state_times: Dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "task_id": self.task_id, "name": self.name, "state": self.state,
+            "type": self.type, "actor_id": self.actor_id,
+            "node_id": self.node_id, "worker_id": self.worker_id,
+            "error_message": self.error_message,
+            "state_times": dict(self.state_times),
+        }
+
+
+@dataclass
+class ProfileSpan:
+    """A user/system span for the chrome-trace timeline."""
+    name: str
+    category: str
+    start_s: float
+    end_s: float
+    pid: str  # row group (node / component)
+    tid: str  # row (worker / thread)
+    extra: Optional[Dict[str, Any]] = None
+
+
+class TaskEventBuffer:
+    """Bounded, insertion-ordered task event history (oldest evicted)."""
+
+    def __init__(self, max_events: int = 10000):
+        self._max = max_events
+        self._events: "OrderedDict[str, TaskEvent]" = OrderedDict()
+        self._spans: List[ProfileSpan] = []
+        self._lock = threading.Lock()
+        self.num_dropped = 0
+
+    def record(self, task_id: str, state: str, *, name: Optional[str] = None,
+               task_type: Optional[str] = None, actor_id: Optional[str] = None,
+               node_id: Optional[str] = None, worker_id: Optional[str] = None,
+               error_message: Optional[str] = None) -> None:
+        now = time.time()
+        with self._lock:
+            ev = self._events.get(task_id)
+            if ev is None:
+                ev = TaskEvent(task_id=task_id, name=name or "")
+                self._events[task_id] = ev
+                if len(self._events) > self._max:
+                    self._events.popitem(last=False)
+                    self.num_dropped += 1
+            if name:
+                ev.name = name
+            if task_type:
+                ev.type = task_type
+            if actor_id:
+                ev.actor_id = actor_id
+            if node_id:
+                ev.node_id = node_id
+            if worker_id:
+                ev.worker_id = worker_id
+            if error_message is not None:
+                ev.error_message = error_message
+            ev.state = state
+            ev.state_times.setdefault(state, now)
+
+    def add_span(self, span: ProfileSpan) -> None:
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self._max:
+                self._spans = self._spans[-self._max:]
+
+    def snapshot(self, filters: Optional[Dict[str, Any]] = None,
+                 limit: int = 10000) -> List[Dict[str, Any]]:
+        with self._lock:
+            events = [e.to_dict() for e in self._events.values()]
+        if filters:
+            for k, v in filters.items():
+                events = [e for e in events if e.get(k) == v]
+        return events[-limit:]
+
+    def summary(self) -> Dict[str, Dict[str, int]]:
+        """name -> state -> count (reference: util/state summarize_tasks)."""
+        out: Dict[str, Dict[str, int]] = {}
+        with self._lock:
+            for ev in self._events.values():
+                per = out.setdefault(ev.name or "<unnamed>", {})
+                per[ev.state] = per.get(ev.state, 0) + 1
+        return out
+
+    def chrome_trace(self) -> List[Dict[str, Any]]:
+        """Chrome trace-event JSON (``ph: X`` complete events), one row per
+        worker, one group per node — loadable in chrome://tracing and
+        Perfetto (reference: _private/state.py:471 chrome_tracing_dump)."""
+        trace: List[Dict[str, Any]] = []
+        with self._lock:
+            events = list(self._events.values())
+            spans = list(self._spans)
+        for ev in events:
+            start = ev.state_times.get(RUNNING)
+            if start is None:
+                continue
+            end = (ev.state_times.get(FINISHED)
+                   or ev.state_times.get(FAILED) or time.time())
+            trace.append({
+                "name": ev.name, "cat": "task", "ph": "X",
+                "ts": start * 1e6, "dur": max(0.0, (end - start)) * 1e6,
+                "pid": f"node:{(ev.node_id or 'driver')[:8]}",
+                "tid": f"worker:{(ev.worker_id or '?')[:8]}",
+                "args": {"task_id": ev.task_id, "state": ev.state},
+            })
+            # Queueing time as a lighter-weight slice.
+            sub = ev.state_times.get(PENDING_ARGS)
+            if sub is not None and start > sub:
+                trace.append({
+                    "name": f"{ev.name} (queued)", "cat": "scheduler",
+                    "ph": "X", "ts": sub * 1e6, "dur": (start - sub) * 1e6,
+                    "pid": "scheduler", "tid": "queue",
+                    "args": {"task_id": ev.task_id},
+                })
+        for sp in spans:
+            trace.append({
+                "name": sp.name, "cat": sp.category, "ph": "X",
+                "ts": sp.start_s * 1e6,
+                "dur": max(0.0, sp.end_s - sp.start_s) * 1e6,
+                "pid": sp.pid, "tid": sp.tid, "args": sp.extra or {},
+            })
+        return trace

@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import serialization
 from .config import Config
+from .events import (FAILED, FINISHED, PENDING_ARGS, RUNNING,
+                     SUBMITTED_TO_NODE, ProfileSpan, TaskEventBuffer)
 from .controller import (ALIVE, DEAD, PENDING_CREATION, RESTARTING,
                          ActorInfo, Controller, JobInfo, NodeInfo,
                          PlacementGroupInfo)
@@ -164,6 +166,12 @@ class Runtime:
         self._put_index = 0
         self._put_lock = threading.Lock()
         self._shutdown = False
+
+        self.events = TaskEventBuffer(
+            Config.get("task_events_max_num_task_in_gcs"))
+        # worker_id hex -> latest user-metrics snapshot pushed from that
+        # process (see ray_tpu.util.metrics).
+        self.metrics_snapshots: Dict[str, list] = {}
 
     # ------------------------------------------------------------------ #
     # object directory
@@ -310,10 +318,19 @@ class Runtime:
         for oid in spec.return_ids:
             self._state(oid)
         if spec.actor_id is not None:
+            self.events.record(
+                spec.task_id.hex(), PENDING_ARGS, name=spec.name,
+                task_type="ACTOR_TASK", actor_id=spec.actor_id.hex())
             self._submit_actor_task(spec)
         elif spec.create_actor_id is not None:
+            self.events.record(
+                spec.task_id.hex(), PENDING_ARGS, name=spec.name,
+                task_type="ACTOR_CREATION_TASK",
+                actor_id=spec.create_actor_id.hex())
             self._submit_actor_creation(spec)
         else:
+            self.events.record(spec.task_id.hex(), PENDING_ARGS,
+                               name=spec.name)
             self.scheduler.submit(spec, self._dispatch_normal)
 
     def _resolve(self, spec: TaskSpec):
@@ -441,15 +458,28 @@ class Runtime:
             rt = self._running.get(task_id)
             if rt is not None:
                 rt.worker_id = worker_id
+        self.events.record(task_id.hex(), RUNNING, node_id=node_id.hex(),
+                           worker_id=worker_id.hex())
 
     def _track(self, spec: TaskSpec, node_id: NodeID) -> None:
         with self._running_lock:
             self._running[spec.task_id] = _RunningTask(spec, node_id)
+        self.events.record(spec.task_id.hex(), SUBMITTED_TO_NODE,
+                           node_id=node_id.hex())
 
     def on_task_done(self, msg: TaskDone, node_id: NodeID) -> None:
         with self._running_lock:
             running = self._running.pop(msg.task_id, None)
         spec = running.spec if running else None
+        if msg.error is not None:
+            err = None
+            try:
+                err = repr(serialization.unpack_payload(msg.error[1]))
+            except Exception:
+                pass
+            self.events.record(msg.task_id.hex(), FAILED, error_message=err)
+        else:
+            self.events.record(msg.task_id.hex(), FINISHED)
         if msg.error is not None:
             for oid in (spec.return_ids if spec else [r[0] for r in msg.results]):
                 self.mark_ready(oid, msg.error)
@@ -468,6 +498,8 @@ class Runtime:
         self._fail_task(spec, WorkerCrashedError(reason))
 
     def _fail_task(self, spec: TaskSpec, exc: Exception) -> None:
+        self.events.record(spec.task_id.hex(), FAILED, name=spec.name,
+                           error_message=repr(exc))
         desc = ("err", serialization.pack_payload(exc))
         for oid in spec.return_ids:
             self.mark_ready(oid, desc)
@@ -731,6 +763,58 @@ class Runtime:
                  "name": a.name, "class_name": a.class_name,
                  "num_restarts": a.num_restarts}
                 for a in self.controller.actors.values()]
+
+    # -- state API feeds (reference: dashboard/modules/state/state_head.py
+    #    backed by GcsTaskManager; here the buffers live in-process) ----- #
+
+    def ctl_list_tasks(self, filters=None, limit=10000):
+        return self.events.snapshot(filters, limit)
+
+    def ctl_summarize_tasks(self):
+        return self.events.summary()
+
+    def ctl_list_objects(self, limit=10000):
+        out = []
+        with self._dir_lock:
+            items = list(self.directory.items())[:limit]
+        for oid, st in items:
+            desc = st.desc
+            kind = desc[0] if desc else "pending"
+            nbytes = None
+            if desc:
+                if desc[0] == "inline":
+                    nbytes = len(desc[1])
+                elif desc[0] == "shm":
+                    nbytes = desc[2]
+                elif desc[0] == "shma":
+                    nbytes = desc[3]
+            out.append({"object_id": oid.hex(), "status": kind,
+                        "size_bytes": nbytes})
+        return out
+
+    def ctl_list_placement_groups(self):
+        return [{"placement_group_id": pg.pg_id.hex(), "state": pg.state,
+                 "name": pg.name, "strategy": pg.strategy,
+                 "bundle_count": len(pg.bundles)}
+                for pg in self.controller.placement_groups.values()]
+
+    def ctl_list_jobs(self):
+        return [{"job_id": j.job_id.hex(), "start_time": j.start_time,
+                 "end_time": j.end_time, "entrypoint": j.entrypoint}
+                for j in self.controller.jobs.values()]
+
+    def ctl_timeline(self):
+        return self.events.chrome_trace()
+
+    def ctl_add_profile_span(self, name, category, start_s, end_s, pid, tid,
+                             extra=None):
+        self.events.add_span(
+            ProfileSpan(name, category, start_s, end_s, pid, tid, extra))
+        return True
+
+    def ctl_push_metrics(self, source_id: str, snapshot):
+        self.metrics_snapshots[source_id] = snapshot
+        return True
 
     # ------------------------------------------------------------------ #
 

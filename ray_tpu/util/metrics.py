@@ -1,0 +1,299 @@
+"""User-defined application metrics: Counter, Gauge, Histogram.
+
+Reference: python/ray/util/metrics.py (Metric/Counter/Gauge/Histogram with
+tag support) exported through the dashboard-agent to Prometheus
+(_private/metrics_agent.py).  Here each process keeps a local registry;
+worker processes push snapshots to the driver over the control channel (a
+background flusher, like the reference's periodic metric export), and
+``prometheus_text()`` renders the merged view in Prometheus exposition
+format.  ``start_metrics_server(port)`` serves it over HTTP for scraping.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0]
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+_flusher_started = False
+
+
+def _tags_key(tags: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(tags.items()))
+
+
+class Metric:
+    """Base class; subclasses define how observations fold into state."""
+
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not _NAME_RE.match(name or ""):
+            raise ValueError(f"invalid Prometheus metric name {name!r}")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        # tags-key -> state (scalar for counter/gauge, bucket list for histo)
+        self._values: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None and existing.metric_type != self.metric_type:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{existing.metric_type}")
+            _registry[name] = self
+        _ensure_flusher()
+
+    @property
+    def info(self) -> Dict[str, Any]:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys,
+                "default_tags": dict(self._default_tags)}
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merge_tags(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        if tags:
+            unknown = set(tags) - set(self._tag_keys)
+            if unknown:
+                raise ValueError(
+                    f"unknown tag keys {sorted(unknown)} for metric "
+                    f"{self._name!r} (declared: {list(self._tag_keys)})")
+            merged.update(tags)
+        return merged
+
+    def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        raise NotImplementedError
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "name": self._name, "type": self.metric_type,
+                "description": self._description,
+                "samples": [(n, dict(t), v) for n, t, v in self._samples()],
+            }
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value <= 0:
+            raise ValueError("Counter.inc() value must be positive")
+        key = _tags_key(self._merge_tags(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _samples(self):
+        return [(self._name, dict(k), v) for k, v in self._values.items()]
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tags_key(self._merge_tags(tags))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def _samples(self):
+        return [(self._name, dict(k), v) for k, v in self._values.items()]
+
+
+class Histogram(Metric):
+    metric_type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        self._boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tags_key(self._merge_tags(tags))
+        with self._lock:
+            state = self._values.get(key)
+            if state is None:
+                state = {"buckets": [0] * (len(self._boundaries) + 1),
+                         "sum": 0.0, "count": 0}
+                self._values[key] = state
+            idx = len(self._boundaries)
+            for i, b in enumerate(self._boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            state["buckets"][idx] += 1
+            state["sum"] += value
+            state["count"] += 1
+
+    def _samples(self):
+        out = []
+        for k, state in self._values.items():
+            tags = dict(k)
+            cum = 0
+            for i, b in enumerate(self._boundaries):
+                cum += state["buckets"][i]
+                out.append((f"{self._name}_bucket",
+                            {**tags, "le": repr(float(b))}, float(cum)))
+            cum += state["buckets"][-1]
+            out.append((f"{self._name}_bucket", {**tags, "le": "+Inf"},
+                        float(cum)))
+            out.append((f"{self._name}_sum", tags, state["sum"]))
+            out.append((f"{self._name}_count", tags, float(state["count"])))
+        return out
+
+
+# --------------------------------------------------------------------------
+# export: worker -> driver push, Prometheus text rendering, scrape server
+# --------------------------------------------------------------------------
+
+def local_snapshots() -> List[Dict[str, Any]]:
+    with _registry_lock:
+        metrics = list(_registry.values())
+    return [m.snapshot() for m in metrics]
+
+
+def flush() -> None:
+    """Push this process's metrics to the driver (no-op on the driver: its
+    registry is read directly)."""
+    from ray_tpu._private import runtime as rt_mod
+    rt = rt_mod.current_runtime()
+    if rt is None or rt_mod.driver_runtime() is rt:
+        return
+    source = getattr(rt, "worker_id", None)
+    source_id = source.hex() if source is not None else "unknown"
+    try:
+        rt.control("push_metrics", source_id, local_snapshots())
+    except Exception:
+        pass  # driver shutting down; metrics are best-effort
+
+
+def _ensure_flusher() -> None:
+    """Start the background flusher once, in worker processes only."""
+    global _flusher_started
+    from ray_tpu._private import runtime as rt_mod
+    rt = rt_mod.current_runtime()
+    if rt is None or rt_mod.driver_runtime() is rt or _flusher_started:
+        return
+    _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(2.0)
+            flush()
+
+    threading.Thread(target=loop, daemon=True,
+                     name="ray_tpu-metrics-flush").start()
+
+
+def _merged_snapshots() -> List[Dict[str, Any]]:
+    """Driver-local metrics + the latest snapshot from each worker."""
+    from ray_tpu._private import runtime as rt_mod
+    snaps = local_snapshots()
+    rt = rt_mod.driver_runtime()
+    if rt is not None:
+        # list() snapshots the dict: workers push concurrently from the RPC
+        # handler thread.
+        for worker_snaps in list(rt.metrics_snapshots.values()):
+            snaps.extend(worker_snaps)
+    return snaps
+
+
+def _escape_tag_value(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def prometheus_text() -> str:
+    """Render all known metrics in Prometheus exposition format."""
+    by_name: Dict[str, Dict[str, Any]] = {}
+    # sample-name -> accumulated {tags-key -> value}; counters/histogram
+    # buckets sum across processes, gauges take the latest writer.
+    acc: Dict[str, Dict[Tuple, float]] = {}
+    for snap in _merged_snapshots():
+        by_name.setdefault(snap["name"], snap)
+        summable = snap["type"] in ("counter", "histogram")
+        for sample_name, tags, value in snap["samples"]:
+            bucket = acc.setdefault(sample_name, {})
+            key = _tags_key(tags)
+            if summable:
+                bucket[key] = bucket.get(key, 0.0) + value
+            else:
+                bucket[key] = value
+    lines: List[str] = []
+    emitted_meta = set()
+    for sample_name, bucket in acc.items():
+        base = sample_name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in by_name:
+                base = base[: -len(suffix)]
+        meta = by_name.get(base)
+        if meta and base not in emitted_meta:
+            emitted_meta.add(base)
+            if meta["description"]:
+                lines.append(f"# HELP {base} {meta['description']}")
+            lines.append(f"# TYPE {base} {meta['type']}")
+        for key, value in sorted(bucket.items()):
+            if key:
+                tag_str = ",".join(
+                    f'{k}="{_escape_tag_value(v)}"' for k, v in key)
+                lines.append(f"{sample_name}{{{tag_str}}} {value}")
+            else:
+                lines.append(f"{sample_name} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+_server = None
+
+
+def start_metrics_server(port: int = 0):
+    """Serve prometheus_text() on http://localhost:port/metrics; returns the
+    bound port (reference: dashboard metrics exposition)."""
+    global _server
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") in ("", "/metrics".rstrip("/")) or \
+                    self.path == "/metrics":
+                body = prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    _server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=_server.serve_forever, daemon=True,
+                     name="ray_tpu-metrics-http").start()
+    return _server.server_address[1]
+
+
+def _reset_for_tests() -> None:
+    global _flusher_started
+    with _registry_lock:
+        _registry.clear()
+    _flusher_started = False

@@ -1,0 +1,121 @@
+"""State API: programmatic views of cluster state.
+
+Reference: python/ray/util/state/api.py (list_actors:793, list_tasks:1020,
+list_nodes, list_objects, list_placement_groups, list_jobs, summarize_*)
+served by dashboard/modules/state/state_head.py over GcsTaskManager.  Here
+the queries hit the driver runtime's controller + TaskEventBuffer directly
+(or over the worker control channel when called inside a task/actor).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.api import _control
+
+
+def list_tasks(filters: Optional[List] = None,
+               limit: int = 10000, **_: Any) -> List[Dict[str, Any]]:
+    """Task event records. ``filters`` is a list of (key, "=", value)
+    triples like the reference's predicate filters."""
+    fd = None
+    if filters:
+        fd = {}
+        for key, op, value in filters:
+            if op not in ("=", "=="):
+                raise ValueError(f"only equality filters supported, got {op}")
+            fd[key] = value
+    return _control("list_tasks", fd, limit)
+
+
+def list_actors(**_: Any) -> List[Dict[str, Any]]:
+    return _control("list_actors")
+
+
+def list_nodes(**_: Any) -> List[Dict[str, Any]]:
+    return _control("nodes")
+
+
+def list_objects(limit: int = 10000, **_: Any) -> List[Dict[str, Any]]:
+    return _control("list_objects", limit)
+
+
+def list_placement_groups(**_: Any) -> List[Dict[str, Any]]:
+    return _control("list_placement_groups")
+
+
+def list_jobs(**_: Any) -> List[Dict[str, Any]]:
+    return _control("list_jobs")
+
+
+def summarize_tasks(**_: Any) -> Dict[str, Dict[str, int]]:
+    """name -> {state -> count} (reference: api.py summarize_tasks)."""
+    return _control("summarize_tasks")
+
+
+def summarize_actors(**_: Any) -> Dict[str, Dict[str, int]]:
+    out: Dict[str, Dict[str, int]] = {}
+    for a in list_actors():
+        per = out.setdefault(a.get("class_name") or "<unknown>", {})
+        per[a["state"]] = per.get(a["state"], 0) + 1
+    return out
+
+
+def get_task(task_id: str) -> Optional[Dict[str, Any]]:
+    for t in list_tasks():
+        if t["task_id"] == task_id:
+            return t
+    return None
+
+
+def get_actor(actor_id: str) -> Optional[Dict[str, Any]]:
+    for a in list_actors():
+        if a["actor_id"] == actor_id:
+            return a
+    return None
+
+
+class profile_span:
+    """Context manager recording a user span onto the timeline
+    (reference: ray.profiling / ProfileEvent, core_worker/profile_event.h).
+
+    Example::
+
+        with state.profile_span("load_batch", category="data"):
+            ...
+    """
+
+    def __init__(self, name: str, category: str = "user",
+                 pid: str = "user", tid: Optional[str] = None,
+                 extra: Optional[Dict[str, Any]] = None):
+        import os
+        import threading
+        self.name = name
+        self.category = category
+        self.pid = pid
+        self.tid = tid or f"pid:{os.getpid()}:{threading.get_ident() % 10000}"
+        self.extra = extra
+
+    def __enter__(self):
+        import time
+        self._start = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+        _control("add_profile_span", self.name, self.category, self._start,
+                 time.time(), self.pid, self.tid, self.extra)
+        return False
+
+
+def timeline(filename: Optional[str] = None) -> str:
+    """Chrome-trace JSON of task execution (reference: `ray timeline`,
+    _private/state.py:471 chrome_tracing_dump). Returns the JSON string and
+    optionally writes it to ``filename``."""
+    trace = _control("timeline")
+    payload = json.dumps(trace)
+    if filename:
+        with open(filename, "w") as f:
+            f.write(payload)
+    return payload

@@ -1,0 +1,185 @@
+"""State API, task events, user metrics, timeline tests.
+
+Reference analogs: python/ray/tests/test_state_api.py, test_metrics_agent.py,
+test_task_events.py.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import state as state_api
+
+
+@ray_tpu.remote
+def quick(x):
+    return x + 1
+
+
+@ray_tpu.remote
+def failing():
+    raise RuntimeError("intentional")
+
+
+@ray_tpu.remote
+class StatefulThing:
+    def ping(self):
+        return "pong"
+
+
+class TestStateAPI:
+    def test_list_tasks_records_lifecycle(self, ray_start):
+        ref = quick.remote(1)
+        assert ray_tpu.get(ref) == 2
+        time.sleep(0.1)
+        tasks = state_api.list_tasks()
+        mine = [t for t in tasks if t["name"].startswith("quick")]
+        assert mine, f"no quick task in {tasks[:3]}"
+        done = [t for t in mine if t["state"] == "FINISHED"]
+        assert done
+        ev = done[-1]
+        assert ev["state_times"].get("RUNNING") is not None
+        assert ev["state_times"]["FINISHED"] >= ev["state_times"]["RUNNING"]
+
+    def test_failed_task_records_error(self, ray_start):
+        ref = failing.remote()
+        with pytest.raises(Exception):
+            ray_tpu.get(ref)
+        time.sleep(0.1)
+        failed = state_api.list_tasks(filters=[("state", "=", "FAILED")])
+        assert any("intentional" in (t["error_message"] or "")
+                   for t in failed)
+
+    def test_list_actors_and_summary(self, ray_start):
+        h = StatefulThing.remote()
+        assert ray_tpu.get(h.ping.remote()) == "pong"
+        actors = state_api.list_actors()
+        assert any(a["class_name"] == "StatefulThing" and a["state"] == "ALIVE"
+                   for a in actors)
+        summary = state_api.summarize_actors()
+        assert summary.get("StatefulThing", {}).get("ALIVE", 0) >= 1
+
+    def test_list_nodes_objects_jobs_pgs(self, ray_start):
+        ref = ray_tpu.put(b"x" * 10)
+        nodes = state_api.list_nodes()
+        assert nodes and nodes[0]["is_head"]
+        objects = state_api.list_objects()
+        assert any(o["object_id"] == ref.hex() for o in objects)
+        jobs = state_api.list_jobs()
+        assert len(jobs) >= 1
+        pg = ray_tpu.placement_group([{"CPU": 1}])
+        assert pg.ready(timeout=10)
+        pgs = state_api.list_placement_groups()
+        assert any(p["placement_group_id"] == pg.id.hex() for p in pgs)
+        ray_tpu.remove_placement_group(pg)
+
+    def test_summarize_tasks(self, ray_start):
+        ray_tpu.get([quick.remote(i) for i in range(3)])
+        time.sleep(0.1)
+        summary = state_api.summarize_tasks()
+        q = [v for k, v in summary.items() if k.startswith("quick")]
+        assert q and q[0].get("FINISHED", 0) >= 3
+
+    def test_state_api_from_worker(self, ray_start):
+        @ray_tpu.remote
+        def introspect():
+            from ray_tpu.util import state
+            return len(state.list_nodes())
+
+        assert ray_tpu.get(introspect.remote()) >= 1
+
+
+class TestTimeline:
+    def test_timeline_chrome_trace(self, ray_start, tmp_path):
+        ray_tpu.get([quick.remote(i) for i in range(2)])
+        time.sleep(0.1)
+        out = tmp_path / "trace.json"
+        payload = ray_tpu.timeline(str(out))
+        trace = json.loads(payload)
+        assert isinstance(trace, list) and trace
+        ev = [e for e in trace if e["ph"] == "X" and e["cat"] == "task"]
+        assert ev
+        assert {"name", "ts", "dur", "pid", "tid"} <= set(ev[0])
+        assert json.loads(out.read_text()) == trace
+
+
+class TestProfileSpan:
+    def test_user_span_in_timeline(self, ray_start):
+        with state_api.profile_span("my_phase", category="demo"):
+            time.sleep(0.01)
+        trace = json.loads(ray_tpu.timeline())
+        spans = [e for e in trace if e["name"] == "my_phase"]
+        assert spans and spans[0]["cat"] == "demo"
+        assert spans[0]["dur"] >= 10_000  # >= 10ms in microseconds
+
+    def test_span_from_worker(self, ray_start):
+        @ray_tpu.remote
+        def traced():
+            from ray_tpu.util import state
+            with state.profile_span("inner_work"):
+                time.sleep(0.01)
+            return True
+
+        assert ray_tpu.get(traced.remote())
+        trace = json.loads(ray_tpu.timeline())
+        assert any(e["name"] == "inner_work" for e in trace)
+
+
+class TestMetrics:
+    def setup_method(self):
+        metrics_mod._reset_for_tests()
+
+    def test_counter_gauge_histogram(self, ray_start):
+        c = metrics_mod.Counter("test_requests_total", "reqs",
+                                tag_keys=("route",))
+        c.inc(tags={"route": "/a"})
+        c.inc(2.0, tags={"route": "/a"})
+        g = metrics_mod.Gauge("test_queue_depth", "depth")
+        g.set(7)
+        h = metrics_mod.Histogram("test_latency_s", "lat",
+                                  boundaries=[0.1, 1.0])
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = metrics_mod.prometheus_text()
+        assert 'test_requests_total{route="/a"} 3.0' in text
+        assert "test_queue_depth 7.0" in text
+        assert 'test_latency_s_bucket{le="0.1"} 1.0' in text
+        assert 'test_latency_s_bucket{le="+Inf"} 3.0' in text
+        assert "test_latency_s_count 3.0" in text
+        assert "# TYPE test_requests_total counter" in text
+
+    def test_counter_validation(self, ray_start):
+        c = metrics_mod.Counter("test_val_total", tag_keys=("k",))
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        with pytest.raises(ValueError):
+            c.inc(tags={"bogus": "x"})
+
+    def test_metrics_http_server(self, ray_start):
+        metrics_mod.Gauge("test_http_gauge").set(1.5)
+        port = metrics_mod.start_metrics_server(0)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "test_http_gauge 1.5" in body
+
+    def test_worker_metrics_flow_to_driver(self, ray_start):
+        @ray_tpu.remote
+        def work():
+            from ray_tpu.util import metrics
+            c = metrics.Counter("test_worker_side_total")
+            c.inc(5.0)
+            metrics.flush()
+            return True
+
+        assert ray_tpu.get(work.remote())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "test_worker_side_total 5.0" in metrics_mod.prometheus_text():
+                break
+            time.sleep(0.2)
+        assert "test_worker_side_total 5.0" in metrics_mod.prometheus_text()
