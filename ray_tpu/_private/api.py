@@ -208,6 +208,36 @@ class ActorMethod:
         refs = [ObjectRef(oid) for oid in return_ids]
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node for this method call (reference: dag/dag_node.py —
+        actor_method.bind builds a ClassMethodNode)."""
+        from ray_tpu.dag import ClassMethodNode
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
+
+class _RayCallMethod:
+    """``actor.__ray_call__.remote(fn, *args)`` runs fn(instance, *args) on
+    the actor's worker (reference: ActorHandle.__ray_call__)."""
+
+    def __init__(self, handle: "ActorHandle"):
+        self._handle = handle
+
+    def remote(self, fn, *args, **kwargs) -> "ObjectRef":
+        rt = _require_runtime()
+        task_id = TaskID.of(self._handle._actor_id)
+        return_ids = [ObjectID.of(task_id, 0)]
+        spec = TaskSpec(
+            task_id=task_id,
+            name=f"{self._handle._class_name}.__ray_call__",
+            fn_blob=serialization.dumps_control(fn), method_name=None,
+            arg_descs=[_pack_arg(a) for a in args],
+            kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
+            return_ids=return_ids, resources=ResourceSet(),
+            actor_id=self._handle._actor_id,
+            max_concurrency=self._handle._max_concurrency)
+        rt.submit_spec(spec)
+        return ObjectRef(return_ids[0])
+
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str = "",
@@ -217,6 +247,8 @@ class ActorHandle:
         self._max_concurrency = max_concurrency
 
     def __getattr__(self, name: str) -> ActorMethod:
+        if name == "__ray_call__":
+            return _RayCallMethod(self)
         if name.startswith("_"):
             raise AttributeError(name)
         return ActorMethod(self, name)

@@ -109,6 +109,8 @@ class TaskEventBuffer:
 
     def snapshot(self, filters: Optional[Dict[str, Any]] = None,
                  limit: int = 10000) -> List[Dict[str, Any]]:
+        if limit <= 0:
+            return []
         with self._lock:
             events = [e.to_dict() for e in self._events.values()]
         if filters:

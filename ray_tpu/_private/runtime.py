@@ -478,12 +478,10 @@ class Runtime:
             except Exception:
                 pass
             self.events.record(msg.task_id.hex(), FAILED, error_message=err)
-        else:
-            self.events.record(msg.task_id.hex(), FINISHED)
-        if msg.error is not None:
             for oid in (spec.return_ids if spec else [r[0] for r in msg.results]):
                 self.mark_ready(oid, msg.error)
         else:
+            self.events.record(msg.task_id.hex(), FINISHED)
             for oid, desc in msg.results:
                 self.mark_ready(oid, desc)
         if spec is not None and spec.create_actor_id is None:

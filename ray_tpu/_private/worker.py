@@ -313,8 +313,14 @@ class WorkerLoop:
                     raise RuntimeError(
                         f"actor __init__ failed: {cause!r}" if cause
                         else "actor instance not initialized")
-                method = getattr(self.actor_instance, spec.method_name)
-                out = method(*args, **kwargs)
+                if spec.method_name is None and spec.fn_blob is not None:
+                    # __ray_call__-style apply: run fn(actor_instance, ...)
+                    # on the actor's worker (used by compiled DAG loops).
+                    fn = serialization.loads_control(spec.fn_blob)
+                    out = fn(self.actor_instance, *args, **kwargs)
+                else:
+                    method = getattr(self.actor_instance, spec.method_name)
+                    out = method(*args, **kwargs)
                 value_list = self._split_returns(out, spec)
             else:
                 fn = serialization.loads_control(spec.fn_blob)

@@ -1,0 +1,163 @@
+"""Compiled graphs: lazy DAGs of actor-method calls executed over channels.
+
+Reference: python/ray/dag/ — DAGNode (dag_node.py), InputNode/
+InputAttributeNode (input_node.py), ClassMethodNode, MultiOutputNode
+(output_node.py), ``experimental_compile`` (dag/compiled_dag_node.py:804
+CompiledDAG).  Interpreted ``execute`` submits ordinary actor tasks;
+compiled execution replaces per-call RPC with persistent per-actor loops
+exchanging messages over shared-memory channels (ray_tpu/dag/channel.py) —
+the ADAG model: plan once, push data through a static pipeline.
+
+Example::
+
+    with InputNode() as inp:
+        x = a.step.bind(inp)
+        y = b.step.bind(x)
+    dag = y.experimental_compile()
+    ref = dag.execute(batch)
+    out = ref.get()
+    dag.teardown()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .channel import ShmChannel
+from .compiled_dag import CompiledDAG
+
+__all__ = ["DAGNode", "InputNode", "InputAttributeNode", "ClassMethodNode",
+           "MultiOutputNode", "CompiledDAG", "ShmChannel"]
+
+
+class DAGNode:
+    """Base class for graph nodes.  Nodes are immutable once bound."""
+
+    def _upstream(self) -> List["DAGNode"]:
+        """Direct DAGNode dependencies of this node."""
+        return []
+
+    # -- interpreted execution --------------------------------------------
+
+    def execute(self, *args, **kwargs):
+        """Execute the DAG by submitting ordinary actor tasks; returns the
+        ObjectRef(s) of this node's result (reference: dag_node.py
+        execute)."""
+        memo: Dict[int, Any] = {}
+        return self._eval(memo, args, kwargs)
+
+    def _eval(self, memo: Dict[int, Any], args, kwargs):
+        key = id(self)
+        if key not in memo:
+            memo[key] = self._eval_impl(memo, args, kwargs)
+        return memo[key]
+
+    def _eval_impl(self, memo, args, kwargs):
+        raise NotImplementedError
+
+    # -- compiled execution ------------------------------------------------
+
+    def experimental_compile(self, *, buffer_size_bytes: int = 1 << 20,
+                             submit_timeout: float = 30.0) -> CompiledDAG:
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes,
+                           submit_timeout=submit_timeout)
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder; a context manager for bind-time use
+    (reference: dag/input_node.py)."""
+
+    def __init__(self):
+        self._attr_cache: Dict[Any, "InputAttributeNode"] = {}
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def __getitem__(self, key: int) -> "InputAttributeNode":
+        if key not in self._attr_cache:
+            self._attr_cache[key] = InputAttributeNode(self, key)
+        return self._attr_cache[key]
+
+    def __getattr__(self, key: str) -> "InputAttributeNode":
+        if key.startswith("_"):
+            raise AttributeError(key)
+        if key not in self._attr_cache:
+            self._attr_cache[key] = InputAttributeNode(self, key)
+        return self._attr_cache[key]
+
+    def _eval_impl(self, memo, args, kwargs):
+        if kwargs and not args:
+            return kwargs
+        if len(args) == 1 and not kwargs:
+            return args[0]
+        return args
+
+    @staticmethod
+    def extract(key: Any, args, kwargs):
+        """Value an InputAttributeNode yields for execute(*args, **kwargs)."""
+        if isinstance(key, int):
+            return args[key]
+        return kwargs[key]
+
+
+class InputAttributeNode(DAGNode):
+    """``inp[i]`` / ``inp.key`` — a positional/keyword slice of the input."""
+
+    def __init__(self, parent: InputNode, key: Any):
+        self._parent = parent
+        self._key = key
+
+    def _upstream(self) -> List[DAGNode]:
+        return [self._parent]
+
+    def _eval_impl(self, memo, args, kwargs):
+        return InputNode.extract(self._key, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    """A bound actor-method call (reference: dag/class_node.py)."""
+
+    def __init__(self, actor_handle, method_name: str,
+                 bound_args: Tuple, bound_kwargs: Dict[str, Any]):
+        self._actor = actor_handle
+        self._method = method_name
+        self._args = bound_args
+        self._kwargs = bound_kwargs
+
+    def _upstream(self) -> List[DAGNode]:
+        return ([a for a in self._args if isinstance(a, DAGNode)]
+                + [v for v in self._kwargs.values() if isinstance(v, DAGNode)])
+
+    def _eval_impl(self, memo, args, kwargs):
+        import ray_tpu
+        r_args = []
+        for a in self._args:
+            v = a._eval(memo, args, kwargs) if isinstance(a, DAGNode) else a
+            r_args.append(v)
+        r_kwargs = {}
+        for k, a in self._kwargs.items():
+            v = a._eval(memo, args, kwargs) if isinstance(a, DAGNode) else a
+            r_kwargs[k] = v
+        method = getattr(self._actor, self._method)
+        return method.remote(*r_args, **r_kwargs)
+
+    def __repr__(self):
+        return (f"ClassMethodNode({self._actor._class_name}."
+                f"{self._method})")
+
+
+class MultiOutputNode(DAGNode):
+    """Marks several nodes as the DAG outputs; execute returns a list
+    (reference: dag/output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        self._outputs = list(outputs)
+
+    def _upstream(self) -> List[DAGNode]:
+        return list(self._outputs)
+
+    def _eval_impl(self, memo, args, kwargs):
+        return [o._eval(memo, args, kwargs) for o in self._outputs]

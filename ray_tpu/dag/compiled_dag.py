@@ -1,0 +1,378 @@
+"""CompiledDAG: static plan + per-actor execution loops over shm channels.
+
+Reference: python/ray/dag/compiled_dag_node.py:804 (CompiledDAG — compile
+the bound DAG into ExecutableTasks per actor, allocate channels per edge,
+run a resident loop on each actor, drive I/O from the driver) and
+:2545 (execute).
+
+Differences from per-call actor RPC: the graph is planned once — argument
+routing, channel allocation, intra-actor locality — and each ``execute``
+only moves payload bytes through single-writer/single-reader channels.
+Capacity-1 channels give pipelined backpressure: stage k can work on
+iteration i+1 while stage k+1 still holds iteration i.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.exceptions import TaskError
+from .channel import FLAG_DATA, FLAG_ERR, FLAG_STOP, ShmChannel
+
+EdgeKey = Tuple[int, int]  # (producer node idx, consumer node idx; -1=driver)
+
+
+def _dag_actor_loop(instance, plan: Dict[str, Any]) -> int:
+    """Resident loop executed on the actor's worker via __ray_call__.
+
+    Each iteration: read every in-channel once, run this actor's steps in
+    topo order, write results to out-channels.  Errors are propagated as
+    FLAG_ERR payloads instead of crashing the pipeline; STOP propagates
+    downstream and ends the loop.
+    """
+    steps = plan["steps"]
+    in_channels: Dict[EdgeKey, ShmChannel] = plan["in_channels"]
+    out_channels: Dict[EdgeKey, ShmChannel] = plan["out_channels"]
+    in_order = sorted(in_channels)
+    iterations = 0
+    try:
+        while True:
+            chan_vals: Dict[EdgeKey, Any] = {}
+            chan_errs: Dict[EdgeKey, bytes] = {}
+            stop = False
+            for key in in_order:
+                flag, payload = in_channels[key].read()
+                if flag == FLAG_STOP:
+                    stop = True
+                elif flag == FLAG_ERR:
+                    chan_errs[key] = payload
+                else:
+                    chan_vals[key] = serialization.unpack_payload(payload)
+            if stop:
+                for chan in out_channels.values():
+                    chan.write(b"", FLAG_STOP)
+                return iterations
+            local_vals: Dict[int, Any] = {}
+            local_errs: Dict[int, bytes] = {}
+            for step in steps:
+                node_idx = step["node_idx"]
+                err: Optional[bytes] = None
+                args: List[Any] = []
+                kwargs: Dict[str, Any] = {}
+
+                def resolve(spec):
+                    nonlocal err
+                    kind, payload = spec
+                    if kind == "const":
+                        return payload
+                    if kind == "chan":
+                        if payload in chan_errs:
+                            err = err or chan_errs[payload]
+                            return None
+                        return chan_vals[payload]
+                    # kind == "local"
+                    if payload in local_errs:
+                        err = err or local_errs[payload]
+                        return None
+                    return local_vals[payload]
+
+                for spec in step["args"]:
+                    args.append(resolve(spec))
+                for k, spec in step["kwargs"].items():
+                    kwargs[k] = resolve(spec)
+                if err is None:
+                    try:
+                        method = getattr(instance, step["method"])
+                        out = method(*args, **kwargs)
+                        local_vals[node_idx] = out
+                    except BaseException as exc:  # noqa: BLE001 — forwarded
+                        import traceback
+                        err = serialization.pack_payload(
+                            TaskError(exc, step["method"],
+                                      traceback.format_exc()))
+                if err is not None:
+                    local_errs[node_idx] = err
+                    for key in step["writes"]:
+                        out_channels[key].write(err, FLAG_ERR)
+                else:
+                    payload = serialization.pack_payload(local_vals[node_idx])
+                    for key in step["writes"]:
+                        out_channels[key].write(payload, FLAG_DATA)
+            iterations += 1
+    finally:
+        for chan in list(in_channels.values()) + list(out_channels.values()):
+            chan.close()
+
+
+class CompiledDAGRef:
+    """Future for one compiled execution (reference: CompiledDAGRef)."""
+
+    def __init__(self, dag: "CompiledDAG", index: int):
+        self._dag = dag
+        self._index = index
+        self._value: Any = None
+        self._fetched = False
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._fetched:
+            self._value = self._dag._fetch(self._index, timeout)
+            self._fetched = True
+        if isinstance(self._value, Exception):
+            raise self._value
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, output_node, *, buffer_size_bytes: int = 1 << 20,
+                 submit_timeout: float = 30.0):
+        from . import (ClassMethodNode, DAGNode, InputAttributeNode,
+                       InputNode, MultiOutputNode)
+        self._buffer = buffer_size_bytes
+        self._submit_timeout = submit_timeout
+        self._lock = threading.Lock()
+        self._torn_down = False
+        self._next_execute = 0
+        self._next_fetch = 0
+        self._fetched: Dict[int, Any] = {}
+
+        # ---- topo order over reachable nodes --------------------------- #
+        order: List[Any] = []
+        seen: Dict[int, int] = {}
+        on_path: set = set()
+
+        def visit(node):
+            nid = id(node)
+            if nid in seen:
+                return
+            if nid in on_path:
+                raise ValueError("cycle detected in DAG")
+            on_path.add(nid)
+            for up in node._upstream():
+                visit(up)
+            on_path.discard(nid)
+            seen[nid] = len(order)
+            order.append(node)
+
+        visit(output_node)
+        idx_of = {id(n): i for i, n in enumerate(order)}
+
+        terminals: List[Any]
+        if isinstance(output_node, MultiOutputNode):
+            terminals = output_node._outputs
+        else:
+            terminals = [output_node]
+        if len({id(t) for t in terminals}) != len(terminals):
+            raise ValueError("duplicate node in MultiOutputNode outputs")
+        for t in terminals:
+            if not isinstance(t, ClassMethodNode):
+                raise ValueError(
+                    "compiled DAG outputs must be actor method calls, got "
+                    f"{type(t).__name__}")
+        compute_nodes = [n for n in order if isinstance(n, ClassMethodNode)]
+        if not compute_nodes:
+            raise ValueError("DAG contains no actor method calls")
+        for n in order:
+            if isinstance(n, MultiOutputNode) and n is not output_node:
+                raise ValueError("MultiOutputNode must be the DAG output")
+
+        # Every compute node must (transitively) depend on the input so each
+        # actor loop is triggered exactly once per execute.
+        reaches_input: Dict[int, bool] = {}
+
+        def check_reach(node) -> bool:
+            nid = id(node)
+            if nid in reaches_input:
+                return reaches_input[nid]
+            if isinstance(node, (InputNode, InputAttributeNode)):
+                reaches_input[nid] = True
+                return True
+            r = any(check_reach(u) for u in node._upstream())
+            reaches_input[nid] = r
+            return r
+
+        for n in compute_nodes:
+            if not check_reach(n):
+                raise ValueError(
+                    f"{n!r} does not depend on the InputNode; every compiled "
+                    "task needs a per-iteration trigger")
+
+        # ---- plan edges ------------------------------------------------- #
+        # (prod_idx, cons_idx) -> ShmChannel for cross-process edges.
+        self._channels: Dict[EdgeKey, ShmChannel] = {}
+        # input-producing nodes the driver must feed per edge.
+        self._input_edges: List[Tuple[EdgeKey, Any]] = []  # (key, node)
+        actor_of = {}  # node idx -> actor handle (by actor_id)
+        for n in compute_nodes:
+            actor_of[idx_of[id(n)]] = n._actor
+
+        plans: Dict[bytes, Dict[str, Any]] = {}  # actor_id bits -> plan
+
+        def plan_for(actor) -> Dict[str, Any]:
+            key = actor._actor_id.binary()
+            if key not in plans:
+                plans[key] = {"actor": actor, "steps": [],
+                              "in_channels": {}, "out_channels": {}}
+            return plans[key]
+
+        def make_channel(ekey: EdgeKey) -> ShmChannel:
+            if ekey not in self._channels:
+                self._channels[ekey] = ShmChannel(self._buffer)
+            return self._channels[ekey]
+
+        for n in compute_nodes:
+            cons_idx = idx_of[id(n)]
+            plan = plan_for(n._actor)
+            arg_specs: List[Tuple[str, Any]] = []
+            kwarg_specs: Dict[str, Tuple[str, Any]] = {}
+
+            def spec_for(a):
+                from . import DAGNode as _DN
+                if not isinstance(a, _DN):
+                    return ("const", a)
+                prod_idx = idx_of[id(a)]
+                if isinstance(a, (InputNode, InputAttributeNode)):
+                    ekey = (prod_idx, cons_idx)
+                    chan = make_channel(ekey)
+                    plan["in_channels"][ekey] = chan
+                    if all(k != ekey for k, _ in self._input_edges):
+                        self._input_edges.append((ekey, a))
+                    return ("chan", ekey)
+                # producer is a ClassMethodNode
+                prod_actor = actor_of[prod_idx]
+                if prod_actor._actor_id == n._actor._actor_id:
+                    return ("local", prod_idx)
+                ekey = (prod_idx, cons_idx)
+                chan = make_channel(ekey)
+                plan["in_channels"][ekey] = chan
+                plan_for(prod_actor)["out_channels"][ekey] = chan
+                plan_for(prod_actor)  # ensure exists
+                return ("chan", ekey)
+
+            for a in n._args:
+                arg_specs.append(spec_for(a))
+            for k, a in n._kwargs.items():
+                kwarg_specs[k] = spec_for(a)
+            plan["steps"].append({
+                "node_idx": cons_idx, "method": n._method,
+                "args": arg_specs, "kwargs": kwarg_specs, "writes": [],
+            })
+
+        # Producer "writes" lists: fill after all edges are known.
+        for ekey in self._channels:
+            prod_idx, cons_idx = ekey
+            if prod_idx in actor_of:  # produced by an actor step
+                plan = plan_for(actor_of[prod_idx])
+                for step in plan["steps"]:
+                    if step["node_idx"] == prod_idx and ekey not in step["writes"]:
+                        step["writes"].append(ekey)
+
+        # Output edges: terminal -> driver.
+        self._output_keys: List[EdgeKey] = []
+        for t in terminals:
+            t_idx = idx_of[id(t)]
+            ekey = (t_idx, -1)
+            chan = make_channel(ekey)
+            plan = plan_for(t._actor)
+            plan["out_channels"][ekey] = chan
+            for step in plan["steps"]:
+                if step["node_idx"] == t_idx and ekey not in step["writes"]:
+                    step["writes"].append(ekey)
+            self._output_keys.append(ekey)
+        self._multi_output = isinstance(output_node, MultiOutputNode)
+
+        # Steps already appended in topo order (compute_nodes follows
+        # `order`). Launch the loops.
+        self._loop_refs = []
+        for plan in plans.values():
+            actor = plan.pop("actor")
+            self._loop_refs.append(
+                actor.__ray_call__.remote(_dag_actor_loop, plan))
+
+    # ------------------------------------------------------------------ #
+
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        from . import InputNode
+        with self._lock:
+            if self._torn_down:
+                raise RuntimeError("compiled DAG has been torn down")
+            for ekey, node in self._input_edges:
+                if isinstance(node, InputNode):
+                    value = node._eval_impl(None, args, kwargs)
+                else:
+                    value = InputNode.extract(node._key, args, kwargs)
+                # Bounded wait: if the pipeline is saturated because results
+                # were never fetched, fail with guidance instead of
+                # deadlocking under the lock.
+                try:
+                    self._channels[ekey].write(
+                        serialization.pack_payload(value), FLAG_DATA,
+                        timeout=self._submit_timeout)
+                except TimeoutError as e:
+                    raise RuntimeError(
+                        "compiled DAG pipeline is full — call .get() on "
+                        "earlier CompiledDAGRefs before submitting more "
+                        "executions") from e
+            index = self._next_execute
+            self._next_execute += 1
+        return CompiledDAGRef(self, index)
+
+    def _fetch(self, index: int, timeout: Optional[float]) -> Any:
+        with self._lock:
+            return self._fetch_locked(index, timeout)
+
+    def _fetch_locked(self, index: int, timeout: Optional[float]) -> Any:
+        if index in self._fetched:
+            return self._fetched.pop(index)
+        while self._next_fetch <= index:
+            results = []
+            error: Optional[Exception] = None
+            for ekey in self._output_keys:
+                flag, payload = self._channels[ekey].read(timeout)
+                if flag == FLAG_ERR:
+                    error = error or serialization.unpack_payload(payload)
+                    results.append(None)
+                elif flag == FLAG_STOP:
+                    error = error or RuntimeError("DAG torn down")
+                    results.append(None)
+                else:
+                    results.append(serialization.unpack_payload(payload))
+            value: Any = error if error is not None else (
+                results if self._multi_output else results[0])
+            self._fetched[self._next_fetch] = value
+            self._next_fetch += 1
+        return self._fetched.pop(index)
+
+    def teardown(self) -> None:
+        import ray_tpu
+        with self._lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+            # Drain unfetched results so STOP can flow through capacity-1
+            # channels without blocking on stale payloads.
+            try:
+                while self._next_fetch < self._next_execute:
+                    self._fetch_locked(self._next_fetch, timeout=5.0)
+            except Exception:
+                pass
+            for ekey, _node in self._input_edges:
+                try:
+                    self._channels[ekey].write(b"", FLAG_STOP, timeout=5.0)
+                except Exception:
+                    pass
+        try:
+            ray_tpu.get(self._loop_refs, timeout=10.0)
+        except Exception:
+            pass
+        for chan in self._channels.values():
+            chan.close()
+            chan.unlink()
+
+    def __del__(self):
+        try:
+            if not self._torn_down:
+                self.teardown()
+        except Exception:
+            pass

@@ -48,11 +48,17 @@ class Metric:
         self._values: Dict[Tuple[Tuple[str, str], ...], Any] = {}
         with _registry_lock:
             existing = _registry.get(name)
-            if existing is not None and existing.metric_type != self.metric_type:
-                raise ValueError(
-                    f"metric {name!r} already registered as "
-                    f"{existing.metric_type}")
-            _registry[name] = self
+            if existing is not None:
+                if existing.metric_type != self.metric_type:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.metric_type}")
+                # Same-name metrics aggregate (Ray semantics): share the
+                # canonical instance's state so no recorded value is lost.
+                self._values = existing._values
+                self._lock = existing._lock
+            else:
+                _registry[name] = self
         _ensure_flusher()
 
     @property
@@ -270,8 +276,8 @@ def start_metrics_server(port: int = 0):
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.rstrip("/") in ("", "/metrics".rstrip("/")) or \
-                    self.path == "/metrics":
+            # Serve at both / and /metrics (trailing slash tolerated).
+            if self.path.rstrip("/") in ("", "/metrics"):
                 body = prometheus_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type",

@@ -1,0 +1,197 @@
+"""Compiled graph tests (interpreted + compiled execution over channels).
+
+Reference analogs: python/ray/dag/tests/experimental/test_accelerated_dag.py
+(compile, execute, multi-output, error propagation, teardown) and
+python/ray/tests/test_channel.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.dag.channel import (FLAG_DATA, FLAG_STOP, ChannelTimeoutError,
+                                 ShmChannel)
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, inc=1):
+        self.inc = inc
+        self.calls = 0
+
+    def add(self, x):
+        self.calls += 1
+        return x + self.inc
+
+    def add2(self, a, b):
+        return a + b
+
+    def boom(self, x):
+        raise ValueError("kapow")
+
+    def num_calls(self):
+        return self.calls
+
+
+class TestShmChannel:
+    def test_roundtrip(self):
+        ch = ShmChannel(1024)
+        ch.write(b"hello")
+        flag, data = ch.read()
+        assert flag == FLAG_DATA and data == b"hello"
+        ch.write(b"", FLAG_STOP)
+        flag, _ = ch.read()
+        assert flag == FLAG_STOP
+        ch.close()
+        ch.unlink()
+
+    def test_backpressure_and_timeout(self):
+        ch = ShmChannel(64)
+        ch.write(b"one")
+        with pytest.raises(ChannelTimeoutError):
+            ch.write(b"two", timeout=0.05)
+        assert ch.read()[1] == b"one"
+        ch.write(b"two")
+        assert ch.read()[1] == b"two"
+        with pytest.raises(ValueError):
+            ch.write(b"x" * 65)
+        ch.close()
+        ch.unlink()
+
+
+class TestInterpretedDag:
+    def test_chain(self, ray_start):
+        a = Adder.remote(1)
+        b = Adder.remote(10)
+        with InputNode() as inp:
+            dag = b.add.bind(a.add.bind(inp))
+        ref = dag.execute(5)
+        assert ray_tpu.get(ref) == 16
+
+    def test_multi_output_and_input_attr(self, ray_start):
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        with InputNode() as inp:
+            dag = MultiOutputNode([a.add.bind(inp[0]), b.add.bind(inp[1])])
+        refs = dag.execute(10, 20)
+        assert ray_tpu.get(refs) == [11, 22]
+
+
+class TestCompiledDag:
+    def test_linear_pipeline(self, ray_start):
+        a = Adder.remote(1)
+        b = Adder.remote(10)
+        with InputNode() as inp:
+            dag = b.add.bind(a.add.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            for i in range(5):
+                assert compiled.execute(i).get(timeout=10) == i + 11
+        finally:
+            compiled.teardown()
+
+    def test_fan_out_fan_in(self, ray_start):
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        c = Adder.remote(0)
+        with InputNode() as inp:
+            x = a.add.bind(inp)
+            y = b.add.bind(inp)
+            dag = c.add2.bind(x, y)
+        compiled = dag.experimental_compile()
+        try:
+            # (5+1) + (5+2) = 13
+            assert compiled.execute(5).get(timeout=10) == 13
+            assert compiled.execute(0).get(timeout=10) == 3
+        finally:
+            compiled.teardown()
+
+    def test_multi_output(self, ray_start):
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        with InputNode() as inp:
+            dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(1).get(timeout=10) == [2, 3]
+        finally:
+            compiled.teardown()
+
+    def test_intra_actor_locality(self, ray_start):
+        # Two stages on the same actor: values pass locally, no channel.
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(a.add.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(0).get(timeout=10) == 2
+            assert len(compiled._channels) == 2  # input edge + output edge
+        finally:
+            compiled.teardown()
+
+    def test_pipelined_executions(self, ray_start):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            refs = [compiled.execute(i) for i in range(2)]
+            assert [r.get(timeout=10) for r in refs] == [1, 2]
+        finally:
+            compiled.teardown()
+
+    def test_error_propagation_keeps_pipeline_alive(self, ray_start):
+        a = Adder.remote(1)
+        b = Adder.remote(1)
+        with InputNode() as inp:
+            dag = b.add.bind(a.boom.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            with pytest.raises(Exception, match="kapow"):
+                compiled.execute(1).get(timeout=10)
+            # The loop survives an application error.
+            with pytest.raises(Exception, match="kapow"):
+                compiled.execute(2).get(timeout=10)
+        finally:
+            compiled.teardown()
+
+    def test_numpy_payload(self, ray_start):
+        a = Adder.remote(1.0)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile(buffer_size_bytes=1 << 22)
+        try:
+            arr = np.ones((256, 256), np.float32)
+            out = compiled.execute(arr).get(timeout=15)
+            np.testing.assert_allclose(out, arr + 1.0)
+        finally:
+            compiled.teardown()
+
+    def test_actor_usable_after_teardown(self, ray_start):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+        assert compiled.execute(1).get(timeout=10) == 2
+        compiled.teardown()
+        # Loop has exited; the actor serves normal calls again.
+        assert ray_tpu.get(a.add.remote(41)) == 42
+        with pytest.raises(RuntimeError):
+            compiled.execute(1)
+
+    def test_compile_validations(self, ray_start):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag_no_input = a.add.bind(7)
+        with pytest.raises(ValueError, match="depend on the InputNode"):
+            dag_no_input.experimental_compile()
+
+
+class TestRayCall:
+    def test_ray_call_apply(self, ray_start):
+        a = Adder.remote(5)
+        ref = a.__ray_call__.remote(lambda self, k: self.inc * k, 4)
+        assert ray_tpu.get(ref) == 20
