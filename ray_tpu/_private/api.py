@@ -191,28 +191,35 @@ class ActorMethod:
                            opts.get("num_returns", self._num_returns))
 
     def remote(self, *args, **kwargs):
-        rt = _require_runtime()
-        task_id = TaskID.of(self._handle._actor_id)
-        return_ids = [ObjectID.of(task_id, i)
-                      for i in range(self._num_returns)]
-        spec = TaskSpec(
-            task_id=task_id,
-            name=f"{self._handle._class_name}.{self._name}",
-            fn_blob=None, method_name=self._name,
-            arg_descs=[_pack_arg(a) for a in args],
-            kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
-            return_ids=return_ids, resources=ResourceSet(),
-            actor_id=self._handle._actor_id,
-            max_concurrency=self._handle._max_concurrency)
-        rt.submit_spec(spec)
-        refs = [ObjectRef(oid) for oid in return_ids]
-        return refs[0] if self._num_returns == 1 else refs
+        return _submit_actor_task(
+            self._handle, method_name=self._name, fn_blob=None,
+            args=args, kwargs=kwargs, num_returns=self._num_returns)
 
     def bind(self, *args, **kwargs):
         """Lazy DAG node for this method call (reference: dag/dag_node.py —
         actor_method.bind builds a ClassMethodNode)."""
         from ray_tpu.dag import ClassMethodNode
         return ClassMethodNode(self._handle, self._name, args, kwargs)
+
+
+def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
+                       args, kwargs, num_returns: int):
+    """Shared submit path for actor methods and __ray_call__ applies."""
+    rt = _require_runtime()
+    task_id = TaskID.of(handle._actor_id)
+    return_ids = [ObjectID.of(task_id, i) for i in range(num_returns)]
+    spec = TaskSpec(
+        task_id=task_id,
+        name=f"{handle._class_name}.{method_name or '__ray_call__'}",
+        fn_blob=fn_blob, method_name=method_name,
+        arg_descs=[_pack_arg(a) for a in args],
+        kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
+        return_ids=return_ids, resources=ResourceSet(),
+        actor_id=handle._actor_id,
+        max_concurrency=handle._max_concurrency)
+    rt.submit_spec(spec)
+    refs = [ObjectRef(oid) for oid in return_ids]
+    return refs[0] if num_returns == 1 else refs
 
 
 class _RayCallMethod:
@@ -223,20 +230,10 @@ class _RayCallMethod:
         self._handle = handle
 
     def remote(self, fn, *args, **kwargs) -> "ObjectRef":
-        rt = _require_runtime()
-        task_id = TaskID.of(self._handle._actor_id)
-        return_ids = [ObjectID.of(task_id, 0)]
-        spec = TaskSpec(
-            task_id=task_id,
-            name=f"{self._handle._class_name}.__ray_call__",
-            fn_blob=serialization.dumps_control(fn), method_name=None,
-            arg_descs=[_pack_arg(a) for a in args],
-            kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
-            return_ids=return_ids, resources=ResourceSet(),
-            actor_id=self._handle._actor_id,
-            max_concurrency=self._handle._max_concurrency)
-        rt.submit_spec(spec)
-        return ObjectRef(return_ids[0])
+        return _submit_actor_task(
+            self._handle, method_name=None,
+            fn_blob=serialization.dumps_control(fn),
+            args=args, kwargs=kwargs, num_returns=1)
 
 
 class ActorHandle:

@@ -247,7 +247,6 @@ class CompiledDAG:
                 chan = make_channel(ekey)
                 plan["in_channels"][ekey] = chan
                 plan_for(prod_actor)["out_channels"][ekey] = chan
-                plan_for(prod_actor)  # ensure exists
                 return ("chan", ekey)
 
             for a in n._args:
@@ -320,29 +319,76 @@ class CompiledDAG:
 
     def _fetch(self, index: int, timeout: Optional[float]) -> Any:
         with self._lock:
-            return self._fetch_locked(index, timeout)
-
-    def _fetch_locked(self, index: int, timeout: Optional[float]) -> Any:
-        if index in self._fetched:
+            if index in self._fetched:
+                return self._fetched.pop(index)
+            if self._torn_down and self._next_fetch > index:
+                raise RuntimeError(
+                    "compiled DAG was torn down before this result was "
+                    "fetched")
+            while self._next_fetch <= index:
+                self._advance(timeout)
             return self._fetched.pop(index)
-        while self._next_fetch <= index:
-            results = []
-            error: Optional[Exception] = None
-            for ekey in self._output_keys:
-                flag, payload = self._channels[ekey].read(timeout)
-                if flag == FLAG_ERR:
-                    error = error or serialization.unpack_payload(payload)
-                    results.append(None)
-                elif flag == FLAG_STOP:
-                    error = error or RuntimeError("DAG torn down")
-                    results.append(None)
-                else:
-                    results.append(serialization.unpack_payload(payload))
-            value: Any = error if error is not None else (
-                results if self._multi_output else results[0])
-            self._fetched[self._next_fetch] = value
-            self._next_fetch += 1
-        return self._fetched.pop(index)
+
+    def _check_loops_alive(self) -> None:
+        """Surface actor-loop death instead of spinning forever."""
+        import ray_tpu
+        done, _ = ray_tpu.wait(self._loop_refs,
+                               num_returns=len(self._loop_refs), timeout=0)
+        if done and not self._torn_down:
+            try:
+                ray_tpu.get(done)
+            except Exception as e:
+                raise RuntimeError(
+                    f"a compiled DAG actor loop died: {e!r}") from e
+            raise RuntimeError(
+                "a compiled DAG actor loop exited unexpectedly")
+
+    def _advance(self, timeout: Optional[float]) -> None:
+        """Read one full iteration's outputs into ``_fetched``.
+
+        Partially-read outputs are staged in ``_partial`` so a timeout
+        midway never desyncs the channels: a retry resumes with the
+        channels that were not yet read.  The timeout is a shared deadline
+        across all outputs, with liveness checks between bounded waits.
+        """
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        if not hasattr(self, "_partial"):
+            self._partial = {}
+        while len(self._partial) < len(self._output_keys):
+            pos = len(self._partial)
+            ekey = self._output_keys[pos]
+            if deadline is None:
+                slice_timeout = 1.0
+            else:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"timed out fetching compiled DAG output {pos}")
+                slice_timeout = min(1.0, remaining)
+            try:
+                flag, payload = self._channels[ekey].read(slice_timeout)
+            except TimeoutError:
+                self._check_loops_alive()
+                continue
+            self._partial[pos] = (flag, payload)
+        results = []
+        error: Optional[Exception] = None
+        for pos in range(len(self._output_keys)):
+            flag, payload = self._partial[pos]
+            if flag == FLAG_ERR:
+                error = error or serialization.unpack_payload(payload)
+                results.append(None)
+            elif flag == FLAG_STOP:
+                error = error or RuntimeError("DAG torn down")
+                results.append(None)
+            else:
+                results.append(serialization.unpack_payload(payload))
+        self._partial = {}
+        value: Any = error if error is not None else (
+            results if self._multi_output else results[0])
+        self._fetched[self._next_fetch] = value
+        self._next_fetch += 1
 
     def teardown(self) -> None:
         import ray_tpu
@@ -351,10 +397,11 @@ class CompiledDAG:
                 return
             self._torn_down = True
             # Drain unfetched results so STOP can flow through capacity-1
-            # channels without blocking on stale payloads.
+            # channels without blocking on stale payloads.  Drained values
+            # stay in _fetched so later ref.get() calls still succeed.
             try:
                 while self._next_fetch < self._next_execute:
-                    self._fetch_locked(self._next_fetch, timeout=5.0)
+                    self._advance(timeout=5.0)
             except Exception:
                 pass
             for ekey, _node in self._input_edges:

@@ -1,0 +1,36 @@
+"""ray_tpu.rl — reinforcement learning library (the RLlib equivalent).
+
+Reference: rllib/ — Algorithm/AlgorithmConfig (algorithms/algorithm.py:208),
+RLModule (core/rl_module/rl_module.py:260), Learner/LearnerGroup
+(core/learner/), EnvRunner(Group) (env/), replay buffers
+(utils/replay_buffers/).  JAX-first: modules are pure-function pytrees,
+learner updates are jit-compiled, and multi-learner data parallelism maps
+to gradient averaging (psum on a TPU mesh; actor tree-mean on CPU).
+
+Quick start::
+
+    from ray_tpu.rl import PPOConfig
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .training(lr=3e-4)
+            .build_algo())
+    for _ in range(10):
+        print(algo.train()["env_runners"]["episode_return_mean"])
+"""
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .dqn import DQN, DQNConfig
+from .env import CartPole, Env, StatelessGuess, VectorEnv, make_env, register_env
+from .env_runner import EnvRunner, EnvRunnerGroup
+from .learner import JaxLearner, LearnerGroup
+from .ppo import PPO, PPOConfig, compute_gae
+from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from .rl_module import DiscretePolicyModule, QModule, RLModuleSpec
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "Env", "CartPole", "StatelessGuess", "VectorEnv", "make_env",
+    "register_env", "EnvRunner", "EnvRunnerGroup", "JaxLearner",
+    "LearnerGroup", "ReplayBuffer", "PrioritizedReplayBuffer",
+    "DiscretePolicyModule", "QModule", "RLModuleSpec", "compute_gae",
+]

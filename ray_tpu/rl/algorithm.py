@@ -1,0 +1,175 @@
+"""Algorithm + AlgorithmConfig: the RL training driver.
+
+Reference: rllib/algorithms/algorithm.py:208 (Algorithm is a Trainable with
+``step:1169`` orchestrating ``training_step:2420``) and
+algorithm_config.py (builder-style AlgorithmConfig: .environment(),
+.env_runners(), .training(), .learners(), .build_algo()).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, Optional, Type
+
+from .env import make_env
+from .env_runner import EnvRunnerGroup
+from .rl_module import RLModuleSpec
+
+
+class AlgorithmConfig:
+    """Builder for algorithm hyperparameters (fluent API like the
+    reference: config.environment("CartPole-v1").training(lr=1e-3))."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self.env_spec: Any = None
+        self.num_env_runners = 0
+        self.num_envs_per_runner = 4
+        self.rollout_fragment_length = 128
+        self.num_learners = 0
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 512
+        self.seed = 0
+        self.module_hidden = (64, 64)
+        self.extra: Dict[str, Any] = {}
+
+    # -- fluent setters --------------------------------------------------- #
+
+    def environment(self, env: Any) -> "AlgorithmConfig":
+        self.env_spec = env
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None
+                 ) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def training(self, *, lr: Optional[float] = None,
+                 gamma: Optional[float] = None,
+                 train_batch_size: Optional[int] = None,
+                 **extra: Any) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        self.extra.update(extra)
+        return self
+
+    def rl_module(self, *, hidden=None) -> "AlgorithmConfig":
+        if hidden is not None:
+            self.module_hidden = tuple(hidden)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    # -- build ------------------------------------------------------------ #
+
+    def module_spec(self) -> RLModuleSpec:
+        probe = make_env(self.env_spec)
+        return RLModuleSpec(probe.observation_dim, probe.num_actions,
+                            tuple(self.module_hidden))
+
+    def build_algo(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use PPOConfig() etc.")
+        return self.algo_class(self)
+
+    # legacy alias (reference keeps .build around)
+    build = build_algo
+
+
+class Algorithm:
+    """Iterative trainer; subclass implements ``training_step``."""
+
+    # Off-policy algorithms that drive their own env loop (DQN) set this
+    # False to skip building the policy-rollout EnvRunnerGroup.
+    _use_env_runner_group = True
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._start = time.time()
+        self.env_runner_group: Optional[EnvRunnerGroup] = None
+        if self._use_env_runner_group:
+            self.env_runner_group = EnvRunnerGroup(
+                lambda: make_env(config.env_spec),
+                num_env_runners=config.num_env_runners,
+                num_envs_per_runner=config.num_envs_per_runner,
+                module_spec=config.module_spec(), seed=config.seed)
+        self.setup(config)
+
+    # -- subclass hooks ---------------------------------------------------- #
+
+    def setup(self, config: AlgorithmConfig) -> None:
+        pass
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- public API --------------------------------------------------------- #
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration (reference: Algorithm.step:1169)."""
+        t0 = time.time()
+        results = self.training_step()
+        self.iteration += 1
+        if self.env_runner_group is not None:
+            results.setdefault("env_runners",
+                               self.env_runner_group.aggregate_metrics())
+        results["training_iteration"] = self.iteration
+        results["time_this_iter_s"] = time.time() - t0
+        results["time_total_s"] = time.time() - self._start
+        return results
+
+    def get_weights(self):
+        raise NotImplementedError
+
+    def set_weights(self, params) -> None:
+        raise NotImplementedError
+
+    def save(self, checkpoint_dir: str) -> str:
+        """Reference: Checkpointable.save_to_path (rllib/utils/checkpoints)."""
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump({"weights": self.get_weights(),
+                         "iteration": self.iteration}, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.set_weights(state["weights"])
+        self.iteration = state["iteration"]
+        if self.env_runner_group is not None:
+            self.env_runner_group.sync_weights(state["weights"])
+
+    def stop(self) -> None:
+        if self.env_runner_group is not None:
+            self.env_runner_group.stop()

@@ -1,0 +1,189 @@
+"""DQN: off-policy Q-learning with replay and target network.
+
+Reference: rllib/algorithms/dqn/dqn.py (DQNConfig / DQN.training_step:
+sample -> store to replay -> sample minibatches -> TD update -> periodic
+target sync) with double-Q targets; prioritized replay optional.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import make_env
+from .env_runner import EnvRunner
+from .learner import JaxLearner
+from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from .rl_module import QModule
+
+
+def dqn_loss(module: QModule, params, batch):
+    import jax.numpy as jnp
+
+    q = module.q_values(params, batch["obs"])
+    q_taken = jnp.take_along_axis(
+        q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    td_error = q_taken - batch["targets"]
+    weights = batch.get("weights", jnp.ones_like(td_error))
+    loss = jnp.mean(weights * td_error ** 2)
+    return loss, {"td_error_mean": jnp.mean(jnp.abs(td_error)),
+                  "q_mean": jnp.mean(q_taken)}
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(DQN)
+        self.buffer_size = 50_000
+        self.prioritized_replay = False
+        self.learning_starts = 500
+        self.target_update_freq = 500  # in sampled env steps
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 5_000
+        self.double_q = True
+        self.train_batch_size = 64
+        self.updates_per_step = 1
+
+    def training(self, *, buffer_size=None, prioritized_replay=None,
+                 learning_starts=None, target_update_freq=None,
+                 epsilon_decay_steps=None, double_q=None,
+                 updates_per_step=None, **kw) -> "DQNConfig":
+        super().training(**kw)
+        if buffer_size is not None:
+            self.buffer_size = buffer_size
+        if prioritized_replay is not None:
+            self.prioritized_replay = prioritized_replay
+        if learning_starts is not None:
+            self.learning_starts = learning_starts
+        if target_update_freq is not None:
+            self.target_update_freq = target_update_freq
+        if epsilon_decay_steps is not None:
+            self.epsilon_decay_steps = epsilon_decay_steps
+        if double_q is not None:
+            self.double_q = double_q
+        if updates_per_step is not None:
+            self.updates_per_step = updates_per_step
+        return self
+
+
+class DQN(Algorithm):
+    """Single-process sampler (epsilon-greedy needs per-step control, so DQN
+    drives its own env loop instead of the policy-rollout EnvRunnerGroup)."""
+
+    _use_env_runner_group = False
+
+    def setup(self, config: DQNConfig) -> None:
+        import jax
+
+        spec = config.module_spec()
+        self.module = QModule(spec)
+        self.learner = JaxLearner(self.module, dqn_loss,
+                                  learning_rate=config.lr, seed=config.seed)
+        self.target_params = self.learner.params
+        if config.prioritized_replay:
+            self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                config.buffer_size, seed=config.seed)
+        else:
+            self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self.env = make_env(config.env_spec)
+        self._obs, _ = self.env.reset(seed=config.seed)
+        self._steps = 0
+        self._rng = np.random.default_rng(config.seed)
+        self._q_fn = jax.jit(self.module.q_values)
+        self._ep_return = 0.0
+        self._returns: list = []
+
+    # -- behavior policy --------------------------------------------------- #
+
+    def _epsilon(self) -> float:
+        cfg: DQNConfig = self.config
+        frac = min(1.0, self._steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def _act(self, obs: np.ndarray) -> int:
+        if self._rng.random() < self._epsilon():
+            return int(self._rng.integers(self.env.num_actions))
+        q = self._q_fn(self.learner.params, obs[None])
+        return int(np.argmax(np.asarray(q)[0]))
+
+    # -- training ----------------------------------------------------------- #
+
+    def _targets(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        import jax.numpy as jnp
+
+        cfg: DQNConfig = self.config
+        q_next_target = np.asarray(
+            self._q_fn(self.target_params, batch["next_obs"]))
+        if cfg.double_q:
+            q_next_online = np.asarray(
+                self._q_fn(self.learner.params, batch["next_obs"]))
+            best = np.argmax(q_next_online, axis=-1)
+        else:
+            best = np.argmax(q_next_target, axis=-1)
+        next_q = np.take_along_axis(q_next_target, best[:, None], -1)[:, 0]
+        return (batch["rewards"]
+                + cfg.gamma * (1.0 - batch["terminateds"]) * next_q
+                ).astype(np.float32)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: DQNConfig = self.config
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.rollout_fragment_length):
+            action = self._act(self._obs)
+            next_obs, r, term, trunc, _ = self.env.step(action)
+            self.buffer.add(
+                obs=self._obs[None], actions=np.array([action], np.int32),
+                rewards=np.array([r], np.float32), next_obs=next_obs[None],
+                terminateds=np.array([float(term)], np.float32))
+            self._ep_return += r
+            self._steps += 1
+            if term or trunc:
+                self._returns.append(self._ep_return)
+                self._ep_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = next_obs
+            if (self._steps >= cfg.learning_starts
+                    and self._steps % cfg.updates_per_step == 0):
+                if cfg.prioritized_replay:
+                    batch, idx, w = self.buffer.sample(cfg.train_batch_size)
+                    batch["weights"] = w
+                    batch["targets"] = self._targets(batch)
+                    metrics = self.learner.update(batch)
+                    q = np.asarray(self._q_fn(self.learner.params,
+                                              batch["obs"]))
+                    q_taken = np.take_along_axis(
+                        q, batch["actions"][:, None].astype(int), -1)[:, 0]
+                    self.buffer.update_priorities(
+                        idx, q_taken - batch["targets"])
+                else:
+                    batch = self.buffer.sample(cfg.train_batch_size)
+                    batch["targets"] = self._targets(batch)
+                    metrics = self.learner.update(batch)
+            if self._steps % cfg.target_update_freq == 0:
+                self.target_params = self.learner.params
+        recent = self._returns[-100:]
+        return {
+            "learner": metrics,
+            "epsilon": self._epsilon(),
+            "num_env_steps_sampled": self._steps,
+            "buffer_size": len(self.buffer),
+            "env_runners": {
+                "episode_return_mean":
+                    float(np.mean(recent)) if recent else float("nan"),
+                "num_episodes": len(self._returns),
+            },
+        }
+
+    def get_weights(self):
+        return self.learner.params
+
+    def set_weights(self, params) -> None:
+        self.learner.set_weights(params)
+        self.target_params = params
+
+    def stop(self) -> None:
+        super().stop()

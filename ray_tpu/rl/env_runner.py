@@ -1,0 +1,200 @@
+"""Rollout layer: EnvRunner (vector env + module inference) and the remote
+fan-out EnvRunnerGroup.
+
+Reference: rllib/env/single_agent_env_runner.py:66 (SingleAgentEnvRunner —
+vector envs, module forward, episode postprocessing via connectors) and
+rllib/env/env_runner_group.py:70 (EnvRunnerGroup — remote runners,
+``sample`` fan-out with ray.get, ``sync_weights`` broadcast).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .env import VectorEnv
+from .rl_module import DiscretePolicyModule, RLModuleSpec
+
+
+class EnvRunner:
+    """Collects fixed-length rollout batches with the current policy."""
+
+    def __init__(self, env_creator: Callable, *, num_envs: int = 4,
+                 module_spec: Optional[RLModuleSpec] = None,
+                 seed: int = 0, explore: bool = True):
+        import jax
+
+        self.vec = VectorEnv(env_creator, num_envs, seed=seed)
+        self.spec = module_spec or RLModuleSpec(
+            self.vec.observation_dim, self.vec.num_actions)
+        self.module = DiscretePolicyModule(self.spec)
+        self.explore = explore
+        self._key = jax.random.key(seed)
+        self.params = self.module.init(jax.random.key(seed + 1))
+        self._obs = self.vec.reset()
+        # Episode-return bookkeeping for metrics.
+        self._ep_returns = np.zeros(num_envs, np.float64)
+        self._ep_lens = np.zeros(num_envs, np.int64)
+        self._finished_returns: List[float] = []
+        self._finished_lens: List[int] = []
+
+        self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._infer_fn = jax.jit(self.module.forward_inference)
+        self._value_fn = jax.jit(
+            lambda p, o: self.module.forward_train(p, o)["value"])
+
+    # -- weights --------------------------------------------------------- #
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.params}
+
+    def set_state(self, state: Dict[str, Any]) -> bool:
+        self.params = state["params"]
+        return True
+
+    # -- sampling -------------------------------------------------------- #
+
+    def sample(self, num_steps: int = 256) -> Dict[str, np.ndarray]:
+        """Rollout ``num_steps`` per sub-env; returns time-major flattened
+        arrays plus bootstrap values for GAE."""
+        import jax
+
+        n, d = self.vec.num_envs, self.vec.observation_dim
+        obs_buf = np.empty((num_steps, n, d), np.float32)
+        act_buf = np.empty((num_steps, n), np.int32)
+        logp_buf = np.empty((num_steps, n), np.float32)
+        val_buf = np.empty((num_steps, n), np.float32)
+        rew_buf = np.empty((num_steps, n), np.float32)
+        done_buf = np.empty((num_steps, n), bool)
+        term_buf = np.empty((num_steps, n), bool)
+        # V(final_obs) for truncated boundaries (0 elsewhere): the GAE
+        # bootstrap for episodes cut by time limits, not by termination.
+        boot_buf = np.zeros((num_steps, n), np.float32)
+
+        for t in range(num_steps):
+            self._key, sub = jax.random.split(self._key)
+            if self.explore:
+                actions, logp, values = self._explore_fn(
+                    self.params, self._obs, sub)
+            else:
+                actions = self._infer_fn(self.params, self._obs)
+                logp = np.zeros(n, np.float32)
+                values = np.zeros(n, np.float32)
+            actions = np.asarray(actions)
+            obs_buf[t] = self._obs
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(values)
+            self._obs, rewards, dones, terms, final_obs = \
+                self.vec.step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            term_buf[t] = terms
+            truncs = dones & ~terms
+            if self.explore and truncs.any():
+                vals = np.asarray(self._value_fn(self.params, final_obs))
+                boot_buf[t, truncs] = vals[truncs]
+            self._ep_returns += rewards
+            self._ep_lens += 1
+            for i in np.nonzero(dones)[0]:
+                self._finished_returns.append(float(self._ep_returns[i]))
+                self._finished_lens.append(int(self._ep_lens[i]))
+                self._ep_returns[i] = 0.0
+                self._ep_lens[i] = 0
+
+        # Bootstrap value for the final observation of each sub-env.
+        if self.explore:
+            self._key, sub = jax.random.split(self._key)
+            _, _, last_val = self._explore_fn(self.params, self._obs, sub)
+            last_val = np.asarray(last_val)
+        else:
+            last_val = np.zeros(n, np.float32)
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "terminateds": term_buf, "bootstrap_values": boot_buf,
+            "last_values": last_val,
+        }
+
+    def metrics(self, window: int = 100) -> Dict[str, float]:
+        rets = self._finished_returns[-window:]
+        lens = self._finished_lens[-window:]
+        return {
+            "episode_return_mean": float(np.mean(rets)) if rets else np.nan,
+            "episode_len_mean": float(np.mean(lens)) if lens else np.nan,
+            "num_episodes": len(self._finished_returns),
+        }
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class EnvRunnerGroup:
+    """Local-or-remote set of EnvRunners (reference: env_runner_group.py:70).
+
+    ``num_env_runners=0`` keeps one local runner (the rllib convention for
+    debugging); otherwise runners are actors sampled in parallel.
+    """
+
+    def __init__(self, env_creator: Callable, *, num_env_runners: int = 0,
+                 num_envs_per_runner: int = 4,
+                 module_spec: Optional[RLModuleSpec] = None, seed: int = 0,
+                 runner_resources: Optional[Dict[str, float]] = None):
+        self.num_env_runners = num_env_runners
+        if num_env_runners == 0:
+            self.local = EnvRunner(env_creator, num_envs=num_envs_per_runner,
+                                   module_spec=module_spec, seed=seed)
+            self.remotes = []
+        else:
+            import ray_tpu
+            self.local = None
+            cls = ray_tpu.remote(EnvRunner)
+            opts = {"num_cpus": 1}
+            if runner_resources:
+                opts["resources"] = runner_resources
+            self.remotes = [
+                cls.options(**opts).remote(
+                    env_creator, num_envs=num_envs_per_runner,
+                    module_spec=module_spec, seed=seed + 1000 * (i + 1))
+                for i in range(num_env_runners)
+            ]
+
+    def sample(self, num_steps: int = 256) -> List[Dict[str, np.ndarray]]:
+        if self.local is not None:
+            return [self.local.sample(num_steps)]
+        import ray_tpu
+        return ray_tpu.get([r.sample.remote(num_steps) for r in self.remotes])
+
+    def sync_weights(self, params) -> None:
+        """Broadcast learner params to all runners (reference:
+        env_runner_group.py sync_weights)."""
+        state = {"params": params}
+        if self.local is not None:
+            self.local.set_state(state)
+            return
+        import ray_tpu
+        ray_tpu.get([r.set_state.remote(state) for r in self.remotes])
+
+    def aggregate_metrics(self) -> Dict[str, float]:
+        if self.local is not None:
+            return self.local.metrics()
+        import ray_tpu
+        all_m = ray_tpu.get([r.metrics.remote() for r in self.remotes])
+        rets = [m["episode_return_mean"] for m in all_m
+                if not np.isnan(m["episode_return_mean"])]
+        lens = [m["episode_len_mean"] for m in all_m
+                if not np.isnan(m["episode_len_mean"])]
+        return {
+            "episode_return_mean": float(np.mean(rets)) if rets else np.nan,
+            "episode_len_mean": float(np.mean(lens)) if lens else np.nan,
+            "num_episodes": int(sum(m["num_episodes"] for m in all_m)),
+        }
+
+    def stop(self) -> None:
+        import ray_tpu
+        for r in self.remotes:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,172 @@
+"""PPO: clipped-surrogate policy optimization with GAE.
+
+Reference: rllib/algorithms/ppo/ppo.py:365 (PPOConfig) / :391
+(training_step: sample from env runners -> learner group update ->
+sync weights) and ppo_learner losses — expressed as a pure JAX loss jitted
+by JaxLearner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .learner import JaxLearner, LearnerGroup
+from .rl_module import DiscretePolicyModule
+
+
+def compute_gae(rewards: np.ndarray, values: np.ndarray, dones: np.ndarray,
+                terminateds: np.ndarray, last_values: np.ndarray,
+                gamma: float, lam: float,
+                bootstrap_values: np.ndarray = None):
+    """Generalized Advantage Estimation over time-major [T, N] rollouts.
+
+    ``dones`` marks episode boundaries (no GAE chaining across them).  The
+    per-step bootstrap value is:
+      * 0 on terminated steps (the future is worth nothing);
+      * ``bootstrap_values[t]`` = V(final_obs) on truncated steps — NOT the
+        next buffer row, which after auto-reset holds the next episode's
+        reset state;
+      * V(s_{t+1}) (``values[t+1]`` / ``last_values`` at the end) otherwise.
+    """
+    T, N = rewards.shape
+    if bootstrap_values is None:
+        bootstrap_values = np.zeros((T, N), np.float32)
+    adv = np.zeros((T, N), np.float32)
+    last_gae = np.zeros(N, np.float32)
+    next_value = last_values
+    for t in reversed(range(T)):
+        done = dones[t].astype(np.float32)
+        term = terminateds[t].astype(np.float32)
+        boundary_value = (1.0 - term) * bootstrap_values[t]
+        nv = (1.0 - done) * next_value + done * boundary_value
+        delta = rewards[t] + gamma * nv - values[t]
+        last_gae = delta + gamma * lam * (1.0 - done) * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+def ppo_loss(module: DiscretePolicyModule, params, batch):
+    import jax.numpy as jnp
+    import jax
+
+    out = module.forward_train(params, batch["obs"])
+    logits = out["action_logits"]
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    ratio = jnp.exp(logp - batch["logp_old"])
+    adv = batch["advantages"]
+    clip = batch["clip_param"][0]
+    surrogate = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+    policy_loss = -jnp.mean(surrogate)
+    value_loss = jnp.mean((out["value"] - batch["value_targets"]) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    vf_coeff = batch["vf_coeff"][0]
+    ent_coeff = batch["ent_coeff"][0]
+    total = policy_loss + vf_coeff * value_loss - ent_coeff * entropy
+    return total, {"policy_loss": policy_loss, "vf_loss": value_loss,
+                   "entropy": entropy,
+                   "kl": jnp.mean(batch["logp_old"] - logp)}
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(PPO)
+        self.clip_param = 0.2
+        self.lambda_ = 0.95
+        self.num_epochs = 4
+        self.minibatch_size = 128
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+
+    def training(self, *, clip_param=None, lambda_=None, num_epochs=None,
+                 minibatch_size=None, vf_loss_coeff=None,
+                 entropy_coeff=None, **kw) -> "PPOConfig":
+        super().training(**kw)
+        if clip_param is not None:
+            self.clip_param = clip_param
+        if lambda_ is not None:
+            self.lambda_ = lambda_
+        if num_epochs is not None:
+            self.num_epochs = num_epochs
+        if minibatch_size is not None:
+            self.minibatch_size = minibatch_size
+        if vf_loss_coeff is not None:
+            self.vf_loss_coeff = vf_loss_coeff
+        if entropy_coeff is not None:
+            self.entropy_coeff = entropy_coeff
+        return self
+
+
+class PPO(Algorithm):
+    def setup(self, config: PPOConfig) -> None:
+        spec = config.module_spec()
+        lr, seed = config.lr, config.seed
+
+        def factory():
+            return JaxLearner(DiscretePolicyModule(spec), ppo_loss,
+                              learning_rate=lr, seed=seed)
+
+        self.learner_group = LearnerGroup(
+            factory, num_learners=config.num_learners)
+        self._rng = np.random.default_rng(config.seed)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: PPOConfig = self.config
+        rollouts = self.env_runner_group.sample(cfg.rollout_fragment_length)
+
+        flat: Dict[str, list] = {k: [] for k in
+                                 ("obs", "actions", "logp_old",
+                                  "advantages", "value_targets")}
+        for ro in rollouts:
+            adv, ret = compute_gae(ro["rewards"], ro["values"], ro["dones"],
+                                   ro["terminateds"], ro["last_values"],
+                                   cfg.gamma, cfg.lambda_,
+                                   ro.get("bootstrap_values"))
+            T, N = ro["rewards"].shape
+            flat["obs"].append(ro["obs"].reshape(T * N, -1))
+            flat["actions"].append(ro["actions"].reshape(-1))
+            flat["logp_old"].append(ro["logp"].reshape(-1))
+            flat["advantages"].append(adv.reshape(-1))
+            flat["value_targets"].append(ret.reshape(-1))
+        batch = {k: np.concatenate(v) for k, v in flat.items()}
+        adv = batch["advantages"]
+        batch["advantages"] = ((adv - adv.mean())
+                               / (adv.std() + 1e-8)).astype(np.float32)
+
+        n = len(batch["actions"])
+        consts = {
+            "clip_param": np.array([cfg.clip_param], np.float32),
+            "vf_coeff": np.array([cfg.vf_loss_coeff], np.float32),
+            "ent_coeff": np.array([cfg.entropy_coeff], np.float32),
+        }
+        metrics: Dict[str, float] = {}
+        mb = min(cfg.minibatch_size, n)
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for s in range(0, n - mb + 1, mb):
+                idx = perm[s:s + mb]
+                minibatch = {k: v[idx] for k, v in batch.items()}
+                minibatch.update(consts)
+                metrics = self.learner_group.update(minibatch)
+        weights = self.learner_group.get_weights()
+        self.env_runner_group.sync_weights(weights)
+        return {"learner": metrics,
+                "num_env_steps_sampled": n}
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def set_weights(self, params) -> None:
+        self.learner_group.set_weights(params)
+
+    def stop(self) -> None:
+        super().stop()
+        self.learner_group.stop()

@@ -1,0 +1,100 @@
+"""RLModule: the model abstraction (policy + value / Q heads) in JAX.
+
+Reference: rllib/core/rl_module/rl_module.py:260 (RLModule with
+forward_inference / forward_exploration / forward_train) — re-expressed as
+pure-function JAX pytrees so the same module runs under jit on CPU or a TPU
+mesh without framework wrappers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """Reference: rllib RLModuleSpec (catalog-free minimal form)."""
+    observation_dim: int
+    num_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+def _init_mlp(key, dims: Sequence[int]) -> Params:
+    params = {}
+    keys = jax.random.split(key, len(dims) - 1)
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f"w{i}"] = jax.random.normal(keys[i], (a, b)) * (2.0 / a) ** 0.5
+        params[f"b{i}"] = jnp.zeros((b,))
+    return params
+
+
+def _mlp(params: Params, x: jax.Array) -> jax.Array:
+    n = len(params) // 2
+    for i in range(n):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class DiscretePolicyModule:
+    """Separate policy and value MLP towers for discrete action spaces
+    (the PPO default; reference: rllib DefaultPPORLModule)."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    def init(self, key: jax.Array) -> Params:
+        kp, kv = jax.random.split(key)
+        dims_p = [self.spec.observation_dim, *self.spec.hidden,
+                  self.spec.num_actions]
+        dims_v = [self.spec.observation_dim, *self.spec.hidden, 1]
+        return {"pi": _init_mlp(kp, dims_p), "vf": _init_mlp(kv, dims_v)}
+
+    # -- forward passes (pure functions of params) ----------------------- #
+
+    def forward_train(self, params: Params, obs: jax.Array
+                      ) -> Dict[str, jax.Array]:
+        logits = _mlp(params["pi"], obs)
+        value = _mlp(params["vf"], obs)[..., 0]
+        return {"action_logits": logits, "value": value}
+
+    def forward_inference(self, params: Params, obs: jax.Array) -> jax.Array:
+        """Greedy actions."""
+        return jnp.argmax(_mlp(params["pi"], obs), axis=-1)
+
+    def forward_exploration(self, params: Params, obs: jax.Array,
+                            key: jax.Array
+                            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Sampled actions + their log-probs + value estimates."""
+        out = self.forward_train(params, obs)
+        logits = out["action_logits"]
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)
+        alogp = jnp.take_along_axis(logp, actions[:, None], axis=-1)[:, 0]
+        return actions, alogp, out["value"]
+
+
+class QModule:
+    """Single Q-tower for value-based algorithms (reference: rllib
+    DefaultDQNRLModule without dueling/distributional extras)."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+
+    def init(self, key: jax.Array) -> Params:
+        dims = [self.spec.observation_dim, *self.spec.hidden,
+                self.spec.num_actions]
+        return {"q": _init_mlp(key, dims)}
+
+    def q_values(self, params: Params, obs: jax.Array) -> jax.Array:
+        return _mlp(params["q"], obs)
+
+    def forward_inference(self, params: Params, obs: jax.Array) -> jax.Array:
+        return jnp.argmax(self.q_values(params, obs), axis=-1)

@@ -195,3 +195,40 @@ class TestRayCall:
         a = Adder.remote(5)
         ref = a.__ray_call__.remote(lambda self, k: self.inc * k, 4)
         assert ray_tpu.get(ref) == 20
+
+
+class TestTeardownSemantics:
+    def test_get_after_teardown_returns_drained_result(self, ray_start):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+        ref = compiled.execute(4)
+        compiled.teardown()
+        # Result was drained into the cache during teardown.
+        assert ref.get(timeout=5) == 5
+
+    def test_get_timeout_does_not_desync_outputs(self, ray_start):
+        import time as _t
+
+        @ray_tpu.remote
+        class Slow:
+            def fast(self, x):
+                return x
+
+            def slow(self, x):
+                _t.sleep(1.0)
+                return x * 10
+
+        f, s = Slow.remote(), Slow.remote()
+        with InputNode() as inp:
+            dag = MultiOutputNode([f.fast.bind(inp), s.slow.bind(inp)])
+        compiled = dag.experimental_compile()
+        try:
+            ref = compiled.execute(3)
+            with pytest.raises(TimeoutError):
+                ref._dag._fetch(0, timeout=0.1)
+            # Retry succeeds with outputs correctly paired.
+            assert ref.get(timeout=10) == [3, 30]
+        finally:
+            compiled.teardown()
