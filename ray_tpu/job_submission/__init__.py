@@ -1,0 +1,11 @@
+"""Job submission: run driver scripts as supervised cluster jobs.
+
+Reference: python/ray/dashboard/modules/job/ — JobManager (job_manager.py:58)
++ per-job JobSupervisor actor (job_supervisor.py:57), REST API (job_head.py),
+SDK client (python/ray/job_submission JobSubmissionClient).
+"""
+
+from .manager import JobInfo, JobManager, JobStatus
+from .client import JobSubmissionClient
+
+__all__ = ["JobManager", "JobStatus", "JobInfo", "JobSubmissionClient"]

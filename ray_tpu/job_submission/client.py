@@ -1,0 +1,94 @@
+"""JobSubmissionClient: SDK over the head's REST API.
+
+Reference: python/ray/job_submission (JobSubmissionClient — submit_job,
+get_job_status, get_job_logs, stop_job, list_jobs, tail_job_logs).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from .manager import JobStatus
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """``address`` like http://127.0.0.1:8265 (the head's job server)."""
+        self.address = address.rstrip("/")
+
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None) -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.address + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode())
+            except Exception:
+                payload = {"error": str(e)}
+            raise RuntimeError(
+                f"{method} {path} failed ({e.code}): "
+                f"{payload.get('error', payload)}") from e
+
+    # -- jobs -------------------------------------------------------------- #
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        out = self._request("POST", "/api/jobs/", {
+            "entrypoint": entrypoint, "submission_id": submission_id,
+            "runtime_env": runtime_env, "metadata": metadata})
+        return out["submission_id"]
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{submission_id}")["status"]
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        return self._request("GET", f"/api/jobs/{submission_id}")
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{submission_id}/logs")["logs"]
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._request(
+            "POST", f"/api/jobs/{submission_id}/stop")["stopped"]
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._request("GET", "/api/jobs/")
+
+    def tail_job_logs(self, submission_id: str, *, poll_s: float = 0.5):
+        """Generator yielding new log text until the job terminates."""
+        seen = 0
+        while True:
+            status = self.get_job_status(submission_id)
+            logs = self.get_job_logs(submission_id)
+            if len(logs) > seen:
+                yield logs[seen:]
+                seen = len(logs)
+            if status in JobStatus.TERMINAL:
+                return
+            time.sleep(poll_s)
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.25)
+        raise TimeoutError(f"job {submission_id} still running")
+
+    # -- cluster ------------------------------------------------------------ #
+
+    def cluster_status(self) -> Dict[str, Any]:
+        return self._request("GET", "/api/cluster/status")

@@ -1,0 +1,204 @@
+"""JobManager + JobSupervisor: entrypoint subprocesses supervised by actors.
+
+Reference: dashboard/modules/job/job_manager.py:58 (JobManager — submit,
+monitor loop, status bookkeeping in GCS KV) and job_supervisor.py:57
+(JobSupervisor actor — spawns the entrypoint shell command in a subprocess,
+streams logs to a file, reports the exit code).
+"""
+
+from __future__ import annotations
+
+import os
+import string
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclass
+class JobInfo:
+    submission_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    metadata: Dict[str, str] = field(default_factory=dict)
+    runtime_env: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "submission_id": self.submission_id,
+            "entrypoint": self.entrypoint, "status": self.status,
+            "message": self.message, "start_time": self.start_time,
+            "end_time": self.end_time, "metadata": self.metadata,
+        }
+
+
+class _JobSupervisor:
+    """Actor supervising one entrypoint subprocess (reference:
+    job_supervisor.py:57).  The subprocess starts in __init__ so status
+    polls are never blocked behind a long-running call."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 env_vars: Optional[Dict[str, str]], log_path: str):
+        import subprocess
+
+        self.submission_id = submission_id
+        self.log_path = log_path
+        env = dict(os.environ)
+        # The job's driver process must not inherit this worker's runtime
+        # wiring; it creates its own ray_tpu session.
+        for k in list(env):
+            if k.startswith("RAY_TPU_WORKER"):
+                env.pop(k)
+        env["RAY_TPU_JOB_SUBMISSION_ID"] = submission_id
+        if env_vars:
+            env.update(env_vars)
+        self._log_f = open(log_path, "wb")
+        self.proc = subprocess.Popen(
+            entrypoint, shell=True, stdout=self._log_f,
+            stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)  # own process group for clean stop
+
+    def poll(self) -> Optional[int]:
+        """None while running, else the exit code."""
+        code = self.proc.poll()
+        if code is not None:
+            self._log_f.flush()
+        return code
+
+    def stop(self) -> bool:
+        import signal
+
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            deadline = time.monotonic() + 3.0
+            while self.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if self.proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                self.proc.wait()
+            return True
+        return False
+
+    def logs(self) -> bytes:
+        self._log_f.flush()
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return b""
+
+
+_ALLOWED_ID = set(string.ascii_letters + string.digits + "-_")
+
+
+class JobManager:
+    """Tracks supervised jobs on the head (reference: job_manager.py:58)."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self._jobs: Dict[str, JobInfo] = {}
+        self._supervisors: Dict[str, Any] = {}
+        self.log_dir = log_dir or os.path.join(
+            "/tmp/ray_tpu", "job_logs", str(os.getpid()))
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        if set(submission_id) - _ALLOWED_ID:
+            raise ValueError(f"invalid submission_id {submission_id!r}")
+        if submission_id in self._jobs:
+            raise ValueError(f"job {submission_id!r} already exists")
+        info = JobInfo(submission_id, entrypoint,
+                       runtime_env=runtime_env, metadata=metadata or {})
+        env_vars = (runtime_env or {}).get("env_vars")
+        log_path = os.path.join(self.log_dir, f"{submission_id}.log")
+        supervisor = ray_tpu.remote(_JobSupervisor).options(
+            name=f"_job_supervisor:{submission_id}",
+            num_cpus=0).remote(submission_id, entrypoint, env_vars, log_path)
+        self._jobs[submission_id] = info
+        self._supervisors[submission_id] = supervisor
+        info.status = JobStatus.RUNNING
+        return submission_id
+
+    def _refresh(self, submission_id: str) -> JobInfo:
+        info = self._jobs[submission_id]
+        if info.status in JobStatus.TERMINAL:
+            return info
+        sup = self._supervisors[submission_id]
+        try:
+            code = ray_tpu.get(sup.poll.remote(), timeout=30)
+        except Exception as e:
+            info.status = JobStatus.FAILED
+            info.message = f"supervisor died: {e!r}"
+            info.end_time = time.time()
+            return info
+        if code is None:
+            return info
+        info.end_time = time.time()
+        if code == 0:
+            info.status = JobStatus.SUCCEEDED
+        else:
+            info.status = JobStatus.FAILED
+            info.message = f"entrypoint exited with code {code}"
+        return info
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._refresh(submission_id).status
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        return self._refresh(submission_id)
+
+    def list_jobs(self) -> List[JobInfo]:
+        return [self._refresh(sid) for sid in list(self._jobs)]
+
+    def stop_job(self, submission_id: str) -> bool:
+        info = self._refresh(submission_id)
+        if info.status in JobStatus.TERMINAL:
+            return False
+        stopped = ray_tpu.get(
+            self._supervisors[submission_id].stop.remote(), timeout=30)
+        info.status = JobStatus.STOPPED
+        info.end_time = time.time()
+        return bool(stopped)
+
+    def get_job_logs(self, submission_id: str) -> str:
+        if submission_id not in self._jobs:
+            raise KeyError(submission_id)
+        data = ray_tpu.get(
+            self._supervisors[submission_id].logs.remote(), timeout=30)
+        return data.decode(errors="replace")
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"job {submission_id} not finished after {timeout}s")

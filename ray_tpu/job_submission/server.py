@@ -1,0 +1,143 @@
+"""REST API for jobs + cluster state (the dashboard-head slice that serves
+the CLI and JobSubmissionClient).
+
+Reference: dashboard/modules/job/job_head.py (REST routes
+/api/jobs/*) and dashboard/head.py (aiohttp app hosting modules).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict, Optional
+
+from .manager import JobManager
+
+
+class JobServer:
+    """aiohttp server on a background thread; thread-safe over the manager
+    by funneling manager calls through an executor (the manager does
+    blocking ray_tpu.get calls)."""
+
+    def __init__(self, manager: JobManager, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.bound_port: Optional[int] = None
+        self._started = threading.Event()
+        self._loop = None
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="ray_tpu-job-server")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("job server failed to start")
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.bound_port}"
+
+    def _serve(self):
+        from aiohttp import web
+
+        mgr = self.manager
+
+        def call(fn, *args, **kwargs):
+            return asyncio.get_event_loop().run_in_executor(
+                None, lambda: fn(*args, **kwargs))
+
+        async def submit(request: "web.Request"):
+            body = await request.json()
+            try:
+                sid = await call(
+                    mgr.submit_job,
+                    entrypoint=body["entrypoint"],
+                    submission_id=body.get("submission_id"),
+                    runtime_env=body.get("runtime_env"),
+                    metadata=body.get("metadata"))
+                return web.json_response({"submission_id": sid})
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": repr(e)}, status=400)
+
+        async def list_jobs(request):
+            infos = await call(mgr.list_jobs)
+            return web.json_response([i.to_dict() for i in infos])
+
+        async def job_info(request):
+            sid = request.match_info["sid"]
+            try:
+                info = await call(mgr.get_job_info, sid)
+            except KeyError:
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response(info.to_dict())
+
+        async def job_logs(request):
+            sid = request.match_info["sid"]
+            try:
+                logs = await call(mgr.get_job_logs, sid)
+            except KeyError:
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response({"logs": logs})
+
+        async def job_stop(request):
+            sid = request.match_info["sid"]
+            try:
+                stopped = await call(mgr.stop_job, sid)
+            except KeyError:
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response({"stopped": stopped})
+
+        async def cluster_status(request):
+            from ray_tpu._private.api import _control
+            import ray_tpu
+            payload: Dict[str, Any] = {
+                "nodes": await call(_control, "nodes"),
+                "total_resources": await call(ray_tpu.cluster_resources),
+                "available_resources":
+                    await call(ray_tpu.available_resources),
+                "actors": await call(_control, "list_actors"),
+                "task_summary": await call(_control, "summarize_tasks"),
+            }
+            return web.json_response(payload)
+
+        async def timeline(request):
+            from ray_tpu._private.api import _control
+            return web.json_response(await call(_control, "timeline"))
+
+        async def metrics(request):
+            from ray_tpu.util import metrics as m
+            text = await call(m.prometheus_text)
+            return web.Response(text=text,
+                                content_type="text/plain")
+
+        async def main():
+            app = web.Application()
+            app.router.add_post("/api/jobs/", submit)
+            app.router.add_get("/api/jobs/", list_jobs)
+            app.router.add_get("/api/jobs/{sid}", job_info)
+            app.router.add_get("/api/jobs/{sid}/logs", job_logs)
+            app.router.add_post("/api/jobs/{sid}/stop", job_stop)
+            app.router.add_get("/api/cluster/status", cluster_status)
+            app.router.add_get("/api/cluster/timeline", timeline)
+            app.router.add_get("/metrics", metrics)
+            app.router.add_get(
+                "/-/healthz", lambda r: web.json_response({"ok": True}))
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self.bound_port = site._server.sockets[0].getsockname()[1]
+            self._started.set()
+            while True:
+                await asyncio.sleep(3600)
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(main())
+        except Exception:
+            pass
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)

@@ -1,0 +1,222 @@
+"""``ray-tpu`` CLI: cluster lifecycle, jobs, state, timeline.
+
+Reference: python/ray/scripts/scripts.py (click CLI — ``ray start:799``,
+``ray stop:1346``, ``ray status``, ``ray job submit/list/logs/stop``,
+``ray timeline``, ``ray summary``).
+
+Run as ``python -m ray_tpu.scripts.cli ...`` (or the ``ray-tpu`` console
+script once installed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import click
+
+DEFAULT_ADDRESS_FILE = "/tmp/ray_tpu/head_address"
+
+
+def _resolve_address(address):
+    if address:
+        return address
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    try:
+        with open(DEFAULT_ADDRESS_FILE) as f:
+            return json.load(f)["address"]
+    except (FileNotFoundError, json.JSONDecodeError, KeyError):
+        raise click.ClickException(
+            "no head address found — pass --address, set RAY_TPU_ADDRESS, "
+            "or run `ray-tpu start --head` on this machine")
+
+
+def _client(address):
+    from ray_tpu.job_submission import JobSubmissionClient
+    return JobSubmissionClient(_resolve_address(address))
+
+
+@click.group()
+def cli():
+    """ray_tpu cluster and job management."""
+
+
+@cli.command()
+@click.option("--head", is_flag=True, help="Start a head node.")
+@click.option("--port", type=int, default=8265, show_default=True)
+@click.option("--num-cpus", type=float, default=None)
+@click.option("--num-tpus", type=int, default=None)
+@click.option("--address-file", default=DEFAULT_ADDRESS_FILE)
+@click.option("--block", is_flag=True, help="Run in the foreground.")
+def start(head, port, num_cpus, num_tpus, address_file, block):
+    """Start the head process (runtime + job/REST server)."""
+    if not head:
+        raise click.ClickException(
+            "only --head is supported; worker nodes join via the runtime's "
+            "node API")
+    cmd = [sys.executable, "-m", "ray_tpu.scripts.head",
+           "--port", str(port), "--address-file", address_file]
+    if num_cpus is not None:
+        cmd += ["--num-cpus", str(num_cpus)]
+    if num_tpus is not None:
+        cmd += ["--num-tpus", str(num_tpus)]
+    if block:
+        raise SystemExit(subprocess.call(cmd))
+    try:
+        os.unlink(address_file)
+    except FileNotFoundError:
+        pass
+    # Detach stdio: the head must not hold the CLI's stdout/stderr pipes
+    # open (callers capturing our output would block on EOF forever).
+    log_path = address_file + ".log"
+    log_f = open(log_path, "ab")
+    proc = subprocess.Popen(cmd, start_new_session=True,
+                            stdin=subprocess.DEVNULL, stdout=log_f,
+                            stderr=subprocess.STDOUT)
+    log_f.close()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise click.ClickException(
+                f"head process exited early with code {proc.returncode}")
+        try:
+            with open(address_file) as f:
+                address = json.load(f)["address"]
+            click.echo(f"head started at {address} (pid {proc.pid})")
+            return
+        except (FileNotFoundError, json.JSONDecodeError):
+            time.sleep(0.2)
+    raise click.ClickException("head did not start within 30s")
+
+
+@cli.command()
+@click.option("--address-file", default=DEFAULT_ADDRESS_FILE)
+def stop(address_file):
+    """Stop the head process started with ``ray-tpu start``."""
+    import signal
+
+    try:
+        with open(address_file) as f:
+            pid = json.load(f)["pid"]
+    except (FileNotFoundError, json.JSONDecodeError, KeyError):
+        raise click.ClickException("no running head found")
+    try:
+        os.kill(pid, signal.SIGTERM)
+        click.echo(f"sent SIGTERM to head (pid {pid})")
+    except ProcessLookupError:
+        click.echo("head already gone")
+        try:
+            os.unlink(address_file)
+        except FileNotFoundError:
+            pass
+
+
+@cli.command()
+@click.option("--address", default=None)
+def status(address):
+    """Cluster resources, nodes, actors, task summary."""
+    s = _client(address).cluster_status()
+    click.echo(f"nodes: {len(s['nodes'])}")
+    for n in s["nodes"]:
+        state = "ALIVE" if n["alive"] else "DEAD"
+        click.echo(f"  {n['node_id'][:12]} {state} head={n['is_head']} "
+                   f"{n['hostname']}")
+    click.echo("resources (available/total):")
+    total, avail = s["total_resources"], s["available_resources"]
+    for k in sorted(total):
+        click.echo(f"  {k}: {avail.get(k, 0):g}/{total[k]:g}")
+    alive = sum(1 for a in s["actors"] if a["state"] == "ALIVE")
+    click.echo(f"actors: {alive} alive / {len(s['actors'])} total")
+    if s["task_summary"]:
+        click.echo("tasks:")
+        for name, states in sorted(s["task_summary"].items()):
+            parts = ", ".join(f"{k}={v}" for k, v in sorted(states.items()))
+            click.echo(f"  {name}: {parts}")
+
+
+@cli.group()
+def job():
+    """Job submission and management."""
+
+
+@job.command("submit")
+@click.option("--address", default=None)
+@click.option("--submission-id", default=None)
+@click.option("--no-wait", is_flag=True)
+@click.option("--env", "env_vars", multiple=True,
+              help="KEY=VALUE env for the entrypoint (repeatable).")
+@click.argument("entrypoint", nargs=-1, required=True)
+def job_submit(address, submission_id, no_wait, env_vars, entrypoint):
+    """Submit ENTRYPOINT (a shell command) as a supervised job."""
+    client = _client(address)
+    runtime_env = None
+    if env_vars:
+        pairs = dict(e.split("=", 1) for e in env_vars)
+        runtime_env = {"env_vars": pairs}
+    sid = client.submit_job(entrypoint=" ".join(entrypoint),
+                            submission_id=submission_id,
+                            runtime_env=runtime_env)
+    click.echo(f"submitted job {sid}")
+    if no_wait:
+        return
+    for chunk in client.tail_job_logs(sid):
+        click.echo(chunk, nl=False)
+    status_ = client.get_job_status(sid)
+    click.echo(f"\njob {sid} finished: {status_}")
+    if status_ != "SUCCEEDED":
+        raise SystemExit(1)
+
+
+@job.command("list")
+@click.option("--address", default=None)
+def job_list(address):
+    for info in _client(address).list_jobs():
+        click.echo(f"{info['submission_id']}  {info['status']:<10} "
+                   f"{info['entrypoint']}")
+
+
+@job.command("status")
+@click.option("--address", default=None)
+@click.argument("submission_id")
+def job_status(address, submission_id):
+    click.echo(_client(address).get_job_status(submission_id))
+
+
+@job.command("logs")
+@click.option("--address", default=None)
+@click.argument("submission_id")
+def job_logs(address, submission_id):
+    click.echo(_client(address).get_job_logs(submission_id), nl=False)
+
+
+@job.command("stop")
+@click.option("--address", default=None)
+@click.argument("submission_id")
+def job_stop(address, submission_id):
+    stopped = _client(address).stop_job(submission_id)
+    click.echo("stopped" if stopped else "already finished")
+
+
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--output", "-o", default="timeline.json", show_default=True)
+def timeline(address, output):
+    """Dump the chrome-trace timeline to a file."""
+    client = _client(address)
+    trace = client._request("GET", "/api/cluster/timeline")
+    with open(output, "w") as f:
+        json.dump(trace, f)
+    click.echo(f"wrote {len(trace)} events to {output}")
+
+
+def main():
+    cli()
+
+
+if __name__ == "__main__":
+    main()

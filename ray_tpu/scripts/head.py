@@ -1,0 +1,58 @@
+"""Head process: runtime + job server, launched by ``ray-tpu start --head``.
+
+Reference: ``ray start --head`` (python/ray/scripts/scripts.py:799) which
+boots GCS + raylet + dashboard; here one process hosts the driver runtime,
+the JobManager and its REST server, and stays up until SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=8265)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--address-file", default="/tmp/ray_tpu/head_address")
+    args = p.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu.job_submission import JobManager
+    from ray_tpu.job_submission.server import JobServer
+
+    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    manager = JobManager()
+    server = JobServer(manager, port=args.port)
+
+    os.makedirs(os.path.dirname(args.address_file), exist_ok=True)
+    with open(args.address_file, "w") as f:
+        json.dump({"address": server.address, "pid": os.getpid()}, f)
+
+    stop = {"flag": False}
+
+    def on_term(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    print(f"ray_tpu head listening on {server.address}", flush=True)
+    while not stop["flag"]:
+        time.sleep(0.2)
+    server.stop()
+    ray_tpu.shutdown()
+    try:
+        os.unlink(args.address_file)
+    except FileNotFoundError:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
