@@ -92,6 +92,16 @@ class ShmChannel:
 
     # -- writer side --------------------------------------------------------
 
+    def writable(self) -> bool:
+        """True iff the reader has consumed the last message (a write now
+        would not block).  Monotonic for the writer: only the writer's own
+        write can flip it back to False."""
+        return self._read_u64(24) == self._read_u64(0)
+
+    def wait_writable(self, timeout: Optional[float] = None) -> None:
+        _spin_wait(self.writable, timeout,
+                   "write (reader has not consumed)")
+
     def write(self, payload: bytes, flag: int = FLAG_DATA,
               timeout: Optional[float] = None) -> None:
         if len(payload) > self.capacity:
@@ -99,8 +109,8 @@ class ShmChannel:
                 f"serialized message ({len(payload)} B) exceeds channel "
                 f"buffer ({self.capacity} B); recompile with a larger "
                 "buffer_size_bytes")
-        _spin_wait(lambda: self._read_u64(24) == self._read_u64(0),
-                   timeout, "write (reader has not consumed)")
+        _spin_wait(self.writable, timeout,
+                   "write (reader has not consumed)")
         self._shm.buf[HEADER_SIZE:HEADER_SIZE + len(payload)] = payload
         self._write_u64(8, len(payload))
         self._shm.buf[16] = flag

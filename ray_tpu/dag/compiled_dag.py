@@ -27,36 +27,45 @@ EdgeKey = Tuple[int, int]  # (producer node idx, consumer node idx; -1=driver)
 def _dag_actor_loop(instance, plan: Dict[str, Any]) -> int:
     """Resident loop executed on the actor's worker via __ray_call__.
 
-    Each iteration: read every in-channel once, run this actor's steps in
-    topo order, write results to out-channels.  Errors are propagated as
-    FLAG_ERR payloads instead of crashing the pipeline; STOP propagates
-    downstream and ends the loop.
+    Each iteration: for each of this actor's steps in topo order, read that
+    step's input edges immediately before executing it, then write results
+    to out-channels.  Per-step (not up-front) reads matter: a DAG that
+    revisits an actor after passing through another (a.f -> b.g -> a.h)
+    would deadlock if the loop blocked on the b->a channel before running
+    f to feed b.  Errors are propagated as FLAG_ERR payloads instead of
+    crashing the pipeline; STOP propagates downstream and ends the loop.
     """
     steps = plan["steps"]
     in_channels: Dict[EdgeKey, ShmChannel] = plan["in_channels"]
     out_channels: Dict[EdgeKey, ShmChannel] = plan["out_channels"]
-    in_order = sorted(in_channels)
+    # Each in-channel feeds exactly one consumer step (edge keys embed the
+    # consumer node idx); dedupe so a channel used in two arg positions of
+    # the same step is read once per iteration.
+    for step in steps:
+        reads: List[EdgeKey] = []
+        for kind, payload in list(step["args"]) + list(step["kwargs"].values()):
+            if kind == "chan" and payload not in reads:
+                reads.append(payload)
+        step["reads"] = reads
     iterations = 0
     try:
         while True:
             chan_vals: Dict[EdgeKey, Any] = {}
             chan_errs: Dict[EdgeKey, bytes] = {}
             stop = False
-            for key in in_order:
-                flag, payload = in_channels[key].read()
-                if flag == FLAG_STOP:
-                    stop = True
-                elif flag == FLAG_ERR:
-                    chan_errs[key] = payload
-                else:
-                    chan_vals[key] = serialization.unpack_payload(payload)
-            if stop:
-                for chan in out_channels.values():
-                    chan.write(b"", FLAG_STOP)
-                return iterations
             local_vals: Dict[int, Any] = {}
             local_errs: Dict[int, bytes] = {}
             for step in steps:
+                for key in step["reads"]:
+                    flag, payload = in_channels[key].read()
+                    if flag == FLAG_STOP:
+                        stop = True
+                    elif flag == FLAG_ERR:
+                        chan_errs[key] = payload
+                    else:
+                        chan_vals[key] = serialization.unpack_payload(payload)
+                if stop:
+                    break
                 node_idx = step["node_idx"]
                 err: Optional[bytes] = None
                 args: List[Any] = []
@@ -100,6 +109,13 @@ def _dag_actor_loop(instance, plan: Dict[str, Any]) -> int:
                     payload = serialization.pack_payload(local_vals[node_idx])
                     for key in step["writes"]:
                         out_channels[key].write(payload, FLAG_DATA)
+            if stop:
+                # Teardown drains all executes before sending STOP, so the
+                # first read of a fresh iteration is the only place STOP
+                # appears — no step has written this iteration yet.
+                for chan in out_channels.values():
+                    chan.write(b"", FLAG_STOP)
+                return iterations
             iterations += 1
     finally:
         for chan in list(in_channels.values()) + list(out_channels.values()):
@@ -296,23 +312,33 @@ class CompiledDAG:
         with self._lock:
             if self._torn_down:
                 raise RuntimeError("compiled DAG has been torn down")
+            payloads = []
             for ekey, node in self._input_edges:
                 if isinstance(node, InputNode):
                     value = node._eval_impl(None, args, kwargs)
                 else:
                     value = InputNode.extract(node._key, args, kwargs)
-                # Bounded wait: if the pipeline is saturated because results
-                # were never fetched, fail with guidance instead of
-                # deadlocking under the lock.
-                try:
-                    self._channels[ekey].write(
-                        serialization.pack_payload(value), FLAG_DATA,
-                        timeout=self._submit_timeout)
-                except TimeoutError as e:
-                    raise RuntimeError(
-                        "compiled DAG pipeline is full — call .get() on "
-                        "earlier CompiledDAGRefs before submitting more "
-                        "executions") from e
+                payloads.append((ekey, serialization.pack_payload(value)))
+            # All-or-nothing submission: wait until EVERY input channel is
+            # writable before writing ANY, so a saturated pipeline fails
+            # without leaving some channels holding this iteration's value
+            # and others not (which would silently pair inputs from
+            # different execute() calls after a retry).  Writability is
+            # monotonic here — the driver under this lock is the only
+            # writer — so the post-check writes cannot block.
+            import time as _time
+            deadline = _time.monotonic() + self._submit_timeout
+            try:
+                for ekey, _ in payloads:
+                    self._channels[ekey].wait_writable(
+                        max(0.0, deadline - _time.monotonic()))
+            except TimeoutError as e:
+                raise RuntimeError(
+                    "compiled DAG pipeline is full — call .get() on "
+                    "earlier CompiledDAGRefs before submitting more "
+                    "executions") from e
+            for ekey, payload in payloads:
+                self._channels[ekey].write(payload, FLAG_DATA)
             index = self._next_execute
             self._next_execute += 1
         return CompiledDAGRef(self, index)

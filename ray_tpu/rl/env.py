@@ -133,7 +133,15 @@ class VectorEnv:
 
     def __init__(self, creator: Callable[[], Env], num_envs: int,
                  seed: int = 0):
-        self.envs: List[Env] = [make_env(creator) for _ in range(num_envs)]
+        if isinstance(creator, Env) and num_envs > 1:
+            # A bare Env instance would alias the same object across all
+            # sub-envs (N lockstep copies stepping one shared state) —
+            # give each sub-env its own deep copy instead.
+            import copy
+            self.envs: List[Env] = [copy.deepcopy(creator)
+                                    for _ in range(num_envs)]
+        else:
+            self.envs = [make_env(creator) for _ in range(num_envs)]
         self.num_envs = num_envs
         self.observation_dim = self.envs[0].observation_dim
         self.num_actions = self.envs[0].num_actions

@@ -49,15 +49,21 @@ class ActorPool:
     def get_next(self, timeout: float = None) -> Any:
         """Next result in submission order.
 
-        Bookkeeping happens before the fetch so a task that errored still
-        returns its actor to the pool and advances the cursor.
+        Waits for readiness BEFORE mutating any bookkeeping so a timeout
+        leaves the result fetchable on retry; errored tasks count as ready,
+        so they still return their actor to the pool and advance the cursor.
         """
         if not self.has_next():
             raise StopIteration("no pending results")
-        future = self._index_to_future.pop(self._next_return_index)
+        future = self._index_to_future[self._next_return_index]
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError(
+                f"timed out waiting for result {self._next_return_index}")
+        del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
         self._return_actor(future)
-        return ray_tpu.get(future, timeout=timeout)
+        return ray_tpu.get(future)
 
     def get_next_unordered(self, timeout: float = None) -> Any:
         """Next result in completion order."""

@@ -53,6 +53,7 @@ class Metric:
                     raise ValueError(
                         f"metric {name!r} already registered as "
                         f"{existing.metric_type}")
+                self._check_alias_compatible(existing)
                 # Same-name metrics aggregate (Ray semantics): share the
                 # canonical instance's state so no recorded value is lost.
                 self._values = existing._values
@@ -81,6 +82,10 @@ class Metric:
                     f"{self._name!r} (declared: {list(self._tag_keys)})")
             merged.update(tags)
         return merged
+
+    def _check_alias_compatible(self, existing: "Metric") -> None:
+        """Subclass hook: validate shape-compatibility with the canonical
+        same-name instance whose state this one is about to share."""
 
     def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
         raise NotImplementedError
@@ -129,6 +134,15 @@ class Histogram(Metric):
                  tag_keys: Optional[Sequence[str]] = None):
         self._boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
         super().__init__(name, description, tag_keys)
+
+    def _check_alias_compatible(self, existing: "Metric") -> None:
+        # Shared bucket lists are sized by the canonical boundaries;
+        # mismatched boundaries would mis-index or IndexError on observe().
+        if tuple(self._boundaries) != tuple(existing._boundaries):
+            raise ValueError(
+                f"histogram {self._name!r} already registered with "
+                f"boundaries {existing._boundaries}; got "
+                f"{self._boundaries}")
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:

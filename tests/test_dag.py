@@ -197,6 +197,27 @@ class TestRayCall:
         assert ray_tpu.get(ref) == 20
 
 
+class TestRevisitActorTopology:
+    def test_actor_revisited_after_other_actor(self, ray_start):
+        # a.add -> b.add -> a.add2: actor A's second step depends on B's
+        # output.  With up-front (all-in-channels-first) reads A would
+        # block on the B->A channel before running its first step — the
+        # per-step read order makes this standard PP topology work.
+        a = Adder.remote(1)
+        b = Adder.remote(10)
+        with InputNode() as inp:
+            x = a.add.bind(inp)          # runs on A
+            y = b.add.bind(x)            # runs on B
+            dag = a.add2.bind(x, y)      # back on A, needs B's output
+        compiled = dag.experimental_compile()
+        try:
+            # (5+1) + (5+1+10) = 22
+            assert compiled.execute(5).get(timeout=10) == 22
+            assert compiled.execute(0).get(timeout=10) == 12
+        finally:
+            compiled.teardown()
+
+
 class TestTeardownSemantics:
     def test_get_after_teardown_returns_drained_result(self, ray_start):
         a = Adder.remote(1)
