@@ -58,7 +58,8 @@ class LlamaConfig:
     attention_impl: str = "auto"
     # Mesh axis used by ring/ulysses attention.
     seq_axis: str = "sp"
-    remat: bool = True
+    # False | True/"full" | "mlp_only" (see forward_with_aux)
+    remat: Any = True
 
     def replace(self, **kw) -> "LlamaConfig":
         return dataclasses.replace(self, **kw)
@@ -180,8 +181,8 @@ def _attend(cfg: LlamaConfig, q, k, v, positions):
     raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
 
 
-def _block(cfg: LlamaConfig, cos, sin, positions, x, layer):
-    """One transformer block. x: [B, S, E]."""
+def _attn_half(cfg: LlamaConfig, cos, sin, positions, x, layer):
+    """Attention residual branch. x: [B, S, E] -> [B, S, E]."""
     dt = cfg.dtype
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bse,ehd->bhsd", h, layer["wq"].astype(dt),
@@ -195,7 +196,12 @@ def _block(cfg: LlamaConfig, cos, sin, positions, x, layer):
     attn = _attend(cfg, q, k, v, positions)
     attn_out = jnp.einsum("bhsd,hde->bse", attn, layer["wo"].astype(dt),
                           preferred_element_type=dt)
-    x = x + attn_out
+    return x + attn_out
+
+
+def _mlp_half(cfg: LlamaConfig, x, layer):
+    """MLP/MoE residual branch. x: [B, S, E] -> ([B, S, E], aux)."""
+    dt = cfg.dtype
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     if cfg.num_experts:
         mlp_out, aux = moe_layer(h, layer["router"].astype(dt),
@@ -215,6 +221,12 @@ def _block(cfg: LlamaConfig, cos, sin, positions, x, layer):
     return x + mlp_out, aux
 
 
+def _block(cfg: LlamaConfig, cos, sin, positions, x, layer):
+    """One transformer block. x: [B, S, E]."""
+    x = _attn_half(cfg, cos, sin, positions, x, layer)
+    return _mlp_half(cfg, x, layer)
+
+
 def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
                      cfg: LlamaConfig,
                      positions: Optional[jax.Array] = None):
@@ -228,10 +240,26 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
 
-    block = partial(_block, cfg, cos, sin, positions)
-    if cfg.remat:
+    # remat modes: False = save everything (small models only); True/"full" =
+    # recompute the whole block in backward; "mlp_only" = keep the attention
+    # half's residuals (incl. the flash kernel's q/k/v/out/LSE — the
+    # quadratic part is never recomputed) and recompute only the cheap MLP
+    # half.  "mlp_only" is the throughput sweet spot when HBM allows.
+    if cfg.remat in (True, "full"):
         block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.nothing_saveable)
+            partial(_block, cfg, cos, sin, positions),
+            policy=jax.checkpoint_policies.nothing_saveable)
+    elif cfg.remat == "mlp_only":
+        mlp = jax.checkpoint(
+            partial(_mlp_half, cfg),
+            policy=jax.checkpoint_policies.nothing_saveable)
+
+        def block(x, layer):
+            return mlp(_attn_half(cfg, cos, sin, positions, x, layer), layer)
+    elif cfg.remat is False:
+        block = partial(_block, cfg, cos, sin, positions)
+    else:
+        raise ValueError(f"unknown remat mode {cfg.remat!r}")
 
     def scan_body(x, layer):
         x, aux = block(x, layer)
@@ -257,8 +285,14 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
     logits, aux = forward_with_aux(params, tokens, cfg, positions)
     targets = jnp.concatenate(
         [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # logsumexp formulation: nll = LSE(logits) - logit[target].  Unlike
+    # log_softmax this never materializes a second [B, S, vocab] array —
+    # the LSE reduce fuses into the lm_head matmul consumer, and the
+    # backward's softmax is recomputed elementwise into the dW/dx matmuls.
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - tgt
     mask = batch.get("loss_mask")
     if mask is None:
         mask = jnp.concatenate(

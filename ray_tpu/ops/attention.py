@@ -1,11 +1,34 @@
-"""Causal (GQA) attention: pallas flash kernel + jnp reference.
+"""Causal (GQA) attention: pallas flash kernels + jnp reference.
 
-The pallas kernel blocks over queries only and keeps each head's full K/V in
-VMEM (fine up to ~8k tokens at 128 head_dim; longer sequences use
-ring_attention / ulysses which shard the sequence before this kernel runs).
-Scores for a [block_q, seq] tile stay in registers/VMEM — the [seq, seq]
-matrix is never materialized in HBM, which is the HBM-bandwidth win over
-naive attention.  MXU work is two matmuls per tile with fp32 accumulation.
+FlashAttention-2 on TPU, forward *and* backward as pallas kernels:
+
+- Forward blocks over BOTH sequence axes — grid (B*H, Sq/bq, Sk/bk) with the
+  K/V axis innermost ("arbitrary" semantics) so pallas double-buffers K/V
+  block DMAs while the MXU works.  Online softmax state (running max m,
+  denominator l, unnormalized accumulator) lives in VMEM scratch carried
+  across K blocks; the [Sq, Sk] score matrix never exists in HBM.  The
+  log-sum-exp is written out as a residual (broadcast over the 128-lane
+  minor dim, the TPU-friendly layout the jax flash kernel also uses).
+- Backward is two kernels: dq (grid over K blocks innermost, accumulating
+  dq for a resident Q block) and dk/dv (grid over Q blocks innermost,
+  accumulating dk/dv for a resident K/V block).  Both recompute probabilities
+  from the saved LSE — one exp, no second softmax pass — with fp32
+  accumulation and bf16 MXU inputs.
+- Causal block-skipping: blocks strictly above the diagonal are predicated
+  out with pl.when and their K/V DMAs are redirected to block 0 (the next
+  useful block), so the skipped half of the grid costs neither FLOPs nor
+  bandwidth.
+- GQA is native: the K/V index maps collapse query heads onto their shared
+  KV head; dk/dv are emitted per query head and group-summed outside only
+  when kv_heads < heads.
+
+``q_offset`` shifts query positions for causal masking so sequence-sharded
+callers (ring attention) can flash-attend a mid-sequence Q shard.
+
+Design provenance (patterns, not code): the reference delegates attention to
+engines (SURVEY §2.4 SP/CP row — no in-repo kernel); the block/layout recipe
+follows jax.experimental.pallas.ops.tpu.flash_attention (LSE lane broadcast,
+dual-axis grid, prefetch-redirect on skipped causal blocks).
 """
 
 from __future__ import annotations
@@ -18,6 +41,8 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+LANES = 128
 
 
 def reference_attention(q, k, v, *, causal: bool = True,
@@ -46,32 +71,87 @@ def reference_attention(q, k, v, *, causal: bool = True,
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
+def _bcast_lanes(x128, n):
+    """[rows, 128] lane-broadcast value -> [rows, n]."""
+    if n == LANES:
+        return x128
+    if n % LANES == 0:
+        return jnp.tile(x128, (1, n // LANES))
+    if n < LANES:
+        return x128[:, :n]
+    raise NotImplementedError(f"n={n} not a multiple of {LANES}")
+
+
+def _visible(qi, bq, ki, bk, q_offset):
+    """Causal: does q block qi see any of k block ki?"""
+    return (qi + 1) * bq - 1 + q_offset >= ki * bk
+
+
+def _causal_mask_bias(s_shape, qi, bq, ki, bk, q_offset):
+    row = jax.lax.broadcasted_iota(jnp.int32, s_shape, 0) + qi * bq + q_offset
+    col = jax.lax.broadcasted_iota(jnp.int32, s_shape, 1) + ki * bk
+    return jnp.where(col <= row, 0.0, MASK_VALUE)
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, nk, q_offset):
+    # lse_ref is None when the caller doesn't need residuals (inference).
     from jax.experimental import pallas as pl
+
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
-    k = k_ref[0]                      # [Sk, D]
-    v = v_ref[0]
-    scores = jax.lax.dot_general(
-        q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # [block_q, Sk]
-    if causal:
-        qpos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(qpos >= kpos, scores, NEG_INF)
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    e = jnp.exp(scores - m)
-    denom = jnp.sum(e, axis=-1, keepdims=True)
-    probs = e / denom
-    o_ref[0] = jax.lax.dot(probs.astype(v.dtype), v,
-                           preferred_element_type=jnp.float32
-                           ).astype(o_ref.dtype)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    run = True if not causal else _visible(qi, block_q, ki, block_k, q_offset)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]                                   # [bq, D]
+        k = k_ref[0]                                   # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            s = s + _causal_mask_bias(s.shape, qi, block_q, ki, block_k,
+                                      q_offset)
+        m_prev = m_scr[...]                            # [bq, 128]
+        l_prev = l_scr[...]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        p = jnp.exp(s - _bcast_lanes(m_next, s.shape[1]))
+        alpha = jnp.exp(m_prev - m_next)               # [bq, 128]
+        l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1)[:, None]
+        m_scr[...] = m_next
+        v = v_ref[0]
+        pv = jax.lax.dot(p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * _bcast_lanes(alpha, acc_scr.shape[1]) \
+            + pv
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[...]
+        l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
+        o_ref[0] = (acc_scr[...]
+                    * _bcast_lanes(l_inv, acc_scr.shape[1])
+                    ).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0] = m_scr[...] + jnp.log(jnp.where(l == 0.0, 1.0, l))
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, interpret):
-    """Returns out [B,H,S,D]."""
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, q_offset,
+                   interpret, *, need_lse):
     from jax.experimental import pallas as pl
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:          # pragma: no cover
+        pltpu = None
 
     B, H, Sq, D = q.shape
     _, Hkv, Sk, _ = k.shape
@@ -79,105 +159,322 @@ def _flash_forward(q, k, v, causal, scale, block_q, interpret):
         raise ValueError(f"H={H} not divisible by Hkv={Hkv}")
     group = H // Hkv
     block_q = min(block_q, Sq)
-    if Sq % block_q:
-        raise ValueError(f"seq {Sq} not divisible by block_q {block_q}")
+    block_k = min(block_k, Sk)
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"seq ({Sq},{Sk}) not divisible by blocks ({block_q},{block_k})")
+    nq, nk = Sq // block_q, Sk // block_k
 
     qr = q.reshape(B * H, Sq, D)
     kr = k.reshape(B * Hkv, Sk, D)
     vr = v.reshape(B * Hkv, Sk, D)
 
-    def q_index(bh, qi):
+    def q_index(bh, qi, ki):
         return (bh, qi, 0)
 
-    def kv_index(bh, qi):
-        b = bh // H
-        h = bh % H
-        return (b * Hkv + h // group, 0, 0)
+    def kv_index(bh, qi, ki):
+        row = (bh // H) * Hkv + (bh % H) // group
+        if causal:
+            ki = jnp.where(
+                _visible(qi, block_q, ki, block_k, q_offset), ki, 0)
+        return (row, ki, 0)
 
-    out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
-        grid=(B * H, Sq // block_q),
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, nk=nk, q_offset=q_offset)
+
+    params = {}
+    if pltpu is not None and not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    out_specs = [pl.BlockSpec((1, block_q, D), q_index)]
+    out_shape = [jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype)]
+    if need_lse:
+        out_specs.append(pl.BlockSpec((1, block_q, LANES), q_index))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B * H, Sq, LANES), jnp.float32))
+    else:
+        # No LSE output at all: skip ~B*H*Sq*128 fp32 of dead HBM writes.
+        kernel = functools.partial(
+            lambda q, k, v, o, m, l, a, *, _k: _k(q, k, v, o, None, m, l, a),
+            _k=kernel)
+
+    res = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), q_index),
-            pl.BlockSpec((1, Sk, D), kv_index),
-            pl.BlockSpec((1, Sk, D), kv_index),
+            pl.BlockSpec((1, block_k, D), kv_index),
+            pl.BlockSpec((1, block_k, D), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), q_index),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            _vmem((block_q, LANES), jnp.float32),
+            _vmem((block_q, LANES), jnp.float32),
+            _vmem((block_q, D), jnp.float32),
+        ],
         interpret=interpret,
+        **params,
     )(qr, kr, vr)
-    return out.reshape(B, H, Sq, D)
+    out = res[0].reshape(B, H, Sq, D)
+    if not need_lse:
+        return out, None
+    return out, res[1][..., 0].reshape(B, H, Sq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, interpret)
+def _vmem(shape, dtype):
+    from jax.experimental import pallas as pl
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        return pltpu.VMEM(shape, dtype)
+    except ImportError:          # pragma: no cover
+        return pl.MemoryRef(shape, dtype)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, interpret)
-    return out, (q, k, v, out)
+# ---------------------------------------------------------------- backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, dq_scr,
+               *, scale, causal, block_q, block_k, nk, q_offset):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    run = True if not causal else _visible(qi, block_q, ki, block_k, q_offset)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                               # [bq, 128]
+        di = di_ref[0]                                 # [bq, 128]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = s + _causal_mask_bias(s.shape, qi, block_q, ki, block_k,
+                                      q_offset)
+        p = jnp.exp(s - _bcast_lanes(lse, s.shape[1]))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - _bcast_lanes(di, s.shape[1])) * scale
+        dq_scr[...] += jax.lax.dot(ds.astype(k.dtype), k,
+                                   preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, interpret, res, dout):
-    """Blocked FA2-style backward in jnp: chunked over q blocks so the
-    [Sq, Sk] score matrix only ever exists one block-row at a time; the
-    einsums hit the MXU and XLA fuses the elementwise chain.  Softmax is
-    recomputed per block (stable, full row available), so the forward saves
-    no LSE.  (A dedicated pallas backward kernel is the planned upgrade.)"""
-    q, k, v, out = res
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, block_q, block_k, nq, q_offset):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    run = True if not causal else _visible(qi, block_q, ki, block_k, q_offset)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        di = di_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            s = s + _causal_mask_bias(s.shape, qi, block_q, ki, block_k,
+                                      q_offset)
+        p = jnp.exp(s - _bcast_lanes(lse, s.shape[1]))
+        dv_scr[...] += jax.lax.dot(
+            p.T.astype(do.dtype), do, preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - _bcast_lanes(di, s.shape[1])) * scale
+        dk_scr[...] += jax.lax.dot(
+            ds.T.astype(q.dtype), q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, dout, causal, scale, block_q, block_k,
+                    q_offset, interpret):
+    from jax.experimental import pallas as pl
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:          # pragma: no cover
+        pltpu = None
+
     B, H, Sq, D = q.shape
     _, Hkv, Sk, _ = k.shape
     group = H // Hkv
-    kf = jnp.repeat(k, group, axis=1).astype(jnp.float32)
-    vf = jnp.repeat(v, group, axis=1).astype(jnp.float32)
-    qf = q.astype(jnp.float32)
-    do = dout.astype(jnp.float32)
-    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    nq, nk = Sq // block_q, Sk // block_k
 
-    nblk = Sq // min(block_q, Sq)
-    bq = Sq // nblk
+    # delta_i = rowsum(dO * O): one fused elementwise+reduce pass in XLA.
+    di = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
-    def body(carry, i):
-        dk, dv = carry
-        sl = jax.lax.dynamic_slice_in_dim
-        qi = sl(qf, i * bq, bq, axis=2)          # [B,H,bq,D]
-        doi = sl(do, i * bq, bq, axis=2)
-        deltai = sl(delta, i * bq, bq, axis=2)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qi, kf) * scale
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * Hkv, Sk, D)
+    vr = v.reshape(B * Hkv, Sk, D)
+    dor = dout.reshape(B * H, Sq, D)
+    # LSE/delta residuals broadcast over the lane dim (layout-friendly).
+    lser = jnp.broadcast_to(lse.reshape(B * H, Sq)[..., None],
+                            (B * H, Sq, LANES))
+    dir_ = jnp.broadcast_to(di.reshape(B * H, Sq)[..., None],
+                            (B * H, Sq, LANES))
+
+    params = {}
+    if pltpu is not None and not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    def kv_row(bh):
+        return (bh // H) * Hkv + (bh % H) // group
+
+    # ---- dq: Q block resident, K/V blocks stream (ki innermost).
+    def q_index(bh, qi, ki):
+        return (bh, qi, 0)
+
+    def kv_index_dq(bh, qi, ki):
         if causal:
-            qpos = i * bq + jnp.arange(bq)
-            mask = qpos[:, None] >= jnp.arange(Sk)[None, :]
-            scores = jnp.where(mask[None, None], scores, NEG_INF)
-        p = jax.nn.softmax(scores, axis=-1)
-        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, doi)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", doi, vf)
-        ds = p * (dp - deltai[..., None]) * scale
-        dqi = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qi)
-        return (dk, dv), dqi
+            ki = jnp.where(
+                _visible(qi, block_q, ki, block_k, q_offset), ki, 0)
+        return (kv_row(bh), ki, 0)
 
-    zeros = jnp.zeros((B, H, Sk, D), jnp.float32)
-    (dk, dv), dq_blocks = jax.lax.scan(body, (zeros, zeros),
-                                       jnp.arange(nblk))
-    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(B, H, Sq, D)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          q_offset=q_offset),
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), q_index),
+            pl.BlockSpec((1, block_k, D), kv_index_dq),
+            pl.BlockSpec((1, block_k, D), kv_index_dq),
+            pl.BlockSpec((1, block_q, D), q_index),
+            pl.BlockSpec((1, block_q, LANES), q_index),
+            pl.BlockSpec((1, block_q, LANES), q_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), q_index),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[_vmem((block_q, D), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(qr, kr, vr, dor, lser, dir_).reshape(B, H, Sq, D)
+
+    # ---- dk/dv: K/V block resident, Q blocks stream (qi innermost).
+    # Emitted per *query* head; group-summed below when GQA.
+    def kv_index(bh, ki, qi):
+        return (kv_row(bh), ki, 0)
+
+    def q_index_dkv(bh, ki, qi):
+        if causal:
+            # Skipped q blocks (above diagonal) redirect their DMA to the
+            # next diagonal block to avoid wasted bandwidth.
+            qi = jnp.where(
+                _visible(qi, block_q, ki, block_k, q_offset), qi,
+                jnp.minimum((ki * block_k) // block_q, nq - 1))
+        return (bh, qi, 0)
+
+    def dkv_index(bh, ki, qi):
+        return (bh, ki, 0)
+
+    dkv_dtype = jnp.float32 if group > 1 else q.dtype
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          q_offset=q_offset),
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), q_index_dkv),
+            pl.BlockSpec((1, block_k, D), kv_index),
+            pl.BlockSpec((1, block_k, D), kv_index),
+            pl.BlockSpec((1, block_q, D), q_index_dkv),
+            pl.BlockSpec((1, block_q, LANES), q_index_dkv),
+            pl.BlockSpec((1, block_q, LANES), q_index_dkv),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), dkv_index),
+            pl.BlockSpec((1, block_k, D), dkv_index),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk, D), dkv_dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), dkv_dtype),
+        ],
+        scratch_shapes=[
+            _vmem((block_k, D), jnp.float32),
+            _vmem((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+        **params,
+    )(qr, kr, vr, dor, lser, dir_)
+
+    dk = dk.reshape(B, H, Sk, D)
+    dv = dv.reshape(B, H, Sk, D)
     if group > 1:
-        dk = dk.reshape(B, Hkv, group, Sk, D).sum(axis=2)
-        dv = dv.reshape(B, Hkv, group, Sk, D).sum(axis=2)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        dk = dk.reshape(B, Hkv, group, Sk, D).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(B, Hkv, group, Sk, D).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- wrapper
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, q_offset, interpret):
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            q_offset, interpret, need_lse=False)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, q_offset, interpret):
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              q_offset, interpret, need_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, q_offset, interpret, res,
+               dout):
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, dout, causal, scale, block_q,
+                           block_k, q_offset, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
-                    scale: Optional[float] = None, block_q: int = 256,
+                    scale: Optional[float] = None, block_q: int = 512,
+                    block_k: int = 512, q_offset: int = 0,
                     interpret: bool = False):
-    """Pallas flash attention with custom VJP.
-    q: [B, H, S, D]; k/v: [B, Hkv, S, D]."""
+    """Pallas flash attention (fwd + bwd kernels) with custom VJP.
+    q: [B, H, Sq, D]; k/v: [B, Hkv, Sk, D]."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    return _flash(q, k, v, causal, scale, block_q, interpret)
+    return _flash(q, k, v, causal, scale, block_q, block_k, q_offset,
+                  interpret)
 
 
 def _on_tpu() -> bool:

@@ -88,6 +88,51 @@ class TestFlashAttention:
         np.testing.assert_allclose(out, reference_attention(q, k, v),
                                    atol=1e-6)
 
+    def test_multi_k_block_online_softmax(self):
+        # block_k < Sk exercises the m/l/acc carry across K blocks.
+        q, k, v = _qkv(jax.random.key(4), S=128)
+        ref = reference_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=64,
+                              interpret=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-4)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_backward_matches_reference(self, causal):
+        q, k, v = _qkv(jax.random.key(5), S=128)
+        do = jax.random.normal(jax.random.key(6), q.shape)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(fn(q, k, v) * do)
+
+        ref_fn = loss(lambda q, k, v: reference_attention(
+            q, k, v, causal=causal))
+        fl_fn = loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, block_q=32, block_k=64, interpret=True))
+        gr = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(fl_fn, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gr, gf, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(b, a, atol=5e-4, rtol=1e-3,
+                                       err_msg=name)
+
+    def test_backward_gqa_offset(self):
+        # GQA group-sum of dk/dv plus a ring-style q_offset.
+        B, H, Hkv, Sq, Sk, D = 1, 4, 2, 64, 128, 32
+        ks = jax.random.split(jax.random.key(7), 4)
+        q = jax.random.normal(ks[0], (B, H, Sq, D))
+        k = jax.random.normal(ks[1], (B, Hkv, Sk, D))
+        v = jax.random.normal(ks[2], (B, Hkv, Sk, D))
+        do = jax.random.normal(ks[3], (B, H, Sq, D))
+
+        gr = jax.grad(lambda q, k, v: jnp.sum(reference_attention(
+            q, k, v, causal=True, q_offset=64) * do), argnums=(0, 1, 2))(
+                q, k, v)
+        gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=32, block_k=64, q_offset=64,
+            interpret=True) * do), argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gr, gf, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(b, a, atol=5e-4, rtol=1e-3,
+                                       err_msg=name)
+
 
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [True, False])
