@@ -47,12 +47,19 @@ _init_lock = threading.Lock()
 def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[int] = None,
          resources: Optional[Dict[str, float]] = None,
          namespace: str = "default", ignore_reinit_error: bool = True,
+         head_port: Optional[int] = None,
+         cluster_token: Optional[bytes] = None,
          **_compat: Any):
     """Start the ray_tpu runtime in this process (driver).
 
     Reference analog: ray.init (python/ray/_private/worker.py:1441) — but the
     control plane, node plane and driver live in one process for single-host
     sessions; worker processes are spawned on demand.
+
+    ``head_port`` (0 = ephemeral) opens the cluster join point so remote
+    nodes can register via ``ray-tpu start --address=<host:port>``
+    (reference: ray start joining a GCS).  The bound address is
+    ``runtime.head_server.address``.
     """
     with _init_lock:
         if _runtime_mod.driver_runtime() is not None:
@@ -61,7 +68,8 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[int] = None,
             raise RuntimeError("ray_tpu.init() already called")
         return _runtime_mod.init_runtime(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
-            namespace=namespace)
+            namespace=namespace, head_port=head_port,
+            cluster_token=cluster_token)
 
 
 def is_initialized() -> bool:

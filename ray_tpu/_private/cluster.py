@@ -1,0 +1,909 @@
+"""Multi-node cluster plane: head service, node join, object transfer.
+
+The reference splits this across the GCS node manager (reference:
+src/ray/gcs/gcs_node_manager.h — registration, liveness,
+gcs_health_check_manager.h:46 probes), per-node raylets speaking gRPC
+(src/ray/raylet/node_manager.cc:1798 lease protocol) and the object manager's
+pull/push pair (src/ray/object_manager/pull_manager.h:50, push_manager.h:28)
+with owner-based location lookup (ownership_object_directory.cc).
+
+Here the head (driver) process stays the control plane — the round-1
+Runtime/Controller/Scheduler — and grows a TCP listener that remote
+``NodeServer`` processes join.  Each remote node runs the same
+``NodeManager`` worker pool used locally, behind a small facade that
+forwards runtime callbacks upstream.  The data plane is peer-to-peer: every
+node (head included) runs a ``DataServer`` bound to its shm object store;
+descriptors crossing node boundaries are tagged ``("at", node_id_bytes,
+desc)`` and consumers pull the payload from the owner's data port, cache it
+in their local store, and proceed zero-copy from there — the owner-directory
+pattern with the head as the location oracle.
+
+Transport: ``multiprocessing.connection`` over TCP with an HMAC authkey
+(the cluster token).  Control messages are the dataclasses in protocol.py
+plus the Up*/down wrappers below; object payloads ride the data plane, not
+the control pipe.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from multiprocessing.connection import Client, Listener
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import Config
+from .controller import NodeInfo
+from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from .protocol import (ActorStateMsg, GetReply, GetRequest, PutFromWorker,
+                       RpcCall, RpcReply, TaskDone, TaskSpec, WaitRequest)
+from .resources import ResourceSet
+
+DEFAULT_TOKEN = b"ray-tpu-cluster"
+
+
+# --------------------------------------------------------------------------
+# wire messages (head <-> node server)
+# --------------------------------------------------------------------------
+
+@dataclass
+class RegisterNode:
+    hostname: str
+    resources: Dict[str, float]
+    num_tpu_chips: int
+    data_address: Tuple[str, int]
+
+
+@dataclass
+class RegisterAck:
+    node_id_bytes: bytes
+    job_id_bytes: bytes
+    config_blob: str
+    head_data_address: Tuple[str, int]
+    head_node_id_bytes: bytes
+
+
+@dataclass
+class DispatchTask:
+    spec: TaskSpec
+    args: list
+    kwargs: dict
+    target_worker: Optional[WorkerID]
+
+
+@dataclass
+class ToWorker:
+    worker_id: WorkerID
+    msg: Any
+
+
+@dataclass
+class KillActorWorker:
+    worker_id: WorkerID
+    force: bool = True
+
+
+@dataclass
+class NodeShutdown:
+    pass
+
+
+@dataclass
+class Ping:
+    t: float
+
+
+@dataclass
+class Pong:
+    t: float
+
+
+@dataclass
+class NodeRpc:
+    """Node server -> head control call (same ctl_* registry as workers)."""
+    request_id: int
+    method: str
+    args: tuple
+    kwargs: dict
+
+
+@dataclass
+class NodeRpcReply:
+    request_id: int
+    value: Any
+    error: Optional[str] = None
+
+
+# Upstream runtime callbacks (node server -> head), mirroring the method
+# calls NodeManager makes on the driver Runtime.
+@dataclass
+class UpTaskDone:
+    msg: TaskDone
+
+
+@dataclass
+class UpNoteTaskRunning:
+    task_id: TaskID
+    worker_id: WorkerID
+
+
+@dataclass
+class UpWorkerDied:
+    worker_id: WorkerID
+    running: List[TaskID]
+    actor_id: Optional[ActorID]
+
+
+@dataclass
+class UpDispatchFailed:
+    spec: TaskSpec
+    reason: str
+
+
+@dataclass
+class UpReleaseResources:
+    resources: Dict[str, float]
+    pg_bytes: Optional[bytes]
+    bundle_index: int
+
+
+@dataclass
+class UpBindActor:
+    actor_id: ActorID
+    worker_id: WorkerID
+
+
+@dataclass
+class UpSubmit:
+    spec: TaskSpec
+
+
+@dataclass
+class UpActorState:
+    msg: ActorStateMsg
+    worker_id: WorkerID
+
+
+# --------------------------------------------------------------------------
+# descriptor location tagging
+# --------------------------------------------------------------------------
+
+def tag_desc(desc, node_id_bytes: bytes):
+    """Mark a node-local descriptor with its owner node."""
+    if isinstance(desc, tuple) and desc and desc[0] in ("shm", "shma"):
+        return ("at", node_id_bytes, desc)
+    return desc
+
+
+def untag_desc(desc, local_node_id_bytes: bytes):
+    """Strip an "at" tag when the object is local; else return None."""
+    if isinstance(desc, tuple) and desc and desc[0] == "at":
+        if desc[1] == local_node_id_bytes:
+            return desc[2]
+        return None
+    return desc
+
+
+def desc_key(desc) -> Optional[bytes]:
+    """Stable fetch key for a (possibly inner) descriptor."""
+    if desc[0] == "shma":
+        return desc[4]
+    if desc[0] == "shm":
+        return desc[1].encode()
+    return None
+
+
+# --------------------------------------------------------------------------
+# data plane: per-node object server + pull client
+# --------------------------------------------------------------------------
+
+class DataServer:
+    """Serves raw object payloads out of the local store (push side of the
+    reference's PushManager, reference: push_manager.h:28 — one message per
+    object; chunking is delegated to the socket layer)."""
+
+    def __init__(self, store, token: bytes, host: str = "0.0.0.0",
+                 advertise_host: str = "127.0.0.1"):
+        self._store = store
+        self._listener = Listener((host, 0), "AF_INET", authkey=token)
+        # Advertised address must be peer-reachable (the bind host is a
+        # wildcard); cross-machine clusters pass their routable IP.
+        self.address: Tuple[str, int] = (advertise_host,
+                                         self._listener.address[1])
+        self._closed = False
+        threading.Thread(target=self._accept_loop, name="data-server",
+                         daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                if self._closed:
+                    return
+                continue
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        try:
+            while True:
+                desc = conn.recv()
+                payload = self._read(desc)
+                conn.send(payload)  # None = gone
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _read(self, desc) -> Optional[bytes]:
+        try:
+            if desc[0] == "shma":
+                return self._store.read_raw_by_key(desc[4])
+            if desc[0] == "shm":
+                # Per-object segment (Python store or worker-written):
+                # readable by name from any process on this host.
+                from .object_store import _open_untracked
+                seg = _open_untracked(desc[1], create=False)
+                try:
+                    return bytes(seg.buf[: desc[2]])
+                finally:
+                    seg.close()
+        except FileNotFoundError:
+            return None
+        except Exception:
+            return None
+        return None
+
+    def shutdown(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+
+
+class DataClient:
+    """Pull side (reference: pull_manager.h:50): pooled connections to peer
+    data servers, one in-flight request per peer connection."""
+
+    def __init__(self, token: bytes):
+        self._token = token
+        self._conns: Dict[Tuple[str, int], Any] = {}
+        self._locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._lock = threading.Lock()
+
+    def fetch(self, address: Tuple[str, int], desc) -> Optional[bytes]:
+        address = tuple(address)
+        with self._lock:
+            lk = self._locks.setdefault(address, threading.Lock())
+        with lk:
+            conn = self._conns.get(address)
+            for attempt in (0, 1):
+                try:
+                    if conn is None:
+                        conn = Client(address, authkey=self._token)
+                        self._conns[address] = conn
+                    conn.send(desc)
+                    return conn.recv()
+                except Exception:
+                    # Covers dead peers (ConnectionRefusedError), token
+                    # mismatch (AuthenticationError) and broken pipes alike:
+                    # a failed pull must degrade to "object unreachable",
+                    # never escape into the dispatch/reply loops.
+                    if conn is not None:
+                        try:
+                            conn.close()
+                        except Exception:
+                            pass
+                        self._conns.pop(address, None)
+                    conn = None
+            return None
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for conn in self._conns.values():
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            self._conns.clear()
+
+
+class ObjectPuller:
+    """Resolve possibly-remote descriptors into local-store descriptors.
+
+    The location oracle maps node_id -> data address; payloads are cached in
+    the local store under their ObjectID so repeated consumers stay
+    zero-copy (reference: object copies are first-class locations in the
+    object directory)."""
+
+    def __init__(self, store, data_client: DataClient,
+                 local_node_id_bytes: bytes, resolve_address):
+        self._store = store
+        self._client = data_client
+        self._local = local_node_id_bytes
+        self._resolve_address = resolve_address  # node_id_bytes -> (h, p)|None
+
+    def localize(self, desc):
+        """Returns a local descriptor, or ("err", payload) if unreachable."""
+        from . import serialization
+        from .exceptions import ObjectLostError
+
+        if not (isinstance(desc, tuple) and desc and desc[0] == "at"):
+            return desc
+        if desc[1] == self._local:
+            return desc[2]
+        inner = desc[2]
+        key = desc_key(inner)
+        oid = ObjectID(inner[4]) if inner[0] == "shma" else None
+        if oid is None and inner[0] == "shm":
+            # Python-store descriptors embed the object id in the shm name
+            # (rt_<hex>); recover it for the local cache key.
+            name = inner[1]
+            try:
+                oid = ObjectID(bytes.fromhex(name.split("_", 1)[1]))
+            except Exception:
+                oid = ObjectID.from_random()  # unparseable: one-off cache key
+        # Cache hit?
+        local = self._store.descriptor(oid)
+        if local is not None:
+            return local
+        addr = self._resolve_address(desc[1])
+        payload = None
+        if addr is not None:
+            payload = self._client.fetch(addr, inner)
+        if payload is None:
+            return ("err", serialization.pack_payload(ObjectLostError(
+                f"object {oid} unreachable (owner node gone?)")))
+        local = self._store.put_raw(oid, payload)
+        if local is None:
+            return ("err", serialization.pack_payload(ObjectLostError(
+                f"object {oid} could not be cached locally")))
+        return local
+
+    def localize_all(self, args: list, kwargs: dict):
+        return ([self.localize(d) for d in args],
+                {k: self.localize(d) for k, d in kwargs.items()})
+
+
+# --------------------------------------------------------------------------
+# head side
+# --------------------------------------------------------------------------
+
+class RemoteNodeProxy:
+    """Head-side stand-in for a joined node: NodeManager's dispatch surface
+    over the control connection (reference: raylet client pool)."""
+
+    is_remote = True
+
+    def __init__(self, head: "HeadServer", conn, info: NodeInfo,
+                 data_address: Tuple[str, int]):
+        self.head = head
+        self.conn = conn
+        self.info = info
+        self.data_address = data_address
+        self.store = None  # no local store access on the head
+        self._send_lock = threading.Lock()
+        self.alive = True
+        self.last_seen = time.monotonic()
+
+    def send(self, msg) -> None:
+        try:
+            with self._send_lock:
+                self.conn.send(msg)
+        except (BrokenPipeError, OSError):
+            pass  # reader loop handles the death
+
+    # -- NodeManager surface -------------------------------------------------
+
+    def dispatch_task(self, spec: TaskSpec, resolved_args, resolved_kwargs,
+                      target_worker: Optional[WorkerID] = None) -> None:
+        # Untagged descriptors in the head directory are head-local; tag
+        # them so the receiving node knows where to pull from.
+        hid = self.head.runtime.node_id.binary()
+        args = [tag_desc(d, hid) for d in resolved_args]
+        kwargs = {k: tag_desc(d, hid) for k, d in resolved_kwargs.items()}
+        self.send(DispatchTask(spec, args, kwargs, target_worker))
+
+    def send_to_worker(self, worker_id: WorkerID, msg) -> None:
+        self.send(ToWorker(worker_id, msg))
+
+    def kill_actor_worker(self, worker_id: WorkerID,
+                          force: bool = True) -> None:
+        self.send(KillActorWorker(worker_id, force))
+
+    def track_get_pins(self, worker_id, request_id, keys) -> None:
+        # Pins for remote readers live on the owning node, not the head.
+        pass
+
+    def shutdown(self) -> None:
+        self.send(NodeShutdown())
+
+
+class HeadServer:
+    """TCP join point on the head: accepts NodeServer registrations, routes
+    upstream runtime callbacks, detects node death (EOF + ping timeouts)."""
+
+    def __init__(self, runtime, port: int = 0, token: bytes = DEFAULT_TOKEN,
+                 host: str = "0.0.0.0",
+                 advertise_host: Optional[str] = None):
+        self.runtime = runtime
+        self.token = token
+        self._listener = Listener((host, port), "AF_INET", authkey=token)
+        bound = self._listener.address
+        self.advertise_host = advertise_host or "127.0.0.1"
+        self.address: Tuple[str, int] = (self.advertise_host, bound[1])
+        self.proxies: Dict[NodeID, RemoteNodeProxy] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        threading.Thread(target=self._accept_loop, name="head-accept",
+                         daemon=True).start()
+        threading.Thread(target=self._ping_loop, name="head-ping",
+                         daemon=True).start()
+
+    # -- membership ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                if self._closed:
+                    return
+                continue
+            threading.Thread(target=self._register, args=(conn,),
+                             daemon=True).start()
+
+    def _register(self, conn) -> None:
+        try:
+            msg: RegisterNode = conn.recv()
+        except (EOFError, OSError):
+            conn.close()
+            return
+        if not isinstance(msg, RegisterNode):
+            conn.close()
+            return
+        node_id = NodeID.from_random()
+        info = NodeInfo(node_id, msg.hostname, ResourceSet(msg.resources),
+                        is_head=False)
+        proxy = RemoteNodeProxy(self, conn, info, msg.data_address)
+        rt = self.runtime
+        with self._lock:
+            self.proxies[node_id] = proxy
+        rt.controller.register_node(info)
+        rt.nodes[node_id] = proxy
+        proxy.send(RegisterAck(
+            node_id.binary(), rt.job_id.binary(), Config.blob(),
+            rt.data_server.address, rt.node_id.binary()))
+        # Register with the scheduler only after the ack is on the wire so
+        # the first dispatch can't race the node's own setup.
+        rt.scheduler.add_node(info)
+        threading.Thread(target=self._reader_loop, args=(proxy,),
+                         name=f"head-node-{node_id.hex()[:8]}",
+                         daemon=True).start()
+
+    def _ping_loop(self) -> None:
+        """Liveness probes (reference: gcs_health_check_manager.h:46): a
+        node that misses `failure_threshold` ping periods is force-closed,
+        which kicks its reader loop into the death path — catching silent
+        partitions that never deliver a FIN/RST."""
+        period = float(Config.get("health_check_period_s"))
+        threshold = int(Config.get("health_check_failure_threshold"))
+        while not self._closed:
+            time.sleep(period)
+            now = time.monotonic()
+            with self._lock:
+                proxies = list(self.proxies.values())
+            for p in proxies:
+                if now - p.last_seen > period * threshold:
+                    try:
+                        p.conn.close()
+                    except Exception:
+                        pass
+                    continue
+                p.send(Ping(now))
+
+    def _reader_loop(self, proxy: RemoteNodeProxy) -> None:
+        conn = proxy.conn
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            try:
+                self._handle(proxy, msg)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+        self._on_node_death(proxy)
+
+    def _on_node_death(self, proxy: RemoteNodeProxy) -> None:
+        if self._closed:
+            return
+        with self._lock:
+            if not proxy.alive:
+                return
+            proxy.alive = False
+            self.proxies.pop(proxy.info.node_id, None)
+        self.runtime.on_node_died(proxy.info.node_id)
+
+    # -- upstream routing ----------------------------------------------------
+
+    def _handle(self, proxy: RemoteNodeProxy, msg) -> None:
+        rt = self.runtime
+        nid = proxy.info.node_id
+        proxy.last_seen = time.monotonic()
+        if isinstance(msg, UpTaskDone):
+            rt.on_task_done(msg.msg, nid)
+        elif isinstance(msg, UpNoteTaskRunning):
+            rt.note_task_running(msg.task_id, nid, msg.worker_id)
+        elif isinstance(msg, UpWorkerDied):
+            rt.on_worker_died(msg.worker_id, nid, msg.running, msg.actor_id)
+        elif isinstance(msg, UpDispatchFailed):
+            rt.on_dispatch_failed(msg.spec, msg.reason)
+        elif isinstance(msg, UpReleaseResources):
+            from .ids import PlacementGroupID
+            pg = PlacementGroupID(msg.pg_bytes) if msg.pg_bytes else None
+            rt.scheduler.release(nid, ResourceSet(msg.resources), pg,
+                                 msg.bundle_index)
+        elif isinstance(msg, UpBindActor):
+            rt.bind_actor_worker(msg.actor_id, nid, msg.worker_id)
+        elif isinstance(msg, UpSubmit):
+            rt.submit_spec(msg.spec)
+        elif isinstance(msg, UpActorState):
+            rt.on_actor_state(msg.msg, nid, msg.worker_id)
+        elif isinstance(msg, GetRequest):
+            rt.on_get_request(proxy, msg)
+        elif isinstance(msg, WaitRequest):
+            rt.on_wait_request(proxy, msg)
+        elif isinstance(msg, PutFromWorker):
+            rt.on_put_from_worker(msg)
+        elif isinstance(msg, RpcCall):
+            rt.on_rpc_call(proxy, msg)
+        elif isinstance(msg, NodeRpc):
+            try:
+                fn = getattr(rt, "ctl_" + msg.method)
+                value = fn(*msg.args, **msg.kwargs)
+                proxy.send(NodeRpcReply(msg.request_id, value))
+            except Exception as e:  # noqa: BLE001
+                proxy.send(NodeRpcReply(msg.request_id, None, repr(e)))
+        elif isinstance(msg, RegisterNode):
+            # Second handshake message: the node's real data address (its
+            # data server can only bind after the ack delivers the config).
+            proxy.data_address = tuple(msg.data_address)
+        elif isinstance(msg, Pong):
+            pass
+
+    def node_data_address(self, node_id_bytes: bytes):
+        rt = self.runtime
+        if node_id_bytes == rt.node_id.binary():
+            return rt.data_server.address
+        with self._lock:
+            p = self.proxies.get(NodeID(node_id_bytes))
+        return p.data_address if p is not None else None
+
+    def shutdown(self) -> None:
+        self._closed = True
+        with self._lock:
+            proxies = list(self.proxies.values())
+            self.proxies.clear()
+        for p in proxies:
+            p.shutdown()
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# node server side
+# --------------------------------------------------------------------------
+
+class _UpstreamScheduler:
+    """scheduler facade NodeManager calls release() on."""
+
+    def __init__(self, server: "NodeServer"):
+        self._server = server
+
+    def release(self, node_id, resources: ResourceSet, pg=None,
+                bundle_index: int = -1) -> None:
+        self._server.send_up(UpReleaseResources(
+            resources.to_dict(), pg.binary() if pg is not None else None,
+            bundle_index))
+
+
+class _NodeServerRuntime:
+    """The `runtime` facade handed to the node-local NodeManager: every
+    callback the driver Runtime would receive is forwarded upstream."""
+
+    def __init__(self, server: "NodeServer", job_id):
+        self._server = server
+        self.job_id = job_id
+        self.scheduler = _UpstreamScheduler(server)
+
+    # NodeManager surface ---------------------------------------------------
+
+    def note_task_running(self, task_id, node_id, worker_id) -> None:
+        self._server.send_up(UpNoteTaskRunning(task_id, worker_id))
+
+    def on_task_done(self, msg: TaskDone, node_id) -> None:
+        nid = self._server.node_id.binary()
+        msg.results = [(oid, tag_desc(d, nid)) for oid, d in msg.results]
+        self._server.send_up(UpTaskDone(msg))
+
+    def on_dispatch_failed(self, spec, reason: str) -> None:
+        self._server.send_up(UpDispatchFailed(spec, reason))
+
+    def on_worker_died(self, worker_id, node_id, running, actor_id) -> None:
+        self._server.send_up(UpWorkerDied(worker_id, running, actor_id))
+
+    def bind_actor_worker(self, actor_id, node_id, worker_id) -> None:
+        self._server.send_up(UpBindActor(actor_id, worker_id))
+
+    def submit_spec(self, spec: TaskSpec) -> None:
+        self._server.send_up(UpSubmit(spec))
+
+    def on_get_request(self, node, msg: GetRequest) -> None:
+        self._server.send_up(msg)
+
+    def on_wait_request(self, node, msg: WaitRequest) -> None:
+        self._server.send_up(msg)
+
+    def on_put_from_worker(self, msg: PutFromWorker) -> None:
+        msg.desc = tag_desc(msg.desc, self._server.node_id.binary())
+        self._server.send_up(msg)
+
+    def on_actor_state(self, msg: ActorStateMsg, node_id, worker_id) -> None:
+        self._server.send_up(UpActorState(msg, worker_id))
+
+    def on_rpc_call(self, node, msg: RpcCall) -> None:
+        self._server.send_up(msg)
+
+
+class NodeServer:
+    """A joined cluster node: local NodeManager worker pool + data server,
+    driven by DispatchTask messages from the head (reference: the raylet —
+    node_manager.cc HandleRequestWorkerLease + object manager, minus local
+    scheduling authority, which stays central on the head)."""
+
+    def __init__(self, head_address: Tuple[str, int],
+                 token: bytes = DEFAULT_TOKEN,
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 advertise_host: str = "127.0.0.1"):
+        self.conn = Client(tuple(head_address), authkey=token)
+        self._send_lock = threading.Lock()
+
+        if num_tpus is None:
+            from ..accelerators.tpu import TPUAcceleratorManager
+            num_tpus = TPUAcceleratorManager.detect_num_chips()
+        node_resources: Dict[str, float] = {
+            "CPU": float(num_cpus if num_cpus is not None
+                         else (os.cpu_count() or 1)),
+        }
+        if num_tpus:
+            node_resources["TPU"] = float(num_tpus)
+        if resources:
+            node_resources.update(resources)
+
+        # Register first; the ack carries identity + config.
+        self._pre_register(node_resources, num_tpus, token, advertise_host)
+
+    def _pre_register(self, node_resources, num_tpus, token, advertise_host):
+        import json
+
+        from .node import NodeManager
+
+        self.conn.send(RegisterNode(socket.gethostname(), node_resources,
+                                    int(num_tpus or 0), ("pending", 0)))
+        ack: RegisterAck = self.conn.recv()
+        if not isinstance(ack, RegisterAck):
+            raise RuntimeError(f"unexpected registration reply: {ack!r}")
+        Config.initialize(json.loads(ack.config_blob))
+        from .ids import JobID
+        self.node_id = NodeID(ack.node_id_bytes)
+        self.job_id = JobID(ack.job_id_bytes)
+        self.head_data_address = tuple(ack.head_data_address)
+        self.head_node_id_bytes = ack.head_node_id_bytes
+
+        info = NodeInfo(self.node_id, socket.gethostname(),
+                        ResourceSet(node_resources), is_head=False)
+        self._rt = _NodeServerRuntime(self, self.job_id)
+        self.node = NodeManager(info, self._rt,
+                                num_tpu_chips=int(num_tpus or 0))
+        self.data_server = DataServer(self.node.store, token,
+                                      advertise_host=advertise_host)
+        self.data_address = self.data_server.address
+        self.data_client = DataClient(token)
+        self._addr_cache: Dict[bytes, Tuple[str, int]] = {}
+        self._rpc_lock = threading.Lock()
+        self._rpc_next = 0
+        self._rpc_waiters: Dict[int, Any] = {}
+        self.puller = ObjectPuller(self.node.store, self.data_client,
+                                   self.node_id.binary(),
+                                   self._resolve_address)
+        self._closed = False
+        # Dispatch and worker-bound messages run on their own ordered
+        # queues: localizing args may block on peer pulls (or a NodeRpc to
+        # the head, whose reply arrives on the serve thread) — processing
+        # them inline would deadlock the control loop.
+        import queue as _q
+        self._dispatch_q: Any = _q.Queue()
+        self._to_worker_q: Any = _q.Queue()
+        threading.Thread(target=self._queue_loop,
+                         args=(self._dispatch_q, self._do_dispatch),
+                         name="node-dispatch", daemon=True).start()
+        threading.Thread(target=self._queue_loop,
+                         args=(self._to_worker_q, self._do_to_worker),
+                         name="node-to-worker", daemon=True).start()
+        # Second message completes the handshake with the real data address.
+        self.send_up(RegisterNode(socket.gethostname(), node_resources,
+                                  int(num_tpus or 0), self.data_address))
+
+    def _resolve_address(self, node_id_bytes: bytes):
+        if node_id_bytes == self.head_node_id_bytes:
+            return self.head_data_address
+        addr = self._addr_cache.get(node_id_bytes)
+        if addr is None:
+            addr = self.node_rpc("node_data_address", node_id_bytes)
+            if addr is not None:
+                self._addr_cache[node_id_bytes] = tuple(addr)
+        return addr
+
+    # -- control plumbing ----------------------------------------------------
+
+    def send_up(self, msg) -> None:
+        try:
+            with self._send_lock:
+                self.conn.send(msg)
+        except (BrokenPipeError, OSError):
+            pass
+
+    def node_rpc(self, method: str, *args, **kwargs):
+        import queue
+        with self._rpc_lock:
+            self._rpc_next += 1
+            rid = self._rpc_next
+            q: Any = queue.Queue()
+            self._rpc_waiters[rid] = q
+        self.send_up(NodeRpc(rid, method, args, kwargs))
+        try:
+            value, error = q.get(timeout=30.0)
+        except Exception:
+            value, error = None, "node_rpc timeout"
+        finally:
+            with self._rpc_lock:
+                self._rpc_waiters.pop(rid, None)
+        if error:
+            return None
+        return value
+
+    # -- main loop -----------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        while not self._closed:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                break
+            try:
+                self._handle(msg)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+        self.shutdown()
+
+    def _queue_loop(self, q, fn) -> None:
+        while not self._closed:
+            item = q.get()
+            if item is None:
+                return
+            try:
+                fn(item)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _do_dispatch(self, msg: DispatchTask) -> None:
+        args, kwargs = self.puller.localize_all(msg.args, msg.kwargs)
+        self.node.dispatch_task(msg.spec, args, kwargs,
+                                target_worker=msg.target_worker)
+
+    def _do_to_worker(self, msg: ToWorker) -> None:
+        inner = msg.msg
+        if isinstance(inner, GetReply):
+            inner = self._localize_get_reply(msg.worker_id, inner)
+        self.node.send_to_worker(msg.worker_id, inner)
+
+    def _handle(self, msg) -> None:
+        if isinstance(msg, DispatchTask):
+            self._dispatch_q.put(msg)
+        elif isinstance(msg, ToWorker):
+            self._to_worker_q.put(msg)
+        elif isinstance(msg, KillActorWorker):
+            self.node.kill_actor_worker(msg.worker_id, msg.force)
+        elif isinstance(msg, Ping):
+            self.send_up(Pong(msg.t))
+        elif isinstance(msg, NodeRpcReply):
+            with self._rpc_lock:
+                q = self._rpc_waiters.get(msg.request_id)
+            if q is not None:
+                q.put((msg.value, msg.error))
+        elif isinstance(msg, NodeShutdown):
+            self._closed = True
+
+    def _localize_get_reply(self, worker_id: WorkerID,
+                            reply: GetReply) -> GetReply:
+        """Pull remote descriptors local and pin them for the reader
+        (plasma client-pin semantics on the consuming node)."""
+        values = []
+        pins: List[bytes] = []
+        for d in reply.values:
+            local = self.puller.localize(d)
+            if isinstance(local, tuple) and local and local[0] == "shma":
+                nd = self.node.store.pin_desc_by_key(local[4])
+                if nd is not None:
+                    pins.append(nd[4])
+                    local = nd
+            values.append(local)
+        if pins:
+            self.node.track_get_pins(worker_id, reply.request_id, pins)
+        return GetReply(reply.request_id, values, reply.timed_out)
+
+    def shutdown(self) -> None:
+        if getattr(self, "_shutdown_done", False):
+            return
+        self._shutdown_done = True
+        self._closed = True
+        self._dispatch_q.put(None)
+        self._to_worker_q.put(None)
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        self.data_server.shutdown()
+        self.data_client.shutdown()
+        self.node.shutdown()
+
+
+def run_node_server(head_host: str, head_port: int, token: bytes,
+                    num_cpus: Optional[float] = None,
+                    num_tpus: Optional[int] = None,
+                    resources: Optional[Dict[str, float]] = None,
+                    advertise_host: str = "127.0.0.1") -> None:
+    server = NodeServer((head_host, head_port), token, num_cpus=num_cpus,
+                        num_tpus=num_tpus, resources=resources,
+                        advertise_host=advertise_host)
+    server.serve_forever()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    p = argparse.ArgumentParser(
+        description="join a ray_tpu cluster as a worker node")
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--token", default=DEFAULT_TOKEN.decode())
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--resources", default=None,
+                   help='JSON dict, e.g. \'{"custom": 2}\'')
+    p.add_argument("--advertise-host",
+                   default=os.environ.get("RAY_TPU_ADVERTISE_HOST",
+                                          "127.0.0.1"),
+                   help="peer-reachable IP of this node's data plane")
+    args = p.parse_args(argv)
+    host, port = args.address.rsplit(":", 1)
+    run_node_server(host, int(port), args.token.encode(),
+                    num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                    resources=json.loads(args.resources)
+                    if args.resources else None,
+                    advertise_host=args.advertise_host)
+    return 0

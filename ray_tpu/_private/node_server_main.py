@@ -1,0 +1,13 @@
+"""Entrypoint: ``python -m ray_tpu._private.node_server_main --address ...``
+
+Separate from cluster.py so its dataclasses always pickle under their real
+module path (running cluster.py itself as __main__ would rebrand them as
+__main__.* and break unpickling on the head).
+"""
+
+import sys
+
+from .cluster import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -186,6 +186,31 @@ class SharedMemoryStore:
                 return None
             return ("shm", _shm_name(object_id), e.nbytes)
 
+    # -- cross-node transfer (raw payload bytes) ----------------------------
+
+    def read_raw_by_key(self, key: bytes) -> Optional[bytes]:
+        """Copy out the serialized payload (for push to another node)."""
+        try:
+            buf, _keep = self.get_buffer(ObjectID(key))
+        except (KeyError, ValueError):
+            return None
+        return bytes(buf)
+
+    def put_raw(self, object_id: ObjectID, payload: bytes) -> Optional[tuple]:
+        """Store a payload pulled from another node; returns the local
+        descriptor (existing one if the object already landed here), or
+        None when the store can't hold it."""
+        try:
+            view = self.create(object_id, len(payload))
+        except ValueError:
+            return self.descriptor(object_id)
+        except ObjectStoreFullError:
+            return None
+        view[:] = payload
+        del view
+        self.seal(object_id)
+        return self.descriptor(object_id)
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {"num_objects": len(self._entries), "used_bytes": self._used,
@@ -352,6 +377,34 @@ class NativeArenaStore:
             return None
         off, nbytes = res
         return serialization.read_payload_from(self._shm.buf[off: off + nbytes])
+
+    # -- cross-node transfer (raw payload bytes) ----------------------------
+
+    def read_raw_by_key(self, key: bytes) -> Optional[bytes]:
+        """Copy out the serialized payload (pin across the copy so a
+        concurrent eviction can't move the offset under us)."""
+        res = self._lookup(key, pin=True)
+        if res is None:
+            return None
+        try:
+            off, nbytes = res
+            return bytes(self._shm.buf[off: off + nbytes])
+        finally:
+            self.unpin_key(key)
+
+    def put_raw(self, object_id: ObjectID, payload: bytes) -> Optional[tuple]:
+        """Store a payload pulled from another node; returns the local
+        descriptor (existing one if the object already landed here), or
+        None when the arena can't hold it."""
+        try:
+            off = self.allocate(object_id, len(payload))
+        except ValueError:
+            return self.descriptor(object_id)
+        except ObjectStoreFullError:
+            return None
+        self._shm.buf[off: off + len(payload)] = payload
+        self.seal(object_id)
+        return self.descriptor(object_id)
 
     def get(self, object_id: ObjectID) -> Any:
         value = self.read_by_key(object_id.binary(), pin=False)

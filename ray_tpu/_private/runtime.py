@@ -92,6 +92,11 @@ class ObjectState:
         cb()
 
 
+def _has_remote_desc(args, kwargs) -> bool:
+    return any(isinstance(d, tuple) and d and d[0] == "at"
+               for d in list(args) + list(kwargs.values()))
+
+
 @dataclass
 class _RunningTask:
     spec: TaskSpec
@@ -116,7 +121,10 @@ class Runtime:
     def __init__(self, num_cpus: Optional[float] = None,
                  num_tpus: Optional[int] = None,
                  resources: Optional[Dict[str, float]] = None,
-                 namespace: str = "default"):
+                 namespace: str = "default",
+                 head_port: Optional[int] = None,
+                 cluster_token: Optional[bytes] = None,
+                 advertise_host: Optional[str] = None):
         Config.initialize()
         self.job_id = JobID.next()
         self.namespace = namespace
@@ -173,6 +181,35 @@ class Runtime:
         # process (see ray_tpu.util.metrics).
         self.metrics_snapshots: Dict[str, list] = {}
 
+        # -- multi-node cluster plane (reference: gcs_node_manager.h node
+        # registration + object_manager pull/push; see cluster.py) -------- #
+        self.head_server = None
+        self.data_server = None
+        self._data_client = None
+        self._puller = None
+        self._xfer_q = None
+        if head_port is not None:
+            import queue as _queue
+
+            from .cluster import (DEFAULT_TOKEN, DataClient, DataServer,
+                                  HeadServer, ObjectPuller)
+            token = cluster_token or DEFAULT_TOKEN
+            advertise = advertise_host or os.environ.get(
+                "RAY_TPU_ADVERTISE_HOST", "127.0.0.1")
+            self.data_server = DataServer(self.node.store, token,
+                                          advertise_host=advertise)
+            self._data_client = DataClient(token)
+            self.head_server = HeadServer(self, port=head_port, token=token,
+                                          advertise_host=advertise)
+            self._puller = ObjectPuller(
+                self.node.store, self._data_client, self.node_id.binary(),
+                self.head_server.node_data_address)
+            # Cross-node pulls block; never run them on the scheduler loop
+            # or a node reader thread (see _offload).
+            self._xfer_q = _queue.Queue()
+            threading.Thread(target=self._xfer_loop, name="head-xfer",
+                             daemon=True).start()
+
     # ------------------------------------------------------------------ #
     # object directory
     # ------------------------------------------------------------------ #
@@ -195,6 +232,14 @@ class Runtime:
         self.scheduler.notify_object_ready(object_id)
 
     def _materialize(self, object_id: ObjectID, desc) -> Any:
+        if desc[0] == "at":
+            # Remote-node object: pull it into the head's local store first
+            # (owner lookup + transfer, reference: pull_manager.h:50).
+            if self._puller is None:
+                raise ObjectLostError(
+                    f"object {object_id} lives on a remote node but this "
+                    "runtime has no cluster data plane")
+            desc = self._puller.localize(desc)
         kind = desc[0]
         if kind == "inline":
             return serialization.unpack_payload(desc[1])
@@ -348,10 +393,59 @@ class Runtime:
                 kwargs[k] = ("inline", payload)
         return args, kwargs
 
+    def _xfer_loop(self) -> None:
+        while True:
+            fn = self._xfer_q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _offload(self, fn) -> None:
+        """Run `fn` on the transfer thread in cluster mode (it may block on
+        cross-node object pulls), inline otherwise."""
+        if self._xfer_q is not None:
+            self._xfer_q.put(fn)
+        else:
+            fn()
+
+    def _requeue_or_fail(self, spec: TaskSpec, reason: str) -> None:
+        if spec.actor_id is None and spec.create_actor_id is None and \
+                spec.retry_count < spec.max_retries:
+            spec.retry_count += 1
+            self.submit_spec(spec)
+        elif spec.create_actor_id is not None:
+            # Creation never completed; re-place it (no restart consumed).
+            self._submit_actor_creation(spec)
+        elif spec.actor_id is not None:
+            self._fail_task(spec, ActorError(spec.actor_id, reason))
+        else:
+            self._fail_task(spec, WorkerCrashedError(reason))
+
     def _dispatch_normal(self, spec: TaskSpec, node_id: NodeID) -> None:
         args, kwargs = self._resolve(spec)
+        node = self.nodes.get(node_id)
+        if node is None:
+            # Node died between placement and dispatch.
+            self._requeue_or_fail(spec, f"node {node_id} died before "
+                                  f"dispatch of {spec.name}")
+            return
+        if not getattr(node, "is_remote", False) and self._puller is not None \
+                and _has_remote_desc(args, kwargs):
+            # Local dispatch with remote args: pull them home on the
+            # transfer thread — pulls must not block the scheduler loop.
+            self._track(spec, node_id)
+
+            def run():
+                a, k = self._puller.localize_all(args, kwargs)
+                node.dispatch_task(spec, a, k)
+            self._offload(run)
+            return
         self._track(spec, node_id)
-        self.nodes[node_id].dispatch_task(spec, args, kwargs)
+        node.dispatch_task(spec, args, kwargs)
 
     # -- actors ---------------------------------------------------------- #
 
@@ -426,9 +520,24 @@ class Runtime:
                 ast.pending_bind.append((spec, args, kwargs))
                 return
             node_id, worker_id = ast.node_id, ast.worker_id
+        node = self.nodes.get(node_id)
+        if node is None:
+            self._fail_task(spec, ActorError(
+                spec.actor_id, "actor's node left the cluster"))
+            return
+        if not getattr(node, "is_remote", False) and self._xfer_q is not None:
+            # All local actor dispatches ride the transfer queue in cluster
+            # mode: localization may block, and a faster no-pull task must
+            # not overtake an earlier pulling one (per-actor ordering).
+            self._track(spec, node_id)
+
+            def run():
+                a, k = self._puller.localize_all(args, kwargs)
+                node.dispatch_task(spec, a, k, target_worker=worker_id)
+            self._offload(run)
+            return
         self._track(spec, node_id)
-        self.nodes[node_id].dispatch_task(spec, args, kwargs,
-                                          target_worker=worker_id)
+        node.dispatch_task(spec, args, kwargs, target_worker=worker_id)
 
     def bind_actor_worker(self, actor_id: ActorID, node_id: NodeID,
                           worker_id: WorkerID) -> None:
@@ -572,6 +681,48 @@ class Runtime:
             for spec, _a, _k in pending:
                 self._fail_task(spec, ActorError(actor_id, "actor died"))
 
+    def on_node_died(self, node_id: NodeID) -> None:
+        """A joined node's control connection dropped: fail/retry its tasks,
+        restart its actors elsewhere, re-plan its PG bundles (reference:
+        gcs_node_manager.cc node death fan-out + gcs_actor_manager restart;
+        gcs_placement_group_manager bundle rescheduling)."""
+        if self._shutdown:
+            return
+        self.nodes.pop(node_id, None)
+        self.controller.mark_node_dead(node_id, "connection lost")
+        self.scheduler.remove_node(node_id)
+
+        specs: List[TaskSpec] = []
+        with self._running_lock:
+            for tid, rt in list(self._running.items()):
+                if rt.node_id == node_id:
+                    self._running.pop(tid, None)
+                    specs.append(rt.spec)
+        for spec in specs:
+            # Creation tasks are re-placed (the actor never came up, so no
+            # restart is consumed); retryable tasks resubmit; others fail.
+            self._requeue_or_fail(
+                spec, f"node {node_id} died while running {spec.name}")
+
+        # Actors that lived there: restart elsewhere via the FSM.
+        with self._actors_lock:
+            lost = [aid for aid, ast in self._actors.items()
+                    if ast.node_id == node_id]
+        for aid in lost:
+            self._on_actor_worker_death(aid, node_id)
+
+        # PG bundles committed to the dead node: re-plan just those bundles
+        # on the surviving nodes.
+        for pg in list(self.controller.placement_groups.values()):
+            if any(b.node_id == node_id for b in pg.bundles):
+                self.scheduler.reschedule_lost_bundles(pg, node_id)
+
+    def ctl_node_data_address(self, node_id_bytes: bytes):
+        """Data-plane address lookup for peer pulls (the location oracle)."""
+        if self.head_server is None:
+            return None
+        return self.head_server.node_data_address(node_id_bytes)
+
     def on_actor_state(self, msg: ActorStateMsg, node_id: NodeID,
                        worker_id: WorkerID) -> None:
         if msg.state == "alive":
@@ -600,17 +751,29 @@ class Runtime:
 
     # -- worker-initiated requests -------------------------------------- #
 
-    def on_get_request(self, node: NodeManager, msg: GetRequest) -> None:
+    def on_get_request(self, node, msg: GetRequest) -> None:
         states = [self._state(o) for o in msg.object_ids]
         remaining = {"n": len(states)}
         lock = threading.Lock()
         replied = {"done": False}
+        is_remote = getattr(node, "is_remote", False)
 
         def finish(timed_out: bool):
             with lock:
                 if replied["done"]:
                     return
                 replied["done"] = True
+            if not is_remote and any(
+                    isinstance(st.desc, tuple) and st.desc
+                    and st.desc[0] == "at" for st in states
+                    if st.event.is_set()):
+                # Local reader needs remote objects: the pull blocks, so
+                # run the reply construction on the transfer thread.
+                self._offload(lambda: _build_reply(timed_out))
+            else:
+                _build_reply(timed_out)
+
+        def _build_reply(timed_out: bool):
             values = []
             pinned_keys = []
             for st in states:
@@ -618,6 +781,22 @@ class Runtime:
                     values.append(("err", b""))
                     continue
                 d = st.desc
+                if is_remote:
+                    # Consumer is on another node: it pulls payloads over
+                    # the data plane by key, so ship location-tagged
+                    # descriptors instead of pinning here (the fetch pins
+                    # on the owner for the duration of the copy).
+                    if isinstance(d, tuple) and d and d[0] in ("shm", "shma"):
+                        from .cluster import tag_desc
+                        d = tag_desc(d, self.node_id.binary())
+                    values.append(d)
+                    continue
+                if isinstance(d, tuple) and d and d[0] == "at":
+                    # Remote object requested by a head-local worker: pull
+                    # it into the head store, then hand out a local pin.
+                    d = self._puller.localize(d) if self._puller else (
+                        "err", serialization.pack_payload(ObjectLostError(
+                            "remote object without a cluster data plane")))
                 if isinstance(d, tuple) and d and d[0] == "shma":
                     # Refresh + pin so the offset stays valid until the
                     # worker's ReadDone (plasma client-pin semantics).
@@ -819,6 +998,14 @@ class Runtime:
     def shutdown(self) -> None:
         self._shutdown = True
         self.scheduler.stop()
+        if self._xfer_q is not None:
+            self._xfer_q.put(None)
+        if self.head_server is not None:
+            self.head_server.shutdown()
+        if self.data_server is not None:
+            self.data_server.shutdown()
+        if self._data_client is not None:
+            self._data_client.shutdown()
         self.node.shutdown()
         for shm in self._mapped_segments.values():
             try:

@@ -295,11 +295,18 @@ class ClusterScheduler:
             return False
 
     def _try_commit_pg(self, pg: PlacementGroupInfo) -> bool:
+        """Commit every still-unplaced bundle (all of them on first create;
+        just the lost ones after a node death re-plan)."""
+        pending = [b for b in pg.bundles if b.node_id is None]
+        if not pending:
+            self._controller.set_pg_state(pg.pg_id, PG_CREATED)
+            return True
         snapshot = {nid: ns.available.copy() for nid, ns in self._nodes.items()}
-        assignment = self._plan_bundles(pg, snapshot)
+        used = {b.node_id for b in pg.bundles if b.node_id is not None}
+        assignment = self._plan_bundles(pg, snapshot, pending, used)
         if assignment is None:
             return False
-        for bundle, node_id in zip(pg.bundles, assignment):
+        for bundle, node_id in zip(pending, assignment):
             ns = self._nodes[node_id]
             ns.available = ns.available - bundle.resources
             ns.bundle_available[(pg.pg_id, bundle.index)] = bundle.resources.copy()
@@ -307,6 +314,25 @@ class ClusterScheduler:
         self._controller.set_pg_state(pg.pg_id, PG_CREATED)
         self._wake.notify_all()
         return True
+
+    def reschedule_lost_bundles(self, pg: PlacementGroupInfo,
+                                dead_node: NodeID) -> None:
+        """Re-plan the bundles a dead node took with it; live bundles keep
+        their placement (reference: GcsPlacementGroupManager rescheduling on
+        node death)."""
+        with self._wake:
+            if pg.state == PG_REMOVED:
+                return
+            lost = False
+            for b in pg.bundles:
+                if b.node_id == dead_node:
+                    b.node_id = None
+                    lost = True
+            if not lost:
+                return
+            self._controller.set_pg_state(pg.pg_id, PG_PENDING)
+            if not self._try_commit_pg(pg) and pg not in self._pending_pgs:
+                self._pending_pgs.append(pg)
 
     def _retry_pending_pgs_locked(self) -> None:
         if not self._pending_pgs:
@@ -320,27 +346,37 @@ class ClusterScheduler:
         self._pending_pgs = still_pending
 
     def _plan_bundles(self, pg: PlacementGroupInfo,
-                      snapshot: Dict[NodeID, ResourceSet]) -> Optional[List[NodeID]]:
+                      snapshot: Dict[NodeID, ResourceSet],
+                      bundles=None,
+                      used_nodes: Optional[Set[NodeID]] = None
+                      ) -> Optional[List[NodeID]]:
+        bundles = pg.bundles if bundles is None else bundles
         node_ids = list(snapshot.keys())
         if not node_ids:
             return None
         assignment: List[NodeID] = []
         if pg.strategy == STRICT_PACK:
-            for nid in node_ids:
+            # All bundles (incl. survivors) must share one node; a partial
+            # re-plan must land on the surviving bundles' node if any.
+            anchor = {b.node_id for b in pg.bundles if b.node_id is not None}
+            cands = list(anchor) if anchor else node_ids
+            for nid in cands:
+                if nid not in snapshot:
+                    continue
                 avail = snapshot[nid].copy()
                 ok = True
-                for b in pg.bundles:
+                for b in bundles:
                     if not b.resources.fits(avail):
                         ok = False
                         break
                     avail = avail - b.resources
                 if ok:
-                    return [nid] * len(pg.bundles)
+                    return [nid] * len(bundles)
             return None
-        used_nodes: Set[NodeID] = set()
+        used_nodes = set(used_nodes or ())
         order = node_ids if pg.strategy != SPREAD else random.sample(
             node_ids, len(node_ids))
-        for b in pg.bundles:
+        for b in bundles:
             placed = None
             if pg.strategy == STRICT_SPREAD:
                 cands = [n for n in order if n not in used_nodes

@@ -48,19 +48,48 @@ def cli():
 
 @cli.command()
 @click.option("--head", is_flag=True, help="Start a head node.")
+@click.option("--address", default=None,
+              help="Join an existing cluster: head host:port "
+                   "(from `ray-tpu start --head` output).")
 @click.option("--port", type=int, default=8265, show_default=True)
+@click.option("--node-port", type=int, default=6380, show_default=True,
+              help="TCP port for cluster node joins (head only).")
+@click.option("--token", default=None, help="Cluster auth token.")
 @click.option("--num-cpus", type=float, default=None)
 @click.option("--num-tpus", type=int, default=None)
 @click.option("--address-file", default=DEFAULT_ADDRESS_FILE)
 @click.option("--block", is_flag=True, help="Run in the foreground.")
-def start(head, port, num_cpus, num_tpus, address_file, block):
-    """Start the head process (runtime + job/REST server)."""
-    if not head:
-        raise click.ClickException(
-            "only --head is supported; worker nodes join via the runtime's "
-            "node API")
+def start(head, address, port, node_port, token, num_cpus, num_tpus,
+          address_file, block):
+    """Start a head node, or join a cluster with --address=<host:port>
+    (reference: ray start / ray start --address)."""
+    if not head and not address:
+        raise click.ClickException("pass --head or --address=<host:port>")
+    if address:
+        # Worker-node join path: runs the NodeServer in the foreground
+        # (or detached without --block).
+        cmd = [sys.executable, "-m", "ray_tpu._private.node_server_main",
+               "--address", address]
+        if token:
+            cmd += ["--token", token]
+        if num_cpus is not None:
+            cmd += ["--num-cpus", str(num_cpus)]
+        if num_tpus is not None:
+            cmd += ["--num-tpus", str(num_tpus)]
+        if block:
+            raise SystemExit(subprocess.call(cmd))
+        log_f = open(os.path.join("/tmp", "ray_tpu_node.log"), "ab")
+        proc = subprocess.Popen(cmd, start_new_session=True,
+                                stdin=subprocess.DEVNULL, stdout=log_f,
+                                stderr=subprocess.STDOUT)
+        log_f.close()
+        click.echo(f"node joining {address} (pid {proc.pid})")
+        return
     cmd = [sys.executable, "-m", "ray_tpu.scripts.head",
-           "--port", str(port), "--address-file", address_file]
+           "--port", str(port), "--node-port", str(node_port),
+           "--address-file", address_file]
+    if token:
+        cmd += ["--token", token]
     if num_cpus is not None:
         cmd += ["--num-cpus", str(num_cpus)]
     if num_tpus is not None:

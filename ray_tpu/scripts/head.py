@@ -18,6 +18,9 @@ import time
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=8265)
+    p.add_argument("--node-port", type=int, default=6380,
+                   help="TCP join port for cluster nodes (0 = ephemeral)")
+    p.add_argument("--token", default=None)
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=int, default=None)
     p.add_argument("--address-file", default="/tmp/ray_tpu/head_address")
@@ -27,13 +30,18 @@ def main(argv=None) -> int:
     from ray_tpu.job_submission import JobManager
     from ray_tpu.job_submission.server import JobServer
 
-    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                      head_port=args.node_port,
+                      cluster_token=args.token.encode()
+                      if args.token else None)
     manager = JobManager()
     server = JobServer(manager, port=args.port)
 
+    node_addr = "%s:%d" % rt.head_server.address
     os.makedirs(os.path.dirname(args.address_file), exist_ok=True)
     with open(args.address_file, "w") as f:
-        json.dump({"address": server.address, "pid": os.getpid()}, f)
+        json.dump({"address": server.address, "pid": os.getpid(),
+                   "node_address": node_addr}, f)
 
     stop = {"flag": False}
 

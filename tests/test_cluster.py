@@ -1,0 +1,207 @@
+"""Multi-node cluster tests: join, remote dispatch, object transfer,
+failover (reference test analog: python/ray/tests/test_multi_node*.py over
+cluster_utils.Cluster, python/ray/cluster_utils.py:137)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_num_cpus=0)
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    yield c
+    c.shutdown()
+
+
+def _pg_info(cluster, pg):
+    return cluster.runtime.controller.get_placement_group(
+        PlacementGroupID(pg.id.binary()))
+
+
+class TestClusterBasics:
+    def test_join_and_resources(self, cluster):
+        assert cluster.alive_node_count() == 4  # head + 3
+        total = ray_tpu.cluster_resources()
+        assert total.get("CPU", 0) == 6.0
+
+    def test_remote_dispatch_and_spread(self, cluster):
+        @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+        def who():
+            time.sleep(0.2)
+            return os.getpid()
+
+        pids = set(ray_tpu.get([who.remote() for _ in range(6)]))
+        # 6 concurrent 1-CPU tasks cannot fit on one 2-CPU node.
+        assert len(pids) >= 3
+
+    def test_cross_node_object_transfer(self, cluster):
+        @ray_tpu.remote(num_cpus=1)
+        def make(n):
+            return np.arange(n, dtype=np.float64)
+
+        @ray_tpu.remote(num_cpus=1)
+        def consume(a):
+            return float(a.sum())
+
+        ref = make.remote(200_000)  # >100KiB -> shm on the producing node
+        # Driver pull:
+        arr = ray_tpu.get(ref)
+        assert arr[-1] == 199_999
+        # Cross-node arg (dispatch-side localization):
+        assert ray_tpu.get(consume.remote(ref)) == float(arr.sum())
+
+    def test_worker_nested_get_of_remote_object(self, cluster):
+        @ray_tpu.remote(num_cpus=1)
+        def make():
+            return np.ones(150_000)
+
+        @ray_tpu.remote(num_cpus=1)
+        def fetch(refs):
+            # Nested get inside a worker: GetRequest -> head -> GetReply
+            # localized by the consuming node server.  (Wrapping the ref in
+            # a list keeps it from being resolved as a task dependency.)
+            return float(ray_tpu.get(refs[0]).sum())
+
+        ref = make.remote()
+        assert ray_tpu.get(fetch.remote([ref])) == 150_000.0
+
+    def test_worker_nested_submit(self, cluster):
+        @ray_tpu.remote(num_cpus=1)
+        def inner(x):
+            return x + 1
+
+        @ray_tpu.remote(num_cpus=1)
+        def outer(x):
+            return ray_tpu.get(inner.remote(x)) * 10
+
+        assert ray_tpu.get(outer.remote(4)) == 50
+
+    def test_actor_on_remote_node_ordering(self, cluster):
+        @ray_tpu.remote(num_cpus=1)
+        class Counter:
+            def __init__(self):
+                self.log = []
+
+            def add(self, x):
+                self.log.append(x)
+                return list(self.log)
+
+        a = Counter.remote()
+        out = ray_tpu.get([a.add.remote(i) for i in range(20)])
+        assert out[-1] == list(range(20))
+        ray_tpu.kill(a)
+
+    def test_strict_spread_pg_lands_on_distinct_nodes(self, cluster):
+        pg = ray_tpu.placement_group(
+            [{"CPU": 1}, {"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+        assert pg.ready(timeout=20)
+        info = _pg_info(cluster, pg)
+        nids = [b.node_id for b in info.bundles]
+        assert len(set(nids)) == 3
+        assert all(n is not None for n in nids)
+
+        @ray_tpu.remote(num_cpus=1)
+        def run():
+            return os.getpid()
+
+        pids = ray_tpu.get([
+            run.options(placement_group=pg,
+                        placement_group_bundle_index=i).remote()
+            for i in range(3)])
+        assert len(set(pids)) == 3
+        ray_tpu.remove_placement_group(pg)
+
+    def test_strict_pack_pg_single_node(self, cluster):
+        pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}],
+                                     strategy="STRICT_PACK")
+        assert pg.ready(timeout=20)
+        info = _pg_info(cluster, pg)
+        nids = {b.node_id for b in info.bundles}
+        assert len(nids) == 1
+        ray_tpu.remove_placement_group(pg)
+
+
+class TestClusterFailover:
+    def test_task_infeasible_until_node_joins(self, cluster):
+        @ray_tpu.remote(resources={"gadget": 1})
+        def use_gadget():
+            return "ok"
+
+        ref = use_gadget.remote()
+        ready, pending = ray_tpu.wait([ref], timeout=1.0)
+        assert pending  # infeasible: no gadget node yet
+        handle = cluster.add_node(num_cpus=1, resources={"gadget": 1})
+        assert ray_tpu.get(ref, timeout=30) == "ok"
+        cluster.remove_node(handle)
+
+    def test_actor_restarts_on_surviving_node(self, cluster):
+        handle = cluster.add_node(num_cpus=1, resources={"doom": 1})
+
+        @ray_tpu.remote(num_cpus=1, resources={"doom": 0.001},
+                        max_restarts=1)
+        class A:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        a = A.remote()
+        assert ray_tpu.get(a.bump.remote()) == 1
+        rt = cluster.runtime
+        victim_nid = rt._actors[a._actor_id].node_id
+        # The doom resource pinned the actor onto the doomed node.
+        cluster.remove_node(handle)
+        # Restart requires a doom-resource node again:
+        handle2 = cluster.add_node(num_cpus=1, resources={"doom": 1})
+        deadline = time.monotonic() + 30
+        val = None
+        while time.monotonic() < deadline:
+            try:
+                val = ray_tpu.get(a.bump.remote(), timeout=10)
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert val == 1  # fresh state after restart
+        new_nid = rt._actors[a._actor_id].node_id
+        assert new_nid != victim_nid
+        ray_tpu.kill(a)
+        cluster.remove_node(handle2)
+
+    def test_pg_bundle_rescheduled_after_node_death(self, cluster):
+        handle = cluster.add_node(num_cpus=2, resources={"mark": 1})
+        pg = ray_tpu.placement_group(
+            [{"CPU": 1, "mark": 0.001}, {"CPU": 1}], strategy="SPREAD")
+        assert pg.ready(timeout=20)
+        info = _pg_info(cluster, pg)
+        marked = [b for b in info.bundles if "mark" in b.resources.to_dict()]
+        assert marked and marked[0].node_id is not None
+        dead_nid = marked[0].node_id
+        cluster.remove_node(handle)
+        # Re-plan needs a new mark-capable node:
+        handle2 = cluster.add_node(num_cpus=2, resources={"mark": 1})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            info = _pg_info(cluster, pg)
+            b = info.bundles[marked[0].index]
+            if info.state == "CREATED" and b.node_id is not None \
+                    and b.node_id != dead_nid:
+                break
+            time.sleep(0.1)
+        assert info.state == "CREATED"
+        assert info.bundles[marked[0].index].node_id != dead_nid
+        ray_tpu.remove_placement_group(pg)
+        cluster.remove_node(handle2)
