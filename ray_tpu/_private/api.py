@@ -21,6 +21,7 @@ from .exceptions import RayTpuError
 from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
 from .protocol import TaskSpec
 from .resources import ResourceSet, task_resources
+from . import runtime as _rtmod
 from .runtime import current_runtime, driver_runtime
 from .scheduler import (NodeAffinitySchedulingStrategy,
                         PlacementGroupSchedulingStrategy)
@@ -42,12 +43,31 @@ def _control(method: str, *args, **kwargs):
 
 class ObjectRef:
     """Handle to a (possibly pending) immutable object
-    (reference: python/ray/includes/object_ref.pxi:50)."""
+    (reference: python/ray/includes/object_ref.pxi:50).
 
-    __slots__ = ("_id",)
+    Driver-process refs are counted by the runtime's reference counter
+    (reference: reference_counter.h:44 local refs): the last ref dropping
+    frees the object.  Pickling a ref into user data marks the object
+    escaped (a borrow the driver can't track), disabling auto-collection.
+    """
+
+    __slots__ = ("_id", "_owned", "__weakref__")
 
     def __init__(self, object_id: ObjectID):
         self._id = object_id
+        rt = _rtmod._global_runtime
+        self._owned = rt is not None and _rtmod._worker_runtime is None
+        if self._owned:
+            rt.add_local_ref(object_id)
+
+    def __del__(self):
+        if getattr(self, "_owned", False):
+            rt = _rtmod._global_runtime
+            if rt is not None:
+                try:
+                    rt.remove_local_ref(self._id)
+                except Exception:
+                    pass
 
     def id(self) -> ObjectID:
         return self._id
@@ -59,6 +79,10 @@ class ObjectRef:
         return self._id.binary()
 
     def __reduce__(self):
+        if getattr(self, "_owned", False):
+            rt = _rtmod._global_runtime
+            if rt is not None:
+                rt.mark_escaped(self._id)
         return (ObjectRef, (self._id,))
 
     def __eq__(self, other):

@@ -41,7 +41,11 @@ from .protocol import (ActorStateMsg, GetReply, GetRequest, PutFromWorker,
                        RpcCall, RpcReply, TaskDone, TaskSpec, WaitRequest)
 from .resources import ResourceSet
 
-DEFAULT_TOKEN = b"ray-tpu-cluster"
+# NOTE: the control/data listeners authenticate with an HMAC token and then
+# unpickle peer messages — treat the token as a secret.  There is no silent
+# well-known default: the head generates a random token when none is given
+# (see Runtime.__init__) and joiners must present it.
+DEFAULT_TOKEN = b"ray-tpu-cluster"  # explicit opt-in only (tests/demos)
 
 
 # --------------------------------------------------------------------------
@@ -88,6 +92,12 @@ class KillActorWorker:
 @dataclass
 class NodeShutdown:
     pass
+
+
+@dataclass
+class FreeObject:
+    """Head -> owner node: delete a GC'd object from the local store."""
+    desc: tuple
 
 
 @dataclass
@@ -140,6 +150,7 @@ class UpWorkerDied:
 class UpDispatchFailed:
     spec: TaskSpec
     reason: str
+    lost_object_bytes: Optional[bytes] = None
 
 
 @dataclass
@@ -192,6 +203,19 @@ def desc_key(desc) -> Optional[bytes]:
         return desc[4]
     if desc[0] == "shm":
         return desc[1].encode()
+    return None
+
+
+def desc_object_id(desc) -> Optional[ObjectID]:
+    """Recover the ObjectID a store descriptor names (shma embeds the id;
+    shm segment names are rt_<hex>)."""
+    try:
+        if desc[0] == "shma":
+            return ObjectID(desc[4])
+        if desc[0] == "shm":
+            return ObjectID(bytes.fromhex(desc[1].split("_", 1)[1]))
+    except (ValueError, IndexError):
+        return None
     return None
 
 
@@ -340,16 +364,9 @@ class ObjectPuller:
         if desc[1] == self._local:
             return desc[2]
         inner = desc[2]
-        key = desc_key(inner)
-        oid = ObjectID(inner[4]) if inner[0] == "shma" else None
-        if oid is None and inner[0] == "shm":
-            # Python-store descriptors embed the object id in the shm name
-            # (rt_<hex>); recover it for the local cache key.
-            name = inner[1]
-            try:
-                oid = ObjectID(bytes.fromhex(name.split("_", 1)[1]))
-            except Exception:
-                oid = ObjectID.from_random()  # unparseable: one-off cache key
+        oid = desc_object_id(inner)
+        if oid is None:
+            oid = ObjectID.from_random()  # unparseable: one-off cache key
         # Cache hit?
         local = self._store.descriptor(oid)
         if local is not None:
@@ -360,11 +377,13 @@ class ObjectPuller:
             payload = self._client.fetch(addr, inner)
         if payload is None:
             return ("err", serialization.pack_payload(ObjectLostError(
-                f"object {oid} unreachable (owner node gone?)")))
+                f"object {oid} unreachable (owner node gone?)",
+                object_id_bytes=oid.binary())))
         local = self._store.put_raw(oid, payload)
         if local is None:
             return ("err", serialization.pack_payload(ObjectLostError(
-                f"object {oid} could not be cached locally")))
+                f"object {oid} could not be cached locally",
+                object_id_bytes=oid.binary())))
         return local
 
     def localize_all(self, args: list, kwargs: dict):
@@ -546,7 +565,8 @@ class HeadServer:
         elif isinstance(msg, UpWorkerDied):
             rt.on_worker_died(msg.worker_id, nid, msg.running, msg.actor_id)
         elif isinstance(msg, UpDispatchFailed):
-            rt.on_dispatch_failed(msg.spec, msg.reason)
+            rt.on_dispatch_failed(msg.spec, msg.reason,
+                                  lost_object_bytes=msg.lost_object_bytes)
         elif isinstance(msg, UpReleaseResources):
             from .ids import PlacementGroupID
             pg = PlacementGroupID(msg.pg_bytes) if msg.pg_bytes else None
@@ -637,8 +657,10 @@ class _NodeServerRuntime:
         msg.results = [(oid, tag_desc(d, nid)) for oid, d in msg.results]
         self._server.send_up(UpTaskDone(msg))
 
-    def on_dispatch_failed(self, spec, reason: str) -> None:
-        self._server.send_up(UpDispatchFailed(spec, reason))
+    def on_dispatch_failed(self, spec, reason: str,
+                           lost_object_bytes=None) -> None:
+        self._server.send_up(UpDispatchFailed(spec, reason,
+                                              lost_object_bytes))
 
     def on_worker_died(self, worker_id, node_id, running, actor_id) -> None:
         self._server.send_up(UpWorkerDied(worker_id, running, actor_id))
@@ -836,6 +858,13 @@ class NodeServer:
                 q = self._rpc_waiters.get(msg.request_id)
             if q is not None:
                 q.put((msg.value, msg.error))
+        elif isinstance(msg, FreeObject):
+            oid = desc_object_id(msg.desc)
+            if oid is not None:
+                try:
+                    self.node.store.delete(oid)
+                except Exception:
+                    pass
         elif isinstance(msg, NodeShutdown):
             self._closed = True
 

@@ -155,6 +155,15 @@ D("health_check_failure_threshold", int, 5,
   "Consecutive missed probes before a node is declared dead.")
 D("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")
 D("actor_max_restarts_default", int, 0, "Default actor restarts.")
+D("enable_object_gc", bool, True,
+  "Reference-count driver ObjectRefs and free unreachable objects "
+  "(reference: reference_counter.h:44 local-ref tracking).")
+D("lineage_max_entries", int, 50000,
+  "Bounded lineage table: task specs kept for object reconstruction, "
+  "LRU-evicted (reference: ray_config_def.h max_lineage_bytes analog).")
+D("object_reconstruction_max_attempts", int, 3,
+  "How many times a lost object may be reconstructed by re-executing its "
+  "producing task (reference: task_manager.h ResubmitTask retry caps).")
 
 # --- Chaos / testing (reference: src/ray/rpc/rpc_chaos.cc:33,
 # RAY_testing_rpc_failure) --------------------------------------------------

@@ -49,7 +49,20 @@ class WorkerCrashedError(RayTpuError):
 
 
 class ObjectLostError(RayTpuError):
-    """Object value is unrecoverable (reference: ObjectLostError)."""
+    """Object value was lost from the cluster (reference: ObjectLostError).
+
+    ``object_id_bytes`` (when known) lets the owner attempt lineage
+    reconstruction before surfacing the error to the user (reference:
+    object_recovery_manager.h:41)."""
+
+    def __init__(self, message: str = "",
+                 object_id_bytes: Optional[bytes] = None):
+        self.object_id_bytes = object_id_bytes
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (ObjectLostError, (self.args[0] if self.args else "",
+                                  self.object_id_bytes))
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):

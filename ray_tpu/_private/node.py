@@ -313,12 +313,15 @@ class NodeManager:
         death for actor workers, which may retain zero-copy views in state).
         """
         pinned: List[bytes] = []
+        lost_key = [None]
 
         def refresh(d):
             if isinstance(d, tuple) and d and d[0] == "shma":
                 nd = self.store.pin_desc_by_key(d[4])
                 if nd is not None:
                     pinned.append(nd[4])
+                elif lost_key[0] is None:
+                    lost_key[0] = d[4]
                 return nd
             return d
 
@@ -352,7 +355,8 @@ class NodeManager:
                     self.info.node_id, spec.resources,
                     spec.placement_group, spec.bundle_index)
             self.runtime.on_dispatch_failed(
-                spec, "arena object freed while dispatching")
+                spec, "arena object freed while dispatching",
+                lost_object_bytes=lost_key[0])
             return False, resolved_args, resolved_kwargs
         if pinned:
             handle.arg_pins[spec.task_id] = pinned

@@ -523,8 +523,18 @@ class RemoteObjectReader:
     def write_payload(object_id: ObjectID, meta: bytes,
                       buffers) -> Tuple[str, int]:
         nbytes = serialization.payload_nbytes(meta, buffers)
-        shm = _open_untracked(_shm_name(object_id), create=True,
-                              size=max(nbytes, 1))
+        try:
+            shm = _open_untracked(_shm_name(object_id), create=True,
+                                  size=max(nbytes, 1))
+        except FileExistsError:
+            # Stale segment from a lost producer (killed node/worker whose
+            # cleanup never ran) — lineage re-execution must be able to
+            # replace it.
+            stale = _open_untracked(_shm_name(object_id), create=False)
+            stale.close()
+            stale.unlink()
+            shm = _open_untracked(_shm_name(object_id), create=True,
+                                  size=max(nbytes, 1))
         serialization.write_payload_into(shm.buf[:nbytes], meta, buffers)
         shm.close()
         return _shm_name(object_id), nbytes

@@ -84,6 +84,13 @@ class ObjectState:
         for cb in cbs:
             cb()
 
+    def reset(self) -> None:
+        """Back to pending (object lost; reconstruction in flight) so
+        consumers block on the event until the re-executed task delivers."""
+        with self.lock:
+            self.desc = None
+            self.event.clear()
+
     def add_callback(self, cb: Callable[[], None]) -> None:
         with self.lock:
             if not self.event.is_set():
@@ -95,6 +102,15 @@ class ObjectState:
 def _has_remote_desc(args, kwargs) -> bool:
     return any(isinstance(d, tuple) and d and d[0] == "at"
                for d in list(args) + list(kwargs.values()))
+
+
+class _DepsPending(Exception):
+    """A dependency's descriptor vanished (object lost; reconstruction in
+    flight) between scheduling and dispatch."""
+
+    def __init__(self, oids):
+        self.oids = oids
+        super().__init__(f"{len(oids)} dependencies back to pending")
 
 
 @dataclass
@@ -161,6 +177,27 @@ class Runtime:
         # released at free() (plasma client-pin semantics).
         self._arena_pins: set = set()
 
+        # -- ownership / GC (reference: reference_counter.h:44) ----------- #
+        # Driver-process ObjectRef counts; objects with zero refs, zero
+        # in-flight dependent tasks and no escaped (pickled-away) copies
+        # are freed from the directory + store.
+        self._gc_enabled = bool(Config.get("enable_object_gc"))
+        self._ref_lock = threading.Lock()
+        self._local_refs: Dict[ObjectID, int] = {}
+        self._escaped: set = set()
+        self._dropped: set = set()
+        self._dep_counts: Dict[ObjectID, int] = {}
+        self._deps_retained: Dict[TaskID, List[ObjectID]] = {}
+
+        # -- lineage + reconstruction (reference: task_manager.h:248
+        # ResubmitTask, object_recovery_manager.h:41) ---------------------- #
+        from collections import OrderedDict
+        self._lineage: "OrderedDict[TaskID, TaskSpec]" = OrderedDict()
+        self._lineage_lock = threading.Lock()
+        self._lineage_cap = int(Config.get("lineage_max_entries"))
+        self._recovering: Dict[TaskID, threading.Event] = {}
+        self._recover_attempts: Dict[TaskID, int] = {}
+
         self.scheduler = ClusterScheduler(self.controller, self._object_ready)
         self.scheduler.on_dispatch_error = self._fail_task
         self.node = NodeManager(node_info, self, num_tpu_chips=int(num_tpus or 0))
@@ -191,9 +228,12 @@ class Runtime:
         if head_port is not None:
             import queue as _queue
 
-            from .cluster import (DEFAULT_TOKEN, DataClient, DataServer,
-                                  HeadServer, ObjectPuller)
-            token = cluster_token or DEFAULT_TOKEN
+            from .cluster import (DataClient, DataServer, HeadServer,
+                                  ObjectPuller)
+            # No silent well-known default: the control port unpickles peer
+            # messages, so an unauthenticated join would be code execution.
+            token = cluster_token or os.urandom(16)
+            self.cluster_token = token
             advertise = advertise_host or os.environ.get(
                 "RAY_TPU_ADVERTISE_HOST", "127.0.0.1")
             self.data_server = DataServer(self.node.store, token,
@@ -205,9 +245,14 @@ class Runtime:
                 self.node.store, self._data_client, self.node_id.binary(),
                 self.head_server.node_data_address)
             # Cross-node pulls block; never run them on the scheduler loop
-            # or a node reader thread (see _offload).
+            # or a node reader thread (see _offload).  Ordered work (actor
+            # dispatch) gets its own queue thread; everything else shares a
+            # pool so one stalled peer can't freeze the data plane.
+            from concurrent.futures import ThreadPoolExecutor
             self._xfer_q = _queue.Queue()
-            threading.Thread(target=self._xfer_loop, name="head-xfer",
+            self._xfer_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="head-xfer")
+            threading.Thread(target=self._xfer_loop, name="head-xfer-ordered",
                              daemon=True).start()
 
     # ------------------------------------------------------------------ #
@@ -230,6 +275,16 @@ class Runtime:
     def mark_ready(self, object_id: ObjectID, desc) -> None:
         self._state(object_id).mark_ready(desc)
         self.scheduler.notify_object_ready(object_id)
+        if self._gc_enabled:
+            # The ref was dropped while the producing task was in flight:
+            # collect the result now that it has landed.
+            with self._ref_lock:
+                collect = object_id in self._dropped and \
+                    self._collectable_locked(object_id)
+                if collect:
+                    self._dropped.discard(object_id)
+            if collect:
+                self.free([object_id])
 
     def _materialize(self, object_id: ObjectID, desc) -> Any:
         if desc[0] == "at":
@@ -257,7 +312,8 @@ class Runtime:
             value = self.node.store.read_by_key(desc[4], pin=pin)
             if value is None:
                 raise ObjectLostError(
-                    f"object {object_id} was evicted or freed")
+                    f"object {object_id} was evicted or freed",
+                    object_id_bytes=object_id.binary())
             if pin:
                 self._arena_pins.add(object_id)
             return value
@@ -295,8 +351,29 @@ class Runtime:
                 raise GetTimeoutError("get timed out")
             if not st.event.wait(remaining):
                 raise GetTimeoutError("get timed out")
-        return [self._materialize(o, st.desc)
-                for o, st in zip(object_ids, states)]
+        values = []
+        max_attempts = int(Config.get("object_reconstruction_max_attempts"))
+        for o, st in zip(object_ids, states):
+            last: Optional[BaseException] = None
+            for _attempt in range(max_attempts + 1):
+                try:
+                    values.append(self._materialize(o, st.desc))
+                    last = None
+                    break
+                except ObjectLostError as e:
+                    # Lost from the cluster: try lineage re-execution
+                    # (reference: object_recovery_manager.h:92).
+                    last = e
+                    if self._recover_object(o) is None:
+                        raise
+                    remaining = None if deadline is None else \
+                        deadline - time.monotonic()
+                    if not st.event.wait(remaining):
+                        raise GetTimeoutError(
+                            "get timed out during object reconstruction")
+            if last is not None:
+                raise last
+        return values
 
     def wait(self, object_ids: List[ObjectID], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True):
@@ -326,8 +403,23 @@ class Runtime:
 
     def free(self, object_ids: List[ObjectID]) -> None:
         for oid in object_ids:
+            with self._ref_lock:
+                self._local_refs.pop(oid, None)
+                self._escaped.discard(oid)
+                self._dropped.discard(oid)
             with self._dir_lock:
                 st = self.directory.pop(oid, None)
+            if st is not None and st.desc and st.desc[0] == "at":
+                # Remote-owned object: route the delete to the owner node.
+                proxy = self.nodes.get(NodeID(st.desc[1]))
+                if proxy is not None and getattr(proxy, "is_remote", False):
+                    from .cluster import FreeObject
+                    proxy.send(FreeObject(st.desc[2]))
+                # A pulled copy may be cached in the head store too.
+                try:
+                    self.node.store.delete(oid)
+                except Exception:
+                    pass
             shm = self._mapped_segments.pop(oid, None)
             if shm is not None:
                 try:
@@ -356,12 +448,180 @@ class Runtime:
                         pass
 
     # ------------------------------------------------------------------ #
+    # ownership GC (reference: reference_counter.h local refs + borrows)
+    # ------------------------------------------------------------------ #
+
+    def add_local_ref(self, oid: ObjectID) -> None:
+        if not self._gc_enabled:
+            return
+        with self._ref_lock:
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        if not self._gc_enabled or self._shutdown:
+            return
+        free = False
+        with self._ref_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+            else:
+                self._local_refs.pop(oid, None)
+                if self._collectable_locked(oid):
+                    with self._dir_lock:
+                        st = self.directory.get(oid)
+                    if st is not None and not st.event.is_set():
+                        # Producing task still in flight: collect at
+                        # mark_ready instead.
+                        self._dropped.add(oid)
+                    else:
+                        free = True
+        if free:
+            self.free([oid])
+
+    def mark_escaped(self, oid: ObjectID) -> None:
+        """An ObjectRef was pickled into user data: copies may now live
+        anywhere (borrowed, reference: reference_counter borrows), so the
+        object is never auto-collected."""
+        if self._gc_enabled:
+            with self._ref_lock:
+                self._escaped.add(oid)
+
+    def _collectable_locked(self, oid: ObjectID) -> bool:
+        return (oid not in self._escaped
+                and self._local_refs.get(oid, 0) == 0
+                and self._dep_counts.get(oid, 0) == 0)
+
+    def _retain_deps(self, spec: TaskSpec) -> None:
+        if not self._gc_enabled:
+            return
+        deps = [a[1] for a in spec.arg_descs if a[0] == "ref"]
+        deps += [d[1] for d in spec.kwarg_descs.values() if d[0] == "ref"]
+        if not deps:
+            return
+        with self._ref_lock:
+            if spec.task_id in self._deps_retained:
+                return  # already retained (idempotent across resubmits)
+            self._deps_retained[spec.task_id] = deps
+            for d in deps:
+                self._dep_counts[d] = self._dep_counts.get(d, 0) + 1
+
+    def _release_deps(self, task_id: TaskID) -> None:
+        if not self._gc_enabled:
+            return
+        to_free: List[ObjectID] = []
+        with self._ref_lock:
+            deps = self._deps_retained.pop(task_id, None)
+            for d in deps or ():
+                n = self._dep_counts.get(d, 0) - 1
+                if n > 0:
+                    self._dep_counts[d] = n
+                else:
+                    self._dep_counts.pop(d, None)
+                    if self._collectable_locked(d):
+                        to_free.append(d)
+        if to_free:
+            self.free(to_free)
+
+    # ------------------------------------------------------------------ #
+    # lineage + reconstruction
+    # ------------------------------------------------------------------ #
+
+    def _record_lineage(self, spec: TaskSpec) -> None:
+        # Only stateless task outputs are reconstructable by re-execution
+        # (actor method results depend on actor state; reference semantics).
+        if spec.actor_id is not None or spec.create_actor_id is not None \
+                or not spec.return_ids:
+            return
+        with self._lineage_lock:
+            self._lineage[spec.task_id] = spec
+            self._lineage.move_to_end(spec.task_id)
+            while len(self._lineage) > self._lineage_cap:
+                self._lineage.popitem(last=False)
+
+    def _recover_object(self, oid: ObjectID) -> Optional[threading.Event]:
+        """Kick lineage re-execution of the task that produced ``oid``.
+        Returns an event set when recovery delivers (None if the object is
+        not reconstructable)."""
+        task_id = oid.task_id()
+        with self._lineage_lock:
+            spec = self._lineage.get(task_id)
+            if spec is None:
+                return None
+            attempts = self._recover_attempts.get(task_id, 0)
+            if attempts >= int(Config.get(
+                    "object_reconstruction_max_attempts")):
+                return None
+            inflight = self._recovering.get(task_id)
+            if inflight is not None:
+                return inflight
+            self._recover_attempts[task_id] = attempts + 1
+            done = threading.Event()
+            self._recovering[task_id] = done
+        # Drop stale driver-side state for the lost returns so the
+        # re-produced values land cleanly.  Healthy sibling returns that the
+        # driver still holds zero-copy views into (multi-return tasks) are
+        # left untouched: deleting their arena slot would corrupt live user
+        # arrays, and mark_ready no-ops on their still-set states.
+        for rid in spec.return_ids:
+            if rid != oid and rid in self._arena_pins:
+                continue
+            shm = self._mapped_segments.pop(rid, None)
+            if shm is not None:
+                try:
+                    shm.close()
+                except Exception:
+                    pass
+            if rid in self._arena_pins:
+                self._arena_pins.discard(rid)
+                try:
+                    self.node.store.unpin_key(rid.binary())
+                except Exception:
+                    pass
+            try:
+                self.node.store.delete(rid)
+            except Exception:
+                pass
+            self._state(rid).reset()
+        with self._ref_lock:
+            self._escaped.add(oid)  # recovered objects stay pinned
+        self.events.record(task_id.hex(), PENDING_ARGS, name=spec.name,
+                           error_message="lineage reconstruction")
+        self.submit_spec(spec)
+        return done
+
+    def _finish_recovery(self, task_id: TaskID) -> None:
+        with self._lineage_lock:
+            done = self._recovering.pop(task_id, None)
+        if done is not None:
+            done.set()
+
+    def _lost_object_in_error(self, error_desc) -> Optional[ObjectID]:
+        """If a task failed because an input object was lost, name it."""
+        if not error_desc or error_desc[0] != "err":
+            return None
+        try:
+            exc = serialization.unpack_payload(error_desc[1])
+        except Exception:
+            return None
+        inner = getattr(exc, "cause", exc)
+        oid_bytes = getattr(inner, "object_id_bytes", None)
+        if isinstance(inner, ObjectLostError) and oid_bytes:
+            try:
+                return ObjectID(oid_bytes)
+            except ValueError:
+                return None
+        return None
+
+    # ------------------------------------------------------------------ #
     # task submission
     # ------------------------------------------------------------------ #
 
     def submit_spec(self, spec: TaskSpec) -> None:
         for oid in spec.return_ids:
             self._state(oid)
+        self._retain_deps(spec)
+        self._record_lineage(spec)
         if spec.actor_id is not None:
             self.events.record(
                 spec.task_id.hex(), PENDING_ARGS, name=spec.name,
@@ -379,19 +639,48 @@ class Runtime:
             self.scheduler.submit(spec, self._dispatch_normal)
 
     def _resolve(self, spec: TaskSpec):
+        """Resolve ref args to descriptors; raises _DepsPending if any dep
+        went back to pending (lost + reconstruction in flight) between the
+        scheduler's readiness check and now."""
+        pending: List[ObjectID] = []
+
+        def desc_of(oid):
+            st = self._state(oid)
+            d = st.desc
+            if d is None:
+                pending.append(oid)
+            return d
+
         args = []
         for kind, payload in spec.arg_descs:
             if kind == "ref":
-                args.append(self._state(payload).desc)
+                args.append(desc_of(payload))
             else:
                 args.append(("inline", payload))
         kwargs = {}
         for k, (kind, payload) in spec.kwarg_descs.items():
             if kind == "ref":
-                kwargs[k] = self._state(payload).desc
+                kwargs[k] = desc_of(payload)
             else:
                 kwargs[k] = ("inline", payload)
+        if pending:
+            raise _DepsPending(pending)
         return args, kwargs
+
+    def _after_deps(self, oids: List[ObjectID], fn: Callable[[], None]) -> None:
+        """Run fn once every oid is (re-)ready."""
+        remaining = {"n": len(oids)}
+        lock = threading.Lock()
+
+        def one_ready():
+            with lock:
+                remaining["n"] -= 1
+                done = remaining["n"] == 0
+            if done:
+                fn()
+
+        for oid in oids:
+            self._state(oid).add_callback(one_ready)
 
     def _xfer_loop(self) -> None:
         while True:
@@ -404,13 +693,25 @@ class Runtime:
                 import traceback
                 traceback.print_exc()
 
-    def _offload(self, fn) -> None:
-        """Run `fn` on the transfer thread in cluster mode (it may block on
-        cross-node object pulls), inline otherwise."""
-        if self._xfer_q is not None:
+    def _offload(self, fn, ordered: bool = False) -> None:
+        """Run `fn` off the caller's thread in cluster mode (it may block on
+        cross-node object pulls), inline otherwise.  ``ordered`` work shares
+        one queue thread (per-actor dispatch ordering); the rest runs on a
+        small pool."""
+        if self._xfer_q is None:
+            fn()
+        elif ordered:
             self._xfer_q.put(fn)
         else:
+            self._xfer_pool.submit(self._safely, fn)
+
+    @staticmethod
+    def _safely(fn) -> None:
+        try:
             fn()
+        except Exception:
+            import traceback
+            traceback.print_exc()
 
     def _requeue_or_fail(self, spec: TaskSpec, reason: str) -> None:
         if spec.actor_id is None and spec.create_actor_id is None and \
@@ -426,7 +727,17 @@ class Runtime:
             self._fail_task(spec, WorkerCrashedError(reason))
 
     def _dispatch_normal(self, spec: TaskSpec, node_id: NodeID) -> None:
-        args, kwargs = self._resolve(spec)
+        try:
+            args, kwargs = self._resolve(spec)
+        except _DepsPending:
+            # A dep went back to pending (reconstruction): give back the
+            # booked resources and let the dependency stage re-hold it.
+            if not spec.resources.is_empty() or spec.placement_group is not None:
+                self.scheduler.release(node_id, spec.resources,
+                                       spec.placement_group,
+                                       spec.bundle_index)
+            self.scheduler.submit(spec, self._dispatch_normal)
+            return
         node = self.nodes.get(node_id)
         if node is None:
             # Node died between placement and dispatch.
@@ -480,24 +791,18 @@ class Runtime:
         unresolved = [d for d in deps if not self._object_ready(d)]
 
         def on_deps_ready():
-            args, kwargs = self._resolve(spec)
+            try:
+                args, kwargs = self._resolve(spec)
+            except _DepsPending as dp:
+                # Dep reset under us (lost + reconstructing): wait again.
+                self._after_deps(dp.oids, on_deps_ready)
+                return
             self._enqueue_actor_dispatch(ast, spec, seq, args, kwargs)
 
         if not unresolved:
             on_deps_ready()
         else:
-            remaining = {"n": len(unresolved)}
-            rlock = threading.Lock()
-
-            def one_ready():
-                with rlock:
-                    remaining["n"] -= 1
-                    done = remaining["n"] == 0
-                if done:
-                    on_deps_ready()
-
-            for d in unresolved:
-                self._state(d).add_callback(one_ready)
+            self._after_deps(list(unresolved), on_deps_ready)
 
     def _enqueue_actor_dispatch(self, ast: _ActorRuntimeState, spec: TaskSpec,
                                 seq: int, args, kwargs) -> None:
@@ -534,7 +839,7 @@ class Runtime:
             def run():
                 a, k = self._puller.localize_all(args, kwargs)
                 node.dispatch_task(spec, a, k, target_worker=worker_id)
-            self._offload(run)
+            self._offload(run, ordered=True)
             return
         self._track(spec, node_id)
         node.dispatch_task(spec, args, kwargs, target_worker=worker_id)
@@ -580,36 +885,78 @@ class Runtime:
         with self._running_lock:
             running = self._running.pop(msg.task_id, None)
         spec = running.spec if running else None
+        resubmit = False
         if msg.error is not None:
-            err = None
-            try:
-                err = repr(serialization.unpack_payload(msg.error[1]))
-            except Exception:
-                pass
-            self.events.record(msg.task_id.hex(), FAILED, error_message=err)
-            for oid in (spec.return_ids if spec else [r[0] for r in msg.results]):
-                self.mark_ready(oid, msg.error)
+            # A task that failed because an *input* object was lost gets
+            # resubmitted once the input's lineage re-execution is kicked
+            # off — the scheduler's dependency stage holds it until the
+            # rebuilt value lands (reference: task resubmission on
+            # ObjectLostError, object_recovery_manager.h).
+            lost = self._lost_object_in_error(msg.error)
+            if lost is not None and spec is not None \
+                    and spec.actor_id is None \
+                    and spec.create_actor_id is None \
+                    and self._recover_object(lost) is not None:
+                resubmit = True
+                self.events.record(
+                    msg.task_id.hex(), PENDING_ARGS, name=spec.name,
+                    error_message="input lost; awaiting reconstruction")
+            else:
+                err = None
+                try:
+                    err = repr(serialization.unpack_payload(msg.error[1]))
+                except Exception:
+                    pass
+                self.events.record(msg.task_id.hex(), FAILED,
+                                   error_message=err)
+                for oid in (spec.return_ids if spec
+                            else [r[0] for r in msg.results]):
+                    self.mark_ready(oid, msg.error)
+                self._finish_recovery(msg.task_id)
         else:
             self.events.record(msg.task_id.hex(), FINISHED)
             for oid, desc in msg.results:
                 self.mark_ready(oid, desc)
+            self._finish_recovery(msg.task_id)
         if spec is not None and spec.create_actor_id is None:
             # Actor creation keeps its resources for the actor's lifetime.
             if not spec.resources.is_empty() or spec.placement_group is not None:
                 self.scheduler.release(node_id, spec.resources,
                                        spec.placement_group, spec.bundle_index)
+        if resubmit:
+            # Deps stay retained across the resubmit (releasing first could
+            # let GC free a sibling input that nothing would re-produce).
+            self.submit_spec(spec)
+        else:
+            self._release_deps(msg.task_id)
 
-    def on_dispatch_failed(self, spec: TaskSpec, reason: str) -> None:
+    def on_dispatch_failed(self, spec: TaskSpec, reason: str,
+                           lost_object_bytes: Optional[bytes] = None) -> None:
         with self._running_lock:
             self._running.pop(spec.task_id, None)
+        if lost_object_bytes is not None and spec.actor_id is None \
+                and spec.create_actor_id is None:
+            # A dependency vanished between resolve and dispatch: rebuild it
+            # via lineage and resubmit (the dependency stage holds the task
+            # until the rebuilt value lands).
+            try:
+                lost = ObjectID(lost_object_bytes)
+            except ValueError:
+                lost = None
+            if lost is not None and self._recover_object(lost) is not None:
+                # Deps stay retained across the resubmit (see on_task_done).
+                self.submit_spec(spec)
+                return
         self._fail_task(spec, WorkerCrashedError(reason))
 
     def _fail_task(self, spec: TaskSpec, exc: Exception) -> None:
         self.events.record(spec.task_id.hex(), FAILED, name=spec.name,
                            error_message=repr(exc))
+        self._release_deps(spec.task_id)
         desc = ("err", serialization.pack_payload(exc))
         for oid in spec.return_ids:
             self.mark_ready(oid, desc)
+        self._finish_recovery(spec.task_id)
 
     def on_worker_died(self, worker_id: WorkerID, node_id: NodeID,
                        running_tasks: List[TaskID],
@@ -776,7 +1123,7 @@ class Runtime:
         def _build_reply(timed_out: bool):
             values = []
             pinned_keys = []
-            for st in states:
+            for oid, st in zip(msg.object_ids, states):
                 if not st.event.is_set():
                     values.append(("err", b""))
                     continue
@@ -796,14 +1143,16 @@ class Runtime:
                     # it into the head store, then hand out a local pin.
                     d = self._puller.localize(d) if self._puller else (
                         "err", serialization.pack_payload(ObjectLostError(
-                            "remote object without a cluster data plane")))
+                            "remote object without a cluster data plane",
+                            object_id_bytes=oid.binary())))
                 if isinstance(d, tuple) and d and d[0] == "shma":
                     # Refresh + pin so the offset stays valid until the
                     # worker's ReadDone (plasma client-pin semantics).
                     nd = node.store.pin_desc_by_key(d[4])
                     if nd is None:
                         d = ("err", serialization.pack_payload(
-                            ObjectLostError("object was evicted or freed")))
+                            ObjectLostError("object was evicted or freed",
+                                            object_id_bytes=oid.binary())))
                     else:
                         d = nd
                         pinned_keys.append(nd[4])
@@ -1000,6 +1349,7 @@ class Runtime:
         self.scheduler.stop()
         if self._xfer_q is not None:
             self._xfer_q.put(None)
+            self._xfer_pool.shutdown(wait=False)
         if self.head_server is not None:
             self.head_server.shutdown()
         if self.data_server is not None:

@@ -68,6 +68,16 @@ def start(head, address, port, node_port, token, num_cpus, num_tpus,
     if address:
         # Worker-node join path: runs the NodeServer in the foreground
         # (or detached without --block).
+        if not token:
+            # Same-host join: the head persisted its token (0600) in the
+            # address file; remote joins must pass --token explicitly.
+            try:
+                with open(address_file) as f:
+                    token = json.load(f)["token"]
+            except (FileNotFoundError, KeyError, json.JSONDecodeError):
+                raise click.ClickException(
+                    "no cluster token: pass --token (the head persists its "
+                    "token in the address file on its own machine)")
         cmd = [sys.executable, "-m", "ray_tpu._private.node_server_main",
                "--address", address]
         if token:

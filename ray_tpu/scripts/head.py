@@ -30,18 +30,22 @@ def main(argv=None) -> int:
     from ray_tpu.job_submission import JobManager
     from ray_tpu.job_submission.server import JobServer
 
+    token_str = args.token or os.urandom(16).hex()
     rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                       head_port=args.node_port,
-                      cluster_token=args.token.encode()
-                      if args.token else None)
+                      cluster_token=token_str.encode())
     manager = JobManager()
     server = JobServer(manager, port=args.port)
 
     node_addr = "%s:%d" % rt.head_server.address
     os.makedirs(os.path.dirname(args.address_file), exist_ok=True)
+    # The cluster token is a secret (the join port unpickles peer messages);
+    # persist it 0600 so local joiners can read it, remote ones get it from
+    # the operator.
     with open(args.address_file, "w") as f:
         json.dump({"address": server.address, "pid": os.getpid(),
-                   "node_address": node_addr}, f)
+                   "node_address": node_addr, "token": token_str}, f)
+    os.chmod(args.address_file, 0o600)
 
     stop = {"flag": False}
 

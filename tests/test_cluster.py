@@ -181,6 +181,27 @@ class TestClusterFailover:
         ray_tpu.kill(a)
         cluster.remove_node(handle2)
 
+    def test_object_reconstructed_after_node_death(self, cluster):
+        """An object whose only copy died with its node is rebuilt by
+        re-executing its producing task on a surviving node (reference:
+        object_recovery_manager.h lineage reconstruction)."""
+        handle = cluster.add_node(num_cpus=1, resources={"vault": 1})
+
+        @ray_tpu.remote(num_cpus=1, resources={"vault": 0.001})
+        def produce():
+            return np.arange(250_000, dtype=np.float64)
+
+        ref = produce.remote()
+        assert ray_tpu.get(ref, timeout=30)[-1] == 249_999
+        # Drop the head's pulled cache copy so the only copy lives on the
+        # doomed node, then kill that node.
+        cluster.runtime.node.store.delete(ref.id())
+        cluster.remove_node(handle)
+        handle2 = cluster.add_node(num_cpus=1, resources={"vault": 1})
+        arr = ray_tpu.get(ref, timeout=60)
+        assert arr[-1] == 249_999
+        cluster.remove_node(handle2)
+
     def test_pg_bundle_rescheduled_after_node_death(self, cluster):
         handle = cluster.add_node(num_cpus=2, resources={"mark": 1})
         pg = ray_tpu.placement_group(

@@ -1,0 +1,141 @@
+"""Ownership GC + lineage reconstruction tests (reference analogs:
+python/ray/tests/test_reference_counting.py, test_object_reconstruction*.py
+over ReferenceCounter reference_counter.h:44 and ObjectRecoveryManager
+object_recovery_manager.h:41)."""
+
+from __future__ import annotations
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def rt():
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+class TestOwnershipGC:
+    def test_put_refs_freed_on_drop(self, rt):
+        stats0 = rt.node.store.stats()
+        refs = [ray_tpu.put(np.zeros(200_000)) for _ in range(5)]
+        assert rt.node.store.stats()["num_objects"] >= \
+            stats0["num_objects"] + 5
+        del refs
+        gc.collect()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if rt.node.store.stats()["num_objects"] <= stats0["num_objects"]:
+                break
+            time.sleep(0.05)
+        assert rt.node.store.stats()["num_objects"] <= stats0["num_objects"]
+
+    def test_directory_bounded_in_task_loop(self, rt):
+        @ray_tpu.remote
+        def noop(i):
+            return i
+
+        for i in range(300):
+            assert ray_tpu.get(noop.remote(i)) == i
+        gc.collect()
+        time.sleep(0.3)
+        # Without GC the directory would hold >=300 entries.
+        assert len(rt.directory) < 100
+
+    def test_in_flight_dependency_not_collected(self, rt):
+        @ray_tpu.remote
+        def make():
+            return np.ones(200_000)
+
+        @ray_tpu.remote
+        def total(a, delay):
+            time.sleep(delay)
+            return float(a.sum())
+
+        # The intermediate ref is dropped immediately after being passed.
+        out_ref = total.remote(make.remote(), 0.5)
+        gc.collect()
+        assert ray_tpu.get(out_ref, timeout=30) == 200_000.0
+
+    def test_escaped_ref_not_collected(self, rt):
+        inner = ray_tpu.put(np.arange(50_000))
+        holder = ray_tpu.put([inner])  # pickles the ref -> escaped
+        inner_id = inner.id()
+        del inner
+        gc.collect()
+        time.sleep(0.2)
+        got = ray_tpu.get(holder)
+        assert ray_tpu.get(got[0])[-1] == 49_999
+        assert inner_id in rt._escaped
+
+
+class TestLineageReconstruction:
+    def test_reconstruct_lost_object_on_get(self, rt):
+        @ray_tpu.remote
+        def produce():
+            return np.arange(300_000, dtype=np.float64)
+
+        ref = produce.remote()
+        arr = ray_tpu.get(ref)
+        assert arr[-1] == 299_999
+        # Simulate loss (spill-file corruption / eviction): drop the only
+        # copy from the store.
+        rt.free([ref.id()])
+        rt._state(ref.id())  # recreate directory entry with no value
+        # Directory entry is gone; re-register the stale descriptor path by
+        # re-getting through a fresh state: the materialize must fail, then
+        # lineage re-execution must deliver an identical value.
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=5)
+
+    def test_reconstruct_store_deleted_object(self, rt):
+        @ray_tpu.remote
+        def produce():
+            return np.arange(250_000, dtype=np.float64)
+
+        ref = produce.remote()
+        assert ray_tpu.get(ref)[-1] == 249_999
+        # Delete the bytes but keep the (now stale) directory entry — the
+        # realistic loss mode (node restart, spill dir wiped).
+        rt.node.store.delete(ref.id())
+        arr2 = ray_tpu.get(ref, timeout=60)
+        assert arr2[-1] == 249_999  # rebuilt by re-executing produce()
+
+    def test_reconstruct_dependency_chain(self, rt):
+        @ray_tpu.remote
+        def base():
+            return np.full(200_000, 3.0)
+
+        @ray_tpu.remote
+        def double(a):
+            return a * 2
+
+        x = base.remote()
+        y = double.remote(x)
+        assert ray_tpu.get(y)[0] == 6.0
+        # Lose both the intermediate and the final object.
+        rt.node.store.delete(x.id())
+        rt.node.store.delete(y.id())
+        out = ray_tpu.get(y, timeout=60)
+        assert out[0] == 6.0
+
+    def test_lost_task_arg_triggers_reconstruction(self, rt):
+        @ray_tpu.remote
+        def base():
+            return np.full(150_000, 5.0)
+
+        @ray_tpu.remote
+        def consume(a):
+            return float(a.sum())
+
+        x = base.remote()
+        assert ray_tpu.get(consume.remote(x), timeout=30) == 750_000.0
+        rt.node.store.delete(x.id())
+        # Dispatch-side pin failure -> lineage rebuild -> resubmit.
+        assert ray_tpu.get(consume.remote(x), timeout=60) == 750_000.0
