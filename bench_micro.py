@@ -1,0 +1,155 @@
+"""Microbenchmark suite mirroring the reference's canonical perf cases
+(reference: python/ray/_private/ray_perf.py:95 `main`; recorded baselines in
+release/perf_metrics/microbenchmark.json — see BASELINE.md table).
+
+Prints one JSON line per case:
+    {"benchmark": "...", "value": N, "unit": "ops/s", "baseline": N}
+
+Run: python bench_micro.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+# Reference numbers from BASELINE.md (release/perf_metrics/microbenchmark.json)
+BASELINES = {
+    "single_client_tasks_async": 7097.0,
+    "single_client_tasks_sync": 813.0,
+    "1_1_actor_calls_sync": 1880.0,
+    "1_1_actor_calls_async": 8397.0,
+    "n_n_actor_calls_async": 23481.0,
+    "single_client_put_calls": 4632.0,
+    "single_client_get_calls": 10618.0,
+    "single_client_put_gigabytes": 12.8,
+    "single_client_wait_1k_refs": 4.9,
+    "placement_group_create_removal": 657.0,
+}
+
+
+def timeit(name, fn, multiplier=1, *, repeat=3, warmup=1):
+    for _ in range(warmup):
+        fn()
+    best = 0.0
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        best = max(best, multiplier / dt)
+    base = BASELINES.get(name)
+    print(json.dumps({
+        "benchmark": name, "value": round(best, 2),
+        "unit": "GiB/s" if "gigabytes" in name else "ops/s",
+        "baseline": base,
+        "vs_baseline": round(best / base, 3) if base else None,
+    }), flush=True)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    args = p.parse_args()
+    scale = 0.2 if args.quick else 1.0
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+
+    @ray_tpu.remote
+    def small():
+        return b"ok"
+
+    # Warm the worker pool so spawn cost isn't measured.
+    ray_tpu.get([small.remote() for _ in range(16)])
+
+    n = int(1000 * scale)
+    timeit("single_client_tasks_async",
+           lambda: ray_tpu.get([small.remote() for _ in range(n)]),
+           multiplier=n)
+
+    n_sync = int(200 * scale)
+
+    def sync_tasks():
+        for _ in range(n_sync):
+            ray_tpu.get(small.remote())
+    timeit("single_client_tasks_sync", sync_tasks, multiplier=n_sync)
+
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return b"ok"
+
+    actor = Echo.remote()
+    ray_tpu.get(actor.ping.remote())
+
+    def actor_sync():
+        for _ in range(n_sync):
+            ray_tpu.get(actor.ping.remote())
+    timeit("1_1_actor_calls_sync", actor_sync, multiplier=n_sync)
+
+    n_async = int(1000 * scale)
+    timeit("1_1_actor_calls_async",
+           lambda: ray_tpu.get([actor.ping.remote() for _ in range(n_async)]),
+           multiplier=n_async)
+
+    n_actors = 8
+    actors = [Echo.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+    per = int(250 * scale)
+    timeit("n_n_actor_calls_async",
+           lambda: ray_tpu.get([a.ping.remote() for a in actors
+                                for _ in range(per)]),
+           multiplier=n_actors * per)
+
+    small_obj = np.zeros(64, np.float64)
+    n_put = int(500 * scale)
+    timeit("single_client_put_calls",
+           lambda: [ray_tpu.put(small_obj) for _ in range(n_put)],
+           multiplier=n_put)
+
+    refs = [ray_tpu.put(small_obj) for _ in range(n_put)]
+
+    def gets():
+        for r in refs:
+            ray_tpu.get(r)
+    timeit("single_client_get_calls", gets, multiplier=n_put)
+
+    big = np.zeros(64 * 1024 * 1024 // 8, np.float64)  # 64 MiB
+    n_big = max(int(8 * scale), 2)
+    gib = n_big * big.nbytes / (1 << 30)
+    put_refs = []
+
+    def big_puts():
+        put_refs.clear()
+        put_refs.extend(ray_tpu.put(big) for _ in range(n_big))
+    timeit("single_client_put_gigabytes", big_puts, multiplier=gib)
+
+    @ray_tpu.remote
+    def slowish(i):
+        return i
+
+    def wait_1k():
+        refs = [slowish.remote(i) for i in range(int(1000 * scale))]
+        ready, pending = ray_tpu.wait(refs, num_returns=len(refs),
+                                      timeout=120)
+        assert not pending
+    timeit("single_client_wait_1k_refs", wait_1k, multiplier=1)
+
+    n_pg = int(50 * scale)
+
+    def pg_cycle():
+        for _ in range(n_pg):
+            pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+            pg.ready(timeout=10)
+            ray_tpu.remove_placement_group(pg)
+    timeit("placement_group_create_removal", pg_cycle, multiplier=n_pg)
+
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -61,11 +61,13 @@ class ObjectRef:
             rt.add_local_ref(object_id)
 
     def __del__(self):
+        # May run at arbitrary GC points: only a lock-free enqueue here
+        # (the runtime's ref-gc thread applies the decrement).
         if getattr(self, "_owned", False):
             rt = _rtmod._global_runtime
             if rt is not None:
                 try:
-                    rt.remove_local_ref(self._id)
+                    rt.enqueue_ref_drop(self._id)
                 except Exception:
                     pass
 

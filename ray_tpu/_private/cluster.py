@@ -587,12 +587,18 @@ class HeadServer:
         elif isinstance(msg, RpcCall):
             rt.on_rpc_call(proxy, msg)
         elif isinstance(msg, NodeRpc):
-            try:
-                fn = getattr(rt, "ctl_" + msg.method)
-                value = fn(*msg.args, **msg.kwargs)
-                proxy.send(NodeRpcReply(msg.request_id, value))
-            except Exception as e:  # noqa: BLE001
-                proxy.send(NodeRpcReply(msg.request_id, None, repr(e)))
+            def run_rpc(m=msg):
+                try:
+                    fn = getattr(rt, "ctl_" + m.method)
+                    value = fn(*m.args, **m.kwargs)
+                    proxy.send(NodeRpcReply(m.request_id, value))
+                except Exception as e:  # noqa: BLE001
+                    proxy.send(NodeRpcReply(m.request_id, None, repr(e)))
+            if msg.method in rt._BLOCKING_CTL:
+                # Long-poll ctl calls must not stall this node's reader.
+                threading.Thread(target=run_rpc, daemon=True).start()
+            else:
+                run_rpc()
         elif isinstance(msg, RegisterNode):
             # Second handshake message: the node's real data address (its
             # data server can only bind after the ack delivers the config).

@@ -94,6 +94,9 @@ class Controller:
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.jobs: Dict[JobID, JobInfo] = {}
         self._kv: Dict[str, Dict[str, bytes]] = {}
+        # Writers notify blocked kv_wait readers (no poll loops; the
+        # reference's pubsub long-poll analog, reference: pubsub/publisher.h).
+        self._kv_cond = threading.Condition(self._lock)
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
 
     # -- nodes --------------------------------------------------------------
@@ -203,7 +206,26 @@ class Controller:
             if not overwrite and key in ns:
                 return False
             ns[key] = value
+            self._kv_cond.notify_all()
             return True
+
+    def kv_wait(self, key: str, namespace: str = "default",
+                timeout: Optional[float] = None) -> Optional[bytes]:
+        """Block until ``key`` exists (or timeout); returns its value.
+
+        Event-driven replacement for client-side poll loops (collective
+        rendezvous, p2p handshakes)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._kv_cond:
+            while True:
+                v = self._kv.get(namespace, {}).get(key)
+                if v is not None:
+                    return v
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._kv_cond.wait(remaining)
 
     def kv_get(self, key: str, namespace: str = "default") -> Optional[bytes]:
         with self._lock:

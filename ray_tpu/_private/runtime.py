@@ -98,6 +98,13 @@ class ObjectState:
                 return
         cb()
 
+    def discard_callback(self, cb: Callable[[], None]) -> None:
+        with self.lock:
+            try:
+                self.callbacks.remove(cb)
+            except ValueError:
+                pass
+
 
 def _has_remote_desc(args, kwargs) -> bool:
     return any(isinstance(d, tuple) and d and d[0] == "at"
@@ -183,6 +190,15 @@ class Runtime:
         # are freed from the directory + store.
         self._gc_enabled = bool(Config.get("enable_object_gc"))
         self._ref_lock = threading.Lock()
+        # __del__ may fire at arbitrary GC points (possibly while this very
+        # process holds _ref_lock), so ref drops are queued lock-free and
+        # drained by a dedicated thread (reference: the Cython ObjectRef
+        # dealloc defers to the io service for the same reason).
+        import queue as _q
+        self._ref_drop_q: Any = _q.SimpleQueue()
+        if self._gc_enabled:
+            threading.Thread(target=self._ref_drop_loop, name="ref-gc",
+                             daemon=True).start()
         self._local_refs: Dict[ObjectID, int] = {}
         self._escaped: set = set()
         self._dropped: set = set()
@@ -377,28 +393,42 @@ class Runtime:
 
     def wait(self, object_ids: List[ObjectID], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True):
+        """Event-driven wait: readiness callbacks signal a condition — no
+        poll loop (the round-1 1ms spin showed up directly in the wait_1k
+        microbenchmark; reference: WaitManager wait_manager.h)."""
         if num_returns > len(object_ids):
             raise ValueError(
                 f"num_returns={num_returns} exceeds the {len(object_ids)} "
                 "refs passed to wait()")
         deadline = None if timeout is None else time.monotonic() + timeout
-        pending = list(object_ids)
-        ready: List[ObjectID] = []
-        while len(ready) < num_returns:
-            progressed = False
-            for o in list(pending):
-                if self._object_ready(o):
-                    ready.append(o)
-                    pending.remove(o)
-                    progressed = True
-                    if len(ready) >= num_returns:
+        cond = threading.Condition()
+        n_ready = [0]
+
+        def on_ready():
+            with cond:
+                n_ready[0] += 1
+                cond.notify()
+
+        states = [self._state(o) for o in object_ids]
+        for st in states:
+            st.add_callback(on_ready)
+        try:
+            with cond:
+                while n_ready[0] < num_returns:
+                    remaining = None if deadline is None else \
+                        deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
                         break
-            if len(ready) >= num_returns:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            if not progressed:
-                time.sleep(0.001)
+                    cond.wait(remaining)
+        finally:
+            # Unregister from still-pending states: polling wait() loops
+            # must not accumulate dead closures on never-ready objects.
+            for st in states:
+                st.discard_callback(on_ready)
+        ready = [o for o, st in zip(object_ids, states) if st.event.is_set()]
+        ready = ready[:max(num_returns, 0)] if len(ready) > num_returns \
+            else ready
+        pending = [o for o in object_ids if o not in set(ready)]
         return ready, pending
 
     def free(self, object_ids: List[ObjectID]) -> None:
@@ -456,6 +486,21 @@ class Runtime:
             return
         with self._ref_lock:
             self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+
+    def enqueue_ref_drop(self, oid: ObjectID) -> None:
+        """GC-safe entry point for ObjectRef.__del__ (lock-free put)."""
+        if self._gc_enabled and not self._shutdown:
+            self._ref_drop_q.put(oid)
+
+    def _ref_drop_loop(self) -> None:
+        while True:
+            oid = self._ref_drop_q.get()
+            if oid is None or self._shutdown:
+                return
+            try:
+                self.remove_local_ref(oid)
+            except Exception:
+                pass
 
     def remove_local_ref(self, oid: ObjectID) -> None:
         if not self._gc_enabled or self._shutdown:
@@ -585,6 +630,28 @@ class Runtime:
             self._state(rid).reset()
         with self._ref_lock:
             self._escaped.add(oid)  # recovered objects stay pinned
+        # Recursively rebuild dependencies that are gone (GC'd after their
+        # refs dropped, or lost and never re-produced): a resubmitted task
+        # parks in the dependency stage, so unready deps must have their
+        # own recovery kicked here or it waits forever.  An unrecoverable
+        # dep (no lineage — e.g. a freed ray.put — or attempts exhausted)
+        # fails the whole recovery NOW: waiters get ObjectLostError instead
+        # of hanging on a task that can never run.
+        deps = [a[1] for a in spec.arg_descs if a[0] == "ref"]
+        deps += [d[1] for d in spec.kwarg_descs.values() if d[0] == "ref"]
+        for dep in deps:
+            with self._dir_lock:
+                st = self.directory.get(dep)
+            if st is None or not st.event.is_set():
+                if self._recover_object(dep) is None:
+                    err = ("err", serialization.pack_payload(ObjectLostError(
+                        f"object {oid} is unrecoverable: its input {dep} "
+                        "has no lineage (freed put or evicted spec)",
+                        object_id_bytes=oid.binary())))
+                    for rid in spec.return_ids:
+                        self._state(rid).mark_ready(err)
+                    self._finish_recovery(task_id)
+                    return None
         self.events.record(task_id.hex(), PENDING_ARGS, name=spec.name,
                            error_message="lineage reconstruction")
         self.submit_spec(spec)
@@ -1190,14 +1257,25 @@ class Runtime:
     def on_put_from_worker(self, msg: PutFromWorker) -> None:
         self.mark_ready(msg.object_id, msg.desc)
 
-    def on_rpc_call(self, node: NodeManager, msg: RpcCall) -> None:
-        try:
-            fn = getattr(self, "ctl_" + msg.method)
-            value = fn(*msg.args, **msg.kwargs)
-            node.send_to_worker(msg.worker_id, RpcReply(msg.request_id, value))
-        except Exception as e:
-            node.send_to_worker(msg.worker_id,
-                                RpcReply(msg.request_id, None, repr(e)))
+    # ctl_* methods that may block (long-poll style): handled off the
+    # reader thread so one waiting worker can't stall its node connection.
+    _BLOCKING_CTL = frozenset({"kv_wait"})
+
+    def on_rpc_call(self, node, msg: RpcCall) -> None:
+        def run():
+            try:
+                fn = getattr(self, "ctl_" + msg.method)
+                value = fn(*msg.args, **msg.kwargs)
+                node.send_to_worker(msg.worker_id,
+                                    RpcReply(msg.request_id, value))
+            except Exception as e:  # noqa: BLE001
+                node.send_to_worker(msg.worker_id,
+                                    RpcReply(msg.request_id, None, repr(e)))
+        if msg.method in self._BLOCKING_CTL:
+            threading.Thread(target=run, daemon=True,
+                             name=f"ctl-{msg.method}").start()
+        else:
+            run()
 
     # control-plane methods callable from workers (and used by the driver
     # API directly). All arguments/returns must be plain picklable data.
@@ -1213,6 +1291,9 @@ class Runtime:
 
     def ctl_kv_keys(self, prefix="", namespace="default"):
         return self.controller.kv_keys(prefix, namespace)
+
+    def ctl_kv_wait(self, key, namespace="default", timeout=None):
+        return self.controller.kv_wait(key, namespace, timeout)
 
     def ctl_get_named_actor(self, name, namespace=None):
         info = self.controller.get_named_actor(name,
@@ -1347,6 +1428,8 @@ class Runtime:
     def shutdown(self) -> None:
         self._shutdown = True
         self.scheduler.stop()
+        if self._gc_enabled:
+            self._ref_drop_q.put(None)
         if self._xfer_q is not None:
             self._xfer_q.put(None)
             self._xfer_pool.shutdown(wait=False)

@@ -22,7 +22,7 @@ import random
 import threading
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from .config import Config
 from .controller import (Controller, NodeInfo, PlacementGroupInfo, PG_CREATED,
@@ -55,6 +55,7 @@ class _PendingTask:
     spec: TaskSpec
     unresolved: Set[ObjectID]
     dispatch: Callable[[TaskSpec, NodeID], None]
+    key: Any = None  # scheduling-class key (computed once at submit)
 
 
 @dataclass
@@ -77,7 +78,12 @@ class ClusterScheduler:
         self._object_ready = object_ready
         self._lock = threading.RLock()
         self._nodes: Dict[NodeID, _NodeState] = {}
-        self._ready: deque = deque()          # _PendingTask with deps resolved
+        # Ready tasks bucketed by scheduling class (reference: SchedulingKey
+        # grouping in normal_task_submitter.h): each wake visits classes,
+        # not tasks, so a full queue behind exhausted resources costs
+        # O(classes) per pass instead of O(tasks).
+        self._ready: "dict[Any, deque]" = {}
+        self._ready_count = 0
         self._waiting: Dict[ObjectID, List[_PendingTask]] = defaultdict(list)
         self._infeasible: List[_PendingTask] = []
         self._wake = threading.Condition(self._lock)
@@ -96,7 +102,8 @@ class ClusterScheduler:
         with self._wake:
             self._nodes[info.node_id] = _NodeState(info, info.total_resources.copy())
             # Newly added capacity may unblock infeasible tasks.
-            self._ready.extend(self._infeasible)
+            for t in self._infeasible:
+                self._push_ready_locked(t)
             self._infeasible.clear()
             self._wake.notify_all()
 
@@ -131,13 +138,20 @@ class ClusterScheduler:
         # already fired, stranding the task in _waiting forever.
         with self._wake:
             unresolved = {d for d in deps if not self._object_ready(d)}
-            task = _PendingTask(spec, unresolved, dispatch)
+            task = _PendingTask(spec, unresolved, dispatch,
+                                self._sched_key(spec))
             if unresolved:
                 for d in unresolved:
                     self._waiting[d].append(task)
             else:
-                self._ready.append(task)
+                self._push_ready_locked(task)
                 self._wake.notify_all()
+
+    def _push_ready_locked(self, task: _PendingTask) -> None:
+        if task.key is None:
+            task.key = self._sched_key(task.spec)
+        self._ready.setdefault(task.key, deque()).append(task)
+        self._ready_count += 1
 
     def notify_object_ready(self, object_id: ObjectID) -> None:
         with self._wake:
@@ -146,7 +160,7 @@ class ClusterScheduler:
             for t in tasks:
                 t.unresolved.discard(object_id)
                 if not t.unresolved:
-                    self._ready.append(t)
+                    self._push_ready_locked(t)
                     moved = True
             if moved:
                 self._wake.notify_all()
@@ -172,40 +186,57 @@ class ClusterScheduler:
 
     # -- scheduling loop ----------------------------------------------------
 
+    @staticmethod
+    def _sched_key(spec: TaskSpec):
+        """Scheduling-class key (reference: SchedulingKey in
+        normal_task_submitter.h): tasks with identical resource shape,
+        placement target and strategy place identically, so one failed
+        placement disqualifies the whole class for this round — turning the
+        O(queue) rescan per wake into O(distinct classes)."""
+        res = tuple(sorted(spec.resources.to_dict().items()))
+        strat = spec.scheduling_strategy
+        if isinstance(strat, NodeAffinitySchedulingStrategy):
+            strat = ("affinity", strat.node_id, strat.soft)
+        return (res, spec.placement_group, spec.bundle_index, strat)
+
     def _loop(self) -> None:
         while True:
             with self._wake:
-                while self._running and not self._ready:
+                while self._running and not self._ready_count:
                     self._retry_pending_pgs_locked()
                     self._wake.wait(timeout=0.5)
                 if not self._running:
                     return
                 self._retry_pending_pgs_locked()
-                n = len(self._ready)
-                deferred: List[_PendingTask] = []
                 progress = False
-                for _ in range(n):
-                    task = self._ready.popleft()
-                    node_id = self._try_place(task.spec)
-                    if node_id is None:
-                        deferred.append(task)
-                        continue
-                    progress = True
-                    try:
-                        task.dispatch(task.spec, node_id)
-                    except Exception as exc:
-                        # Undo the resource deduction and surface the error;
-                        # silently dropping would leak capacity and hang get().
-                        spec = task.spec
-                        self.release(node_id, spec.resources,
-                                     spec.placement_group, spec.bundle_index)
-                        if self.on_dispatch_error is not None:
-                            try:
-                                self.on_dispatch_error(spec, exc)
-                            except Exception:
-                                pass
-                self._ready.extend(deferred)
-                if deferred and not progress:
+                for key in list(self._ready):
+                    bucket = self._ready.get(key)
+                    while bucket:
+                        task = bucket[0]
+                        node_id = self._try_place(task.spec)
+                        if node_id is None:
+                            break  # whole class blocked this round
+                        bucket.popleft()
+                        self._ready_count -= 1
+                        progress = True
+                        try:
+                            task.dispatch(task.spec, node_id)
+                        except Exception as exc:
+                            # Undo the resource deduction and surface the
+                            # error; silently dropping would leak capacity
+                            # and hang get().
+                            spec = task.spec
+                            self.release(node_id, spec.resources,
+                                         spec.placement_group,
+                                         spec.bundle_index)
+                            if self.on_dispatch_error is not None:
+                                try:
+                                    self.on_dispatch_error(spec, exc)
+                                except Exception:
+                                    pass
+                    if not bucket:
+                        self._ready.pop(key, None)
+                if self._ready_count and not progress:
                     # Nothing placeable right now; sleep until resources free.
                     self._wake.wait(timeout=0.05)
 
@@ -416,4 +447,5 @@ class ClusterScheduler:
 
     def num_pending(self) -> int:
         with self._lock:
-            return len(self._ready) + sum(len(v) for v in self._waiting.values())
+            return self._ready_count + sum(
+                len(v) for v in self._waiting.values())

@@ -34,14 +34,18 @@ def _kv_del(key: str) -> None:
 
 
 def _wait_for(key: str, timeout: float = _RENDEZVOUS_TIMEOUT_S) -> bytes:
+    """Blocking server-side wait (controller condvar, ctl_kv_wait) — the
+    writer's kv_put wakes us; no client poll loop.  Chunked so a lost
+    reply can't strand the caller past the deadline."""
     deadline = time.monotonic() + timeout
+    from .._private.api import _control
     while True:
-        v = _kv_get(key)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"rendezvous timed out waiting for {key}")
+        v = _control("kv_wait", key, timeout=min(remaining, 10.0))
         if v is not None:
             return v
-        if time.monotonic() > deadline:
-            raise TimeoutError(f"rendezvous timed out waiting for {key}")
-        time.sleep(_POLL_S)
 
 
 def _free_port() -> int:

@@ -53,6 +53,9 @@ class LlamaConfig:
     # MoE: 0 experts = dense model.
     num_experts: int = 0
     moe_top_k: int = 2
+    # 0 = dense (masked) dispatch; > 0 = capacity-based sparse dispatch
+    # with this capacity factor (see ops/moe.py).
+    moe_capacity_factor: float = 0.0
     # "auto" (flash on TPU / reference on CPU), "reference", "flash",
     # "flash_interpret", "ring", "ulysses"
     attention_impl: str = "auto"
@@ -60,6 +63,10 @@ class LlamaConfig:
     seq_axis: str = "sp"
     # False | True/"full" | "mlp_only" (see forward_with_aux)
     remat: Any = True
+    # Pipeline parallelism: number of microbatches (0 = off).  Needs a
+    # mesh with pp > 1 and layers % pp == 0; the "layers" logical axis is
+    # then sharded over pp (see parallel/pipeline.py).
+    pp_microbatches: int = 0
 
     def replace(self, **kw) -> "LlamaConfig":
         return dataclasses.replace(self, **kw)
@@ -208,7 +215,8 @@ def _mlp_half(cfg: LlamaConfig, x, layer):
                                  layer["w_gate"].astype(dt),
                                  layer["w_up"].astype(dt),
                                  layer["w_down"].astype(dt),
-                                 k=cfg.moe_top_k)
+                                 k=cfg.moe_top_k,
+                                 capacity_factor=cfg.moe_capacity_factor)
     else:
         gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(dt),
                           preferred_element_type=dt)
@@ -265,7 +273,30 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
         x, aux = block(x, layer)
         return x, aux
 
-    x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
+    if cfg.pp_microbatches:
+        # Microbatched pipeline over the pp mesh axis: each stage scans its
+        # resident layer shard; activations hop stage-to-stage over ICI.
+        from ..parallel.mesh import get_global_mesh
+        from ..parallel.pipeline import pipeline_blocks
+        mesh = get_global_mesh()
+        if mesh is None or mesh.shape.get("pp", 1) <= 1:
+            raise ValueError(
+                "cfg.pp_microbatches > 0 needs a global mesh with pp > 1")
+        if cfg.num_experts:
+            raise NotImplementedError("MoE + pipeline parallelism")
+        if cfg.attention_impl in ("ring", "ulysses"):
+            raise NotImplementedError(
+                "sequence-parallel attention inside a pipeline stage")
+
+        def stage_body(stage_layers, h):
+            h, _ = jax.lax.scan(scan_body, h, stage_layers)
+            return h
+
+        x = pipeline_blocks(params["blocks"], x, stage_body,
+                            num_microbatches=cfg.pp_microbatches, mesh=mesh)
+        auxes = jnp.zeros((), jnp.float32)
+    else:
+        x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(dt),
                         preferred_element_type=jnp.float32)

@@ -55,23 +55,85 @@ def load_balancing_loss(info: RoutingInfo, num_experts: int) -> jax.Array:
     return num_experts * jnp.sum(me * ce)
 
 
+def capacity_dispatch(info: RoutingInfo, num_experts: int,
+                      capacity: int) -> Tuple[jax.Array, jax.Array]:
+    """Build GShard-style dispatch/combine tensors with capacity dropping.
+
+    Tokens are assigned slots within each expert in token order via a
+    cumulative count; assignments beyond ``capacity`` are dropped (their
+    contribution to the output is zero — the residual stream carries them).
+
+    Returns (dispatch [T, X, C] one-hot float, combine [T, X, C]) over
+    flattened tokens T = B*S.
+    """
+    B, S, X = info.combine_weights.shape
+    k = info.expert_index.shape[-1]
+    idx = info.expert_index.reshape(B * S, k)
+    weights = info.combine_weights.reshape(B * S, X)
+
+    counts = jnp.zeros((X,), jnp.int32)
+    dispatch = jnp.zeros((B * S, X, capacity), jnp.float32)
+    combine = jnp.zeros((B * S, X, capacity), jnp.float32)
+    for j in range(k):
+        oh = jax.nn.one_hot(idx[:, j], X, dtype=jnp.int32)     # [T, X]
+        pos = jnp.cumsum(oh, axis=0) - 1 + counts[None, :]     # [T, X]
+        keep = (pos < capacity) & (oh > 0)
+        counts = counts + jnp.sum(oh * keep, axis=0)
+        slot = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                              dtype=jnp.float32)               # [T, X, C]
+        d_j = slot * keep[..., None].astype(jnp.float32)
+        dispatch = dispatch + d_j
+        w_j = jnp.take_along_axis(weights, idx[:, j:j + 1], axis=-1)
+        combine = combine + d_j * w_j[..., None]
+    return dispatch, combine
+
+
 def moe_layer(x, router_w, w_gate, w_up, w_down, k: int = 2,
               rng: Optional[jax.Array] = None,
-              router_noise: float = 0.0) -> Tuple[jax.Array, jax.Array]:
+              router_noise: float = 0.0,
+              capacity_factor: float = 0.0) -> Tuple[jax.Array, jax.Array]:
     """SwiGLU expert MLPs with top-k routing.
 
     x: [B, S, E]; router_w: [E, X]; w_gate/w_up: [X, E, M]; w_down: [X, M, E].
     Returns (output [B, S, E], aux_loss scalar).
+
+    ``capacity_factor`` == 0 keeps the dense dispatch (every expert sees
+    every token, masked — exact, but O(num_experts) FLOPs); > 0 switches to
+    capacity-based sparse dispatch where each expert processes at most
+    ``ceil(k * T * capacity_factor / X)`` token slots, so expert FLOPs
+    scale as top_k * capacity_factor / num_experts of dense.  Under the
+    ``ep`` mesh axis the dispatch/combine einsums lower to the token
+    all-to-all (GShard recipe).
     """
+    import math
+
+    X = router_w.shape[-1]
     info = top_k_routing(x, router_w, k=k, rng=rng,
                          router_noise=router_noise)
-    # Dense dispatch: compute all experts, weight by combine matrix.  Under
-    # the ep axis, each device computes only its expert shard ("x" dim) and
-    # GSPMD reduces the combine einsum across ep.
-    gate = jnp.einsum("bse,xem->bsxm", x, w_gate)
-    up = jnp.einsum("bse,xem->bsxm", x, w_up)
-    h = jax.nn.silu(gate) * up
-    expert_out = jnp.einsum("bsxm,xme->bsxe", h, w_down)
-    out = jnp.einsum("bsxe,bsx->bse", expert_out,
-                     info.combine_weights.astype(expert_out.dtype))
-    return out.astype(x.dtype), load_balancing_loss(info, router_w.shape[-1])
+    if capacity_factor and capacity_factor > 0.0:
+        B, S, E = x.shape
+        T = B * S
+        capacity = max(int(math.ceil(k * T * capacity_factor / X)), 1)
+        dispatch, combine = capacity_dispatch(info, X, capacity)
+        xt = x.reshape(T, E)
+        # Token all-to-all: [T, E] x [T, X, C] -> per-expert slot inputs.
+        expert_in = jnp.einsum("te,txc->xce", xt,
+                               dispatch.astype(x.dtype))
+        gate = jnp.einsum("xce,xem->xcm", expert_in, w_gate)
+        up = jnp.einsum("xce,xem->xcm", expert_in, w_up)
+        h = jax.nn.silu(gate) * up
+        expert_out = jnp.einsum("xcm,xme->xce", h, w_down)
+        out = jnp.einsum("xce,txc->te", expert_out,
+                         combine.astype(expert_out.dtype))
+        out = out.reshape(B, S, E)
+    else:
+        # Dense dispatch: compute all experts, weight by combine matrix.
+        # Under the ep axis, each device computes only its expert shard
+        # ("x" dim) and GSPMD reduces the combine einsum across ep.
+        gate = jnp.einsum("bse,xem->bsxm", x, w_gate)
+        up = jnp.einsum("bse,xem->bsxm", x, w_up)
+        h = jax.nn.silu(gate) * up
+        expert_out = jnp.einsum("bsxm,xme->bsxe", h, w_down)
+        out = jnp.einsum("bsxe,bsx->bse", expert_out,
+                         info.combine_weights.astype(expert_out.dtype))
+    return out.astype(x.dtype), load_balancing_loss(info, X)

@@ -45,6 +45,11 @@ def make_lm_train_step(cfg, mesh, *, rules: Optional[ShardingRules] = None,
     from ..models import llama as L
 
     rules = rules or default_rules()
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if getattr(cfg, "pp_microbatches", 0) and axis_sizes.get("pp", 1) > 1:
+        # Pipeline mode: shard the stacked layer axis over pp so each stage
+        # holds its resident layers (see parallel/pipeline.py).
+        rules = rules.replace(layers="pp")
     set_global_mesh(mesh)
     if optimizer is None:
         optimizer = optax.adamw(learning_rate, b1=0.9, b2=0.95,
@@ -93,6 +98,9 @@ def make_lm_eval_step(cfg, mesh, *, rules: Optional[ShardingRules] = None):
     from ..models import llama as L
 
     rules = rules or default_rules()
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if getattr(cfg, "pp_microbatches", 0) and axis_sizes.get("pp", 1) > 1:
+        rules = rules.replace(layers="pp")
     set_global_mesh(mesh)
     logical = L.param_logical_axes(cfg)
     param_shardings = jax.tree.map(

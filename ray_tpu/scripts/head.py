@@ -42,10 +42,14 @@ def main(argv=None) -> int:
     # The cluster token is a secret (the join port unpickles peer messages);
     # persist it 0600 so local joiners can read it, remote ones get it from
     # the operator.
-    with open(args.address_file, "w") as f:
+    fd = os.open(args.address_file, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                 0o600)
+    # O_CREAT's mode only applies to new files; a pre-existing address file
+    # must also be clamped before the token lands in it.
+    os.fchmod(fd, 0o600)
+    with os.fdopen(fd, "w") as f:
         json.dump({"address": server.address, "pid": os.getpid(),
                    "node_address": node_addr, "token": token_str}, f)
-    os.chmod(args.address_file, 0o600)
 
     stop = {"flag": False}
 

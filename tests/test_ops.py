@@ -191,6 +191,47 @@ class TestMoE:
         g = jax.grad(loss)(rw)
         assert np.isfinite(np.asarray(g)).all()
 
+    def test_sparse_dispatch_matches_dense_at_full_capacity(self):
+        # Capacity >= T means nothing drops: sparse == dense exactly.
+        B, S, E, M, X = 2, 8, 16, 32, 4
+        ks = jax.random.split(jax.random.key(1), 5)
+        x = jax.random.normal(ks[0], (B, S, E))
+        rw = jax.random.normal(ks[1], (E, X)) * 0.1
+        wg = jax.random.normal(ks[2], (X, E, M)) * 0.1
+        wu = jax.random.normal(ks[3], (X, E, M)) * 0.1
+        wd = jax.random.normal(ks[4], (X, M, E)) * 0.1
+        dense, _ = moe_layer(x, rw, wg, wu, wd, k=2)
+        # capacity_factor X/k -> capacity == T: no token can overflow.
+        sparse, _ = moe_layer(x, rw, wg, wu, wd, k=2,
+                              capacity_factor=X / 2)
+        np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_sparse_dispatch_capacity_drops_and_grads(self):
+        from ray_tpu.ops.moe import capacity_dispatch
+        B, S, E, M, X = 2, 16, 16, 32, 4
+        ks = jax.random.split(jax.random.key(2), 5)
+        x = jax.random.normal(ks[0], (B, S, E))
+        rw = jax.random.normal(ks[1], (E, X)) * 0.1
+        info = top_k_routing(x, rw, k=2)
+        capacity = 4  # far below T*k/X = 16: forces drops
+        dispatch, combine = capacity_dispatch(info, X, capacity)
+        # No expert slot is double-assigned; per-expert load <= capacity.
+        per_slot = np.asarray(dispatch).sum(axis=0)  # [X, C]
+        assert (per_slot <= 1.0 + 1e-6).all()
+        assert (np.asarray(dispatch).sum(axis=(0, 2)) <= capacity).all()
+        # Dropped tokens have zero combine weight but output stays finite
+        # and differentiable.
+        wg = jax.random.normal(ks[2], (X, E, M)) * 0.1
+        wu = jax.random.normal(ks[3], (X, E, M)) * 0.1
+        wd = jax.random.normal(ks[4], (X, M, E)) * 0.1
+
+        def loss(rw):
+            o, a = moe_layer(x, rw, wg, wu, wd, k=2, capacity_factor=0.5)
+            return (o ** 2).mean() + 0.01 * a
+        g = jax.grad(loss)(rw)
+        assert np.isfinite(np.asarray(g)).all()
+
 
 class TestMeshSharding:
     def test_mesh_spec_resolution(self):

@@ -125,6 +125,35 @@ class TestLineageReconstruction:
         out = ray_tpu.get(y, timeout=60)
         assert out[0] == 6.0
 
+    def test_reconstruct_after_dep_was_gcd(self, rt):
+        """A lost object whose input was already GC'd: recovery must
+        recursively rebuild the freed dependency too."""
+        import gc as _gc
+
+        @ray_tpu.remote
+        def base():
+            return np.full(200_000, 3.0)
+
+        @ray_tpu.remote
+        def double(a):
+            return a * 2
+
+        x = base.remote()
+        y = double.remote(x)
+        assert ray_tpu.get(y)[0] == 6.0
+        x_id = x.id()
+        del x  # drop the only ref; GC frees x once deps release
+        _gc.collect()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if x_id not in rt.directory:
+                break
+            time.sleep(0.05)
+        assert x_id not in rt.directory  # x is gone
+        rt.node.store.delete(y.id())     # now lose y's bytes too
+        out = ray_tpu.get(y, timeout=60)
+        assert out[0] == 6.0
+
     def test_lost_task_arg_triggers_reconstruction(self, rt):
         @ray_tpu.remote
         def base():
