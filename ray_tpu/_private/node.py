@@ -525,7 +525,11 @@ class NodeManager:
         if handle is None:
             return
         if force and handle.proc.poll() is None:
-            handle.proc.terminate()
+            # SIGKILL, not SIGTERM: workers running jax install a
+            # preemption-notifier SIGTERM handler that swallows the signal,
+            # which would leave the "killed" actor training forever and its
+            # resources never released.
+            handle.proc.kill()
         else:
             self._send(handle, KillWorker("actor killed"))
 

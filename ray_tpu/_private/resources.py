@@ -87,7 +87,13 @@ def task_resources(num_cpus: float | None, num_tpus: float | None,
                    resources: Mapping[str, float] | None,
                    default_num_cpus: float = 1.0) -> ResourceSet:
     r: Dict[str, float] = dict(resources or {})
-    r[CPU] = float(num_cpus) if num_cpus is not None else default_num_cpus
+    if num_cpus is not None:
+        r[CPU] = float(num_cpus)
+    elif CPU not in r:
+        # The default must not clobber an explicit CPU entry in the custom
+        # resources dict (resources={"CPU": 1} on an actor means 1, not the
+        # actor default of 0).
+        r[CPU] = default_num_cpus
     if num_tpus is not None:
         r[TPU] = float(num_tpus)
     if memory is not None:

@@ -103,11 +103,13 @@ class TrainController:
 
     def __init__(self, train_fn: Callable, train_loop_config,
                  scaling_config, run_config):
+        from .scaling_policy import make_scaling_policy
         self.train_fn = train_fn
         self.train_loop_config = train_loop_config
         self.scaling = scaling_config
         self.run_config = run_config
         self.run_id = uuid.uuid4().hex[:12]
+        self.policy = make_scaling_policy(scaling_config)
         self.manager = CheckpointManager(
             run_config.storage_path, run_config.name,
             num_to_keep=run_config.checkpoint_config.num_to_keep)
@@ -116,7 +118,7 @@ class TrainController:
 
     # -- worker group -------------------------------------------------------
 
-    def _worker_env(self, rank: int) -> Dict[str, str]:
+    def _worker_env(self, rank: int, world: int) -> Dict[str, str]:
         env: Dict[str, str] = dict(self.scaling.env_per_worker or {})
         if not self.scaling.use_tpu:
             env.setdefault("JAX_PLATFORMS", "cpu")
@@ -124,18 +126,19 @@ class TrainController:
             env.setdefault("XLA_FLAGS", "")
         if self.scaling.num_slices > 1:
             from ..accelerators.tpu import get_tpu_coordinator_env_vars
-            workers_per_slice = max(
-                1, self.scaling.num_workers // self.scaling.num_slices)
+            # Slice layout follows the ACTUAL group size (elastic groups
+            # may be smaller than the configured num_workers).
+            workers_per_slice = max(1, world // self.scaling.num_slices)
             env.update(get_tpu_coordinator_env_vars(
                 slice_id=rank // workers_per_slice,
                 num_slices=self.scaling.num_slices,
                 coordinator_address=self._megascale_addr))
         return env
 
-    def _start_group(self) -> WorkerGroupState:
+    def _start_group(self, n: Optional[int] = None) -> WorkerGroupState:
         import ray_tpu
 
-        n = self.scaling.num_workers
+        n = n if n is not None else self.scaling.num_workers
         self._megascale_addr = f"127.0.0.1:{_free_port()}"
         resources = dict(self.scaling.resources_per_worker or {})
         if self.scaling.use_tpu and self.scaling.chips_per_worker:
@@ -145,7 +148,7 @@ class TrainController:
         group = WorkerGroupState()
         for rank in range(n):
             opts: Dict[str, Any] = {
-                "runtime_env": {"env_vars": self._worker_env(rank)},
+                "runtime_env": {"env_vars": self._worker_env(rank, n)},
             }
             if resources:
                 opts["resources"] = resources
@@ -194,8 +197,14 @@ class TrainController:
 
         failures = 0
         error: Optional[Exception] = None
+        carry_target: Optional[int] = None
+        self.world_size_history: List[int] = []
         while True:
-            group = self._start_group()
+            decision = self.policy.initial_decision(prefer=carry_target)
+            carry_target = None
+            world = decision.num_workers
+            self.world_size_history.append(world)
+            group = self._start_group(world)
             fn_blob = serialization.dumps_control(self.train_fn)
             ctx_info = {
                 "storage_path": self.run_config.storage_path,
@@ -207,6 +216,8 @@ class TrainController:
                 w.run.remote(fn_blob, self.train_loop_config, ctx_info)
                 for w in group.workers]
             error = None
+            resize_to: Optional[int] = None
+            last_elastic_check = time.monotonic()
             pending = list(group.run_refs)
             while pending:
                 done, pending = ray_tpu.wait(
@@ -219,8 +230,35 @@ class TrainController:
                         error = e
                         pending = []
                         break
+                # Elastic upsize check (reference: elastic.py monitor
+                # decision): new capacity -> teardown + re-form the world
+                # at the larger size, resuming from the latest checkpoint.
+                if pending and error is None and \
+                        time.monotonic() - last_elastic_check >= \
+                        self.scaling.elastic_check_interval_s:
+                    last_elastic_check = time.monotonic()
+                    d = self.policy.monitor_decision(len(group.workers))
+                    if d is not None:
+                        # A crashed worker frees resources that look like
+                        # growth; drain already-failed refs first so a
+                        # crash takes the failure path (and max_failures
+                        # accounting), not the resize path.
+                        done_now, _ = ray_tpu.wait(
+                            pending, num_returns=len(pending), timeout=0)
+                        for ref in done_now:
+                            try:
+                                ray_tpu.get(ref)
+                            except Exception as e:  # noqa: BLE001
+                                error = e
+                                break
+                        if error is None:
+                            resize_to = d.num_workers
+                        pending = []
             self._poll_reports()
             self._teardown_group(group)
+            if resize_to is not None:
+                carry_target = resize_to
+                continue  # not a failure: re-run at the new size
             if error is None:
                 break
             failures += 1
@@ -229,6 +267,10 @@ class TrainController:
             # Restart: fresh group resumes from the latest committed
             # checkpoint (reference: controller failure policy ->
             # group teardown -> re-create -> resume, SURVEY §3.4 step 6).
+            # Prefer the previous size so the policy grace-waits for the
+            # dead group's resources to release instead of greedily
+            # under-sizing on the first partial fit.
+            carry_target = world
 
         rank0 = sorted((r for r in self._reports if r["rank"] == 0),
                        key=lambda r: r["time"])
@@ -239,4 +281,5 @@ class TrainController:
             checkpoint=Checkpoint(latest) if latest else None,
             error=error,
             all_reports=self._reports,
-            num_failures=failures)
+            num_failures=failures,
+            world_size_history=self.world_size_history)

@@ -1,0 +1,108 @@
+"""Scaling policies: how large the next worker group should be.
+
+Reference: python/ray/train/v2/_internal/execution/scaling_policy/
+(fixed.py, elastic.py) — the controller consults the policy before every
+group (re)start and between status polls; an elastic decision triggers
+group teardown + re-formation + checkpoint restore (JAX cannot resize a
+live mesh, so resize == restart, same as the reference's torch elastic).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass
+class ScalingDecision:
+    num_workers: int
+    reason: str = ""
+
+
+class FixedScalingPolicy:
+    def __init__(self, scaling_config):
+        self.scaling = scaling_config
+
+    def initial_decision(self, prefer: Optional[int] = None
+                         ) -> ScalingDecision:
+        return ScalingDecision(self.scaling.num_workers, "fixed")
+
+    def monitor_decision(self, current: int) -> Optional[ScalingDecision]:
+        return None  # never resizes mid-run
+
+
+class ElasticScalingPolicy:
+    """Size groups to current cluster capacity in [min, max] workers."""
+
+    def __init__(self, scaling_config):
+        self.scaling = scaling_config
+        self.min = scaling_config.min_workers or 1
+        self.max = scaling_config.max_workers or max(
+            scaling_config.num_workers, self.min)
+        if self.min > self.max:
+            raise ValueError(
+                f"min_workers ({self.min}) > max_workers ({self.max})")
+
+    def _per_worker_resources(self) -> Dict[str, float]:
+        res = dict(self.scaling.resources_per_worker or {})
+        if self.scaling.use_tpu and self.scaling.chips_per_worker:
+            res["TPU"] = float(self.scaling.chips_per_worker)
+        if not res:
+            res = {"CPU": 1.0}
+        return res
+
+    def _fit_count(self) -> int:
+        import ray_tpu
+        avail = ray_tpu.available_resources()
+        per = self._per_worker_resources()
+        fit = math.inf
+        for name, amount in per.items():
+            if amount <= 0:
+                continue
+            fit = min(fit, int(avail.get(name, 0.0) // amount))
+        if fit is math.inf:
+            fit = self.max
+        return max(min(int(fit), self.max), 0)
+
+    def initial_decision(self, timeout_s: float = 120.0,
+                         prefer: Optional[int] = None) -> ScalingDecision:
+        """Wait until at least min_workers fit, then take all that fit.
+
+        ``prefer`` carries a monitor decision across the restart: right
+        after a teardown the old group's resources release asynchronously,
+        so the policy briefly waits for capacity to reach the preferred
+        size before settling for whatever fits."""
+        deadline = time.monotonic() + timeout_s
+        prefer_deadline = time.monotonic() + 10.0 if prefer else None
+        while True:
+            fit = self._fit_count()
+            if prefer is not None and fit >= min(prefer, self.max):
+                return ScalingDecision(min(prefer, self.max),
+                                       f"resized to {prefer}")
+            if fit >= self.min and (
+                    prefer_deadline is None
+                    or time.monotonic() > prefer_deadline):
+                return ScalingDecision(fit, f"capacity fits {fit}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"elastic trainer needs >= {self.min} workers; cluster "
+                    f"fits only {fit}")
+            time.sleep(0.5)
+
+    def monitor_decision(self, current: int) -> Optional[ScalingDecision]:
+        """Upsize when new capacity appears (downsizing happens naturally
+        through the failure path when workers/nodes die)."""
+        headroom = self._fit_count()
+        target = min(current + headroom, self.max)
+        if target > current:
+            return ScalingDecision(
+                target, f"capacity grew: {current} -> {target}")
+        return None
+
+
+def make_scaling_policy(scaling_config):
+    if scaling_config.elastic:
+        return ElasticScalingPolicy(scaling_config)
+    return FixedScalingPolicy(scaling_config)

@@ -45,6 +45,19 @@ class ScalingConfig:
     env_per_worker: Optional[Dict[str, str]] = None
     # Form a jax.distributed world even for num_workers == 1.
     force_distributed: bool = False
+    # Elastic scaling (reference: train/v2/_internal/execution/
+    # scaling_policy/elastic.py): when min/max are set, the controller
+    # sizes each (re)started group to what the cluster can currently fit,
+    # clamped to [min_workers, max_workers], and upsizes between polls
+    # when capacity appears (resize = teardown + re-form the jax world +
+    # resume from the latest checkpoint — a live mesh cannot be resized).
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
+    elastic_check_interval_s: float = 5.0
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None or self.max_workers is not None
 
 
 @dataclass
@@ -69,6 +82,9 @@ class Result:
     error: Optional[Exception] = None
     all_reports: List[Dict[str, Any]] = field(default_factory=list)
     num_failures: int = 0
+    # World size of each group incarnation (len > 1 = elastic resizes /
+    # failure restarts happened).
+    world_size_history: List[int] = field(default_factory=list)
 
 
 class JaxTrainer:
