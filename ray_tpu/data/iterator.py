@@ -38,8 +38,10 @@ def iter_batches(ds, *, batch_size: int = 256, drop_last: bool = False,
 
 
 def _blocks_of(ds):
-    from .executor import execute, fetch
-    for b in execute(ds):
+    # Streaming execution: batches can be consumed while later blocks are
+    # still being produced by worker tasks (produce/consume overlap).
+    from .executor import execute_streaming, fetch
+    for b in execute_streaming(ds):
         yield fetch(b)
 
 

@@ -15,8 +15,11 @@ gets exclusive chips per replica.
 from .api import (Application, Deployment, DeploymentHandle, deployment,
                   get_deployment_handle, run, shutdown, status)
 from .batching import batch
+from .controller import AutoscalingConfig
+from .long_poll import LongPollBroker
 
 __all__ = [
     "deployment", "run", "shutdown", "status", "Deployment", "Application",
     "DeploymentHandle", "get_deployment_handle", "batch",
+    "AutoscalingConfig", "LongPollBroker",
 ]

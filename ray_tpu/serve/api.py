@@ -10,16 +10,19 @@ uvicorn ingress).
 
 from __future__ import annotations
 
-import itertools
 import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from .controller import AutoscalingConfig
 
 _app_lock = threading.Lock()
 _deployments: Dict[str, "_DeploymentState"] = {}
 _http_server = None
+_controller = None
 
 
 @dataclass
@@ -33,6 +36,9 @@ class Deployment:
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     init_args: tuple = ()
     init_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # Queue-depth autoscaling (reference: serve/autoscaling_policy.py);
+    # None = fixed num_replicas.
+    autoscaling_config: Optional["AutoscalingConfig"] = None
 
     def options(self, **kw) -> "Deployment":
         import dataclasses
@@ -54,14 +60,16 @@ class Application:
 def deployment(_cls=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 8,
                num_cpus: float = 0.0, num_tpus: int = 0,
-               ray_actor_options: Optional[Dict[str, Any]] = None):
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               autoscaling_config: Optional["AutoscalingConfig"] = None):
     """@serve.deployment (reference: serve/api.py:471)."""
     def wrap(cls):
         return Deployment(cls, name or cls.__name__,
                           num_replicas=num_replicas,
                           max_ongoing_requests=max_ongoing_requests,
                           num_cpus=num_cpus, num_tpus=num_tpus,
-                          ray_actor_options=ray_actor_options or {})
+                          ray_actor_options=ray_actor_options or {},
+                          autoscaling_config=autoscaling_config)
     if _cls is not None:
         return wrap(_cls)
     return wrap
@@ -94,51 +102,104 @@ class _ReplicaActor:
 
 
 class _DeploymentState:
+    """Replica set + router state; mutated only by start/stop and the
+    ServeController's reconcile loop (self-healing + autoscaling)."""
+
     def __init__(self, dep: Deployment):
         self.deployment = dep
         self.replicas: List[Any] = []
-        self.inflight: Dict[int, int] = {}
+        self.inflight: Dict[int, int] = {}  # id(replica) -> in-flight count
+        self.stopped = False
+        # Reconcile-backfill crash-loop backoff (controller-owned).
+        self.backfill_not_before = 0.0
+        self.backfill_backoff_s = 0.5
+        ac = dep.autoscaling_config
+        self.target_replicas = max(dep.num_replicas, ac.min_replicas) \
+            if ac is not None else dep.num_replicas
         self._lock = threading.Lock()
-        self._rr = itertools.count()
+        self._opts: Optional[Dict[str, Any]] = None
+        self._cls_blob: Optional[bytes] = None
+
+    def _replica_opts(self):
+        from .._private import serialization
+        if self._opts is None:
+            self._cls_blob = serialization.dumps_control(
+                self.deployment.cls_or_fn)
+            opts: Dict[str, Any] = {
+                "max_concurrency": self.deployment.max_ongoing_requests,
+                "num_cpus": self.deployment.num_cpus,
+            }
+            if self.deployment.num_tpus:
+                opts["num_tpus"] = self.deployment.num_tpus
+            opts.update(self.deployment.ray_actor_options)
+            self._opts = opts
+        return self._cls_blob, self._opts
+
+    def add_replica(self, wait_ready: bool = False):
+        import ray_tpu
+        if self.stopped:
+            raise RuntimeError("deployment is stopped")
+        cls_blob, opts = self._replica_opts()
+        actor_cls = ray_tpu.remote(_ReplicaActor)
+        r = actor_cls.options(**opts).remote(
+            cls_blob, self.deployment.init_args, self.deployment.init_kwargs)
+        if wait_ready:
+            try:
+                ray_tpu.get(r.ping.remote(), timeout=120)
+            except Exception:
+                ray_tpu.kill(r)
+                raise
+        with self._lock:
+            if self.stopped:
+                ray_tpu.kill(r)
+                raise RuntimeError("deployment is stopped")
+            self.replicas.append(r)
+            self.inflight[id(r)] = 0
+        return r
+
+    def remove_replica(self):
+        import ray_tpu
+        with self._lock:
+            if not self.replicas:
+                return
+            r = self.replicas.pop()
+            self.inflight.pop(id(r), None)
+        try:
+            ray_tpu.kill(r)
+        except Exception:
+            pass
 
     def start(self):
         import ray_tpu
-        from .._private import serialization
-        cls_blob = serialization.dumps_control(self.deployment.cls_or_fn)
-        actor_cls = ray_tpu.remote(_ReplicaActor)
-        opts: Dict[str, Any] = {
-            "max_concurrency": self.deployment.max_ongoing_requests,
-            "num_cpus": self.deployment.num_cpus,
-        }
-        if self.deployment.num_tpus:
-            opts["num_tpus"] = self.deployment.num_tpus
-        opts.update(self.deployment.ray_actor_options)
-        for i in range(self.deployment.num_replicas):
-            r = actor_cls.options(**opts).remote(
-                cls_blob, self.deployment.init_args,
-                self.deployment.init_kwargs)
-            self.replicas.append(r)
-            self.inflight[i] = 0
-        ray_tpu.get([r.ping.remote() for r in self.replicas], timeout=120)
+        refs = [self.add_replica().ping.remote()
+                for _ in range(self.target_replicas)]
+        ray_tpu.get(refs, timeout=120)
 
-    def pick_replica(self) -> int:
+    def pick_replica(self):
         """Power-of-two-choices on in-flight counts (reference:
-        pow_2_router.py)."""
+        pow_2_router.py).  Returns a replica handle."""
         with self._lock:
             n = len(self.replicas)
+            if n == 0:
+                return None
             if n == 1:
-                return 0
-            a, b = random.sample(range(n), 2)
-            return a if self.inflight[a] <= self.inflight[b] else b
+                return self.replicas[0]
+            ia, ib = random.sample(range(n), 2)
+            a, b = self.replicas[ia], self.replicas[ib]
+            return a if self.inflight.get(id(a), 0) <= \
+                self.inflight.get(id(b), 0) else b
 
     def stop(self):
         import ray_tpu
-        for r in self.replicas:
+        with self._lock:
+            self.stopped = True
+            replicas, self.replicas = self.replicas, []
+            self.inflight.clear()
+        for r in replicas:
             try:
                 ray_tpu.kill(r)
             except Exception:
                 pass
-        self.replicas = []
 
 
 class DeploymentHandle:
@@ -161,15 +222,28 @@ class DeploymentHandle:
             state = _deployments.get(self._name)
         if state is None:
             raise ValueError(f"no deployment named {self._name!r}")
-        idx = state.pick_replica()
+        # A reconcile may briefly leave zero replicas (all died at once);
+        # wait for the controller to backfill rather than failing the
+        # request (reference: router retries against the long-poll set).
+        deadline = time.monotonic() + 60
+        while True:
+            replica = state.pick_replica()
+            if replica is not None:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no live replicas")
+            time.sleep(0.05)
         with state._lock:
-            state.inflight[idx] += 1
-        replica = state.replicas[idx]
+            state.inflight[id(replica)] = \
+                state.inflight.get(id(replica), 0) + 1
         ref = replica.handle_request.remote(self._method, args, kwargs)
 
         def _done():
             with state._lock:
-                state.inflight[idx] = max(0, state.inflight[idx] - 1)
+                if id(replica) in state.inflight:
+                    state.inflight[id(replica)] = max(
+                        0, state.inflight[id(replica)] - 1)
         # Decrement when the result materializes.
         threading.Thread(target=lambda: (_wait_quiet(ref), _done()),
                          daemon=True).start()
@@ -188,6 +262,7 @@ def run(app: Application, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None,
         http_port: Optional[int] = None) -> DeploymentHandle:
     """Deploy and return a handle (reference: serve/api.py:902)."""
+    global _controller
     import ray_tpu
     if not ray_tpu.is_initialized():
         ray_tpu.init()
@@ -199,6 +274,9 @@ def run(app: Application, *, name: Optional[str] = None,
         state = _DeploymentState(dep)
         _deployments[dep.name] = state
     state.start()
+    if _controller is None:
+        from .controller import ServeController
+        _controller = ServeController(_deployments, _app_lock)
     if http_port is not None:
         _ensure_http(http_port)
     return DeploymentHandle(dep.name)
@@ -213,14 +291,23 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 def status() -> Dict[str, Dict[str, Any]]:
     with _app_lock:
-        return {name: {
-            "num_replicas": len(s.replicas),
-            "inflight": dict(s.inflight),
-        } for name, s in _deployments.items()}
+        states = list(_deployments.items())
+    out = {}
+    for name, s in states:
+        with s._lock:
+            out[name] = {
+                "num_replicas": len(s.replicas),
+                "target_replicas": s.target_replicas,
+                "inflight": dict(s.inflight),
+            }
+    return out
 
 
 def shutdown() -> None:
-    global _http_server
+    global _http_server, _controller
+    if _controller is not None:
+        _controller.stop()
+        _controller = None
     with _app_lock:
         for s in _deployments.values():
             s.stop()

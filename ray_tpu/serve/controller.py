@@ -1,0 +1,159 @@
+"""Serve controller: reconciliation + autoscaling control loop.
+
+Reference: the ServeController actor's update loops
+(python/ray/serve/_private/deployment_state.py:2795 — reconcile target vs
+running replicas, recover dead ones) and request-based autoscaling
+(serve/autoscaling_policy.py + _private/autoscaling_state.py — desired =
+total ongoing requests / target per replica, clamped with up/downscale
+delays).  One background thread reconciles every deployment; replica-set
+changes are pushed to routers through the long-poll broker.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from .long_poll import LongPollBroker
+
+
+@dataclass
+class AutoscalingConfig:
+    """reference: serve/config.py AutoscalingConfig."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 1.0
+    downscale_delay_s: float = 5.0
+
+
+class ServeController:
+    """Reconciles deployments to their targets (self-healing + autoscale)."""
+
+    def __init__(self, deployments: Dict, app_lock: threading.Lock,
+                 interval_s: float = 0.25):
+        self.deployments = deployments  # name -> _DeploymentState (live dict)
+        self._app_lock = app_lock
+        self.broker = LongPollBroker()
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        # Autoscaling decision memory: name -> (direction, since_ts)
+        self._pending_scale: Dict[str, tuple] = {}
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-controller", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    # -- control loop -------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._reconcile_all()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _reconcile_all(self) -> None:
+        with self._app_lock:
+            states = list(self.deployments.values())
+        for state in states:
+            if state.stopped:
+                continue
+            self._health_check(state)
+            self._autoscale(state)
+            self._reconcile(state)
+
+    # -- pieces -------------------------------------------------------------
+
+    def _health_check(self, state) -> None:
+        """Drop replicas whose actors died (reference: deployment_state
+        replica recovery); the reconcile step then backfills."""
+        from .._private.api import _control
+        dead = []
+        with state._lock:
+            replicas = list(state.replicas)
+        for r in replicas:
+            try:
+                actor_state = _control("actor_state", r._actor_id.binary())
+            except Exception:
+                actor_state = None
+            if actor_state in ("DEAD",):
+                dead.append(r)
+        if dead:
+            with state._lock:
+                for r in dead:
+                    if r in state.replicas:
+                        i = state.replicas.index(r)
+                        state.replicas.pop(i)
+                        state.inflight.pop(id(r), None)
+            self._publish(state)
+
+    def _autoscale(self, state) -> None:
+        cfg: Optional[AutoscalingConfig] = state.deployment.autoscaling_config
+        if cfg is None:
+            return
+        with state._lock:
+            n = len(state.replicas)
+            total_inflight = sum(state.inflight.values())
+        if n == 0:
+            return
+        desired = math.ceil(total_inflight / max(cfg.target_ongoing_requests,
+                                                 1e-6))
+        desired = max(min(desired, cfg.max_replicas), cfg.min_replicas)
+        if desired == state.target_replicas:
+            self._pending_scale.pop(state.deployment.name, None)
+            return
+        direction = "up" if desired > state.target_replicas else "down"
+        delay = cfg.upscale_delay_s if direction == "up" \
+            else cfg.downscale_delay_s
+        key = state.deployment.name
+        pending = self._pending_scale.get(key)
+        now = time.monotonic()
+        if pending is None or pending[0] != direction:
+            self._pending_scale[key] = (direction, now, desired)
+            return
+        if now - pending[1] >= delay:
+            state.target_replicas = desired
+            self._pending_scale.pop(key, None)
+
+    def _reconcile(self, state) -> None:
+        """Start/stop replicas until running == target (reference:
+        deployment_state.py reconciliation).  Backfill waits for replica
+        readiness and backs off exponentially when creation keeps failing
+        (no unbounded actor crash loops)."""
+        if state.stopped:
+            return
+        with state._lock:
+            n = len(state.replicas)
+            target = state.target_replicas
+        changed = False
+        now = time.monotonic()
+        while n < target and now >= state.backfill_not_before:
+            try:
+                state.add_replica(wait_ready=True)
+                state.backfill_backoff_s = 0.5
+                changed = True
+            except Exception:
+                state.backfill_not_before = now + state.backfill_backoff_s
+                state.backfill_backoff_s = min(
+                    state.backfill_backoff_s * 2, 30.0)
+                break
+            n += 1
+        while n > target:
+            state.remove_replica()
+            changed = True
+            n -= 1
+        if changed:
+            self._publish(state)
+
+    def _publish(self, state) -> None:
+        with state._lock:
+            snapshot = list(state.replicas)
+        self.broker.publish(state.deployment.name, snapshot)

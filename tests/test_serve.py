@@ -92,3 +92,94 @@ class TestBatching:
         out = sorted(ray_tpu.get(refs, timeout=60))
         assert out == [100 + i for i in range(8)]
         serve.shutdown()
+
+
+class TestServeControlPlane:
+    """Reconciliation + autoscaling (reference:
+    serve/_private/deployment_state.py:2795 reconcile loops,
+    serve/autoscaling_policy.py)."""
+
+    def test_dead_replica_recreated(self, ray_start):
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=2)
+        class Svc:
+            def __call__(self, x):
+                return x * 2
+
+            def pid(self):
+                import os
+                return os.getpid()
+
+        h = serve.run(Svc.bind())
+        assert ray_tpu.get(h.remote(21), timeout=30) == 42
+        state = serve.api._deployments["Svc"]
+        victim = state.replicas[0]
+        ray_tpu.kill(victim)
+        # Controller notices the death and backfills to target.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with state._lock:
+                live = [r for r in state.replicas if r is not victim]
+                if victim not in state.replicas and len(state.replicas) == 2:
+                    break
+            time.sleep(0.1)
+        with state._lock:
+            assert victim not in state.replicas
+            assert len(state.replicas) == 2
+        # Requests still served after self-heal.
+        assert ray_tpu.get(h.remote(5), timeout=30) == 10
+        serve.shutdown()
+
+    def test_autoscale_up_and_down(self, ray_start):
+        from ray_tpu import serve
+        from ray_tpu.serve import AutoscalingConfig
+
+        @serve.deployment(
+            num_replicas=1, max_ongoing_requests=4,
+            autoscaling_config=AutoscalingConfig(
+                min_replicas=1, max_replicas=3,
+                target_ongoing_requests=1.0,
+                upscale_delay_s=0.3, downscale_delay_s=0.6))
+        class Slow:
+            def __call__(self, t):
+                time.sleep(t)
+                return "done"
+
+        h = serve.run(Slow.bind())
+        state = serve.api._deployments["Slow"]
+        # Load ramp: many slow concurrent requests -> queue depth >> target.
+        refs = [h.remote(3.0) for _ in range(9)]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(state.replicas) >= 3:
+                break
+            time.sleep(0.1)
+        assert len(state.replicas) >= 3, "did not scale up"
+        ray_tpu.get(refs, timeout=120)
+        # Idle: scales back down to min.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len(state.replicas) == 1:
+                break
+            time.sleep(0.1)
+        assert len(state.replicas) == 1, "did not scale down"
+        serve.shutdown()
+
+    def test_long_poll_push_on_change(self, ray_start):
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=1)
+        class P:
+            def __call__(self, x):
+                return x
+
+        serve.run(P.bind())
+        broker = serve.api._controller.broker
+        v0, _ = broker.get("P")
+        state = serve.api._deployments["P"]
+        # Kill the only replica; the reconciler publishes a new snapshot.
+        ray_tpu.kill(state.replicas[0])
+        v1, snap = broker.wait_for_change("P", v0, timeout=30)
+        assert v1 > v0
+        serve.shutdown()
