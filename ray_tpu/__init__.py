@@ -30,10 +30,10 @@ from typing import Any, Dict, Optional
 
 from ._private import runtime as _runtime_mod
 from ._private.api import (ActorClass, ActorHandle, ActorMethod, ObjectRef,
-                           PlacementGroup, RemoteFunction, available_resources,
-                           cluster_resources, get, get_actor, kill, nodes,
-                           placement_group, put, remote,
-                           remove_placement_group, wait)
+                           ObjectRefGenerator, PlacementGroup, RemoteFunction,
+                           available_resources, cluster_resources, get,
+                           get_actor, kill, nodes, placement_group, put,
+                           remote, remove_placement_group, wait)
 from ._private.exceptions import (ActorError, GetTimeoutError, ObjectLostError,
                                   RayTpuError, TaskError, WorkerCrashedError)
 from ._private.scheduler import (NodeAffinitySchedulingStrategy,
@@ -98,7 +98,7 @@ def __getattr__(name: str):
     # collective / tune / serve / rl / util.
     import importlib
     if name in ("train", "data", "parallel", "ops", "models", "collective",
-                "tune", "serve", "rl", "util", "accelerators"):
+                "tune", "serve", "rl", "util", "accelerators", "llm"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
@@ -110,7 +110,8 @@ __all__ = [
     "remote", "get", "put", "wait",
     "kill", "get_actor", "cluster_resources", "available_resources", "nodes",
     "placement_group", "remove_placement_group", "PlacementGroup",
-    "ObjectRef", "ActorHandle", "ActorClass", "ActorMethod", "RemoteFunction",
+    "ObjectRef", "ObjectRefGenerator", "ActorHandle", "ActorClass",
+    "ActorMethod", "RemoteFunction",
     "NodeAffinitySchedulingStrategy", "PlacementGroupSchedulingStrategy",
     "RayTpuError", "TaskError", "ActorError", "WorkerCrashedError",
     "ObjectLostError", "GetTimeoutError",

@@ -41,6 +41,48 @@ def _control(method: str, *args, **kwargs):
     return getattr(rt, "ctl_" + method)(*args, **kwargs)
 
 
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded results (reference:
+    ObjectRefStream, task_manager.h:86; python num_returns="streaming").
+
+    Iterating yields ObjectRefs one per generator item; the stream closes
+    at the worker's ("end",) marker, and a mid-stream task error raises at
+    the failing item's position when its ref is materialized."""
+
+    def __init__(self, task_id: TaskID):
+        self._task_id = task_id
+        self._next = 0
+        self._terminated = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        if self._terminated:
+            raise StopIteration
+        rt = current_runtime()
+        oid = ObjectID.of(self._task_id, self._next)
+        st = rt._state(oid) if hasattr(rt, "_state") else None
+        if st is None:
+            # worker-side facade: block through a get to learn the state
+            raise RuntimeError(
+                "ObjectRefGenerator iteration is driver-side only")
+        st.event.wait()
+        if isinstance(st.desc, tuple) and st.desc and st.desc[0] == "end":
+            self._terminated = True
+            raise StopIteration
+        if isinstance(st.desc, tuple) and st.desc and st.desc[0] == "err":
+            # The error is the stream's final item: consuming it raises,
+            # and iteration ends (no index after the failure is ever
+            # published).
+            self._terminated = True
+        self._next += 1
+        return ObjectRef(oid)
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()})"
+
+
 class ObjectRef:
     """Handle to a (possibly pending) immutable object
     (reference: python/ray/includes/object_ref.pxi:50).
@@ -182,8 +224,10 @@ class RemoteFunction:
         if self._fn_blob is None:
             self._fn_blob = serialization.dumps_control(self._fn)
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
         task_id = _next_task_id()
-        return_ids = [ObjectID.of(task_id, i) for i in range(num_returns)]
+        return_ids = [] if streaming else [
+            ObjectID.of(task_id, i) for i in range(num_returns)]
         strategy, pg, bundle = _normalize_strategy(opts)
         resources = task_resources(opts.get("num_cpus"), opts.get("num_tpus"),
                                    opts.get("memory"), opts.get("resources"),
@@ -195,12 +239,15 @@ class RemoteFunction:
             arg_descs=[_pack_arg(a) for a in args],
             kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
             return_ids=return_ids, resources=resources,
-            max_retries=opts.get("max_retries",
-                                 Config.get("task_max_retries_default")),
+            max_retries=0 if streaming else opts.get(
+                "max_retries", Config.get("task_max_retries_default")),
             placement_group=pg, bundle_index=bundle,
             scheduling_strategy=strategy,
-            runtime_env=opts.get("runtime_env"))
+            runtime_env=opts.get("runtime_env"),
+            streaming=streaming)
         rt.submit_spec(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id)
         refs = [ObjectRef(oid) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 

@@ -49,6 +49,10 @@ class TaskSpec:
     runtime_env: Optional[Dict[str, Any]] = None
     max_concurrency: int = 1
     submitter: str = "driver"  # worker id hex of the submitting process
+    # num_returns="streaming": results stream item-by-item as
+    # ObjectID.of(task_id, i); a ("end",) marker closes the stream
+    # (reference: ObjectRefStream, src/ray/core_worker/task_manager.h:86).
+    streaming: bool = False
 
 
 @dataclass

@@ -190,6 +190,13 @@ class Runtime:
         # are freed from the directory + store.
         self._gc_enabled = bool(Config.get("enable_object_gc"))
         self._ref_lock = threading.Lock()
+        # Zero-copy view tracking: materialized values alias shm/arena
+        # memory, so a GC-triggered free must wait for the views to die
+        # (plasma buffer-retention semantics).  Values that can't carry a
+        # weakref keep their object pinned for the session (leak-safe).
+        self._view_counts: Dict[ObjectID, int] = {}
+        self._view_immortal: set = set()
+        self._pending_free: set = set()
         # __del__ may fire at arbitrary GC points (possibly while this very
         # process holds _ref_lock), so ref drops are queued lock-free and
         # drained by a dedicated thread (reference: the Cython ObjectRef
@@ -319,8 +326,10 @@ class Runtime:
             if shm is None:
                 value, shm = RemoteObjectReader.read(desc[1], desc[2])
                 self._mapped_segments[object_id] = shm
-                return value
-            return serialization.read_payload_from(shm.buf[: desc[2]])
+            else:
+                value = serialization.read_payload_from(shm.buf[: desc[2]])
+            self._track_view(object_id, value)
+            return value
         if kind == "shma":
             # Pin once per driver-held object so the arena offset stays valid
             # for any zero-copy views the caller retains; released at free().
@@ -332,6 +341,7 @@ class Runtime:
                     object_id_bytes=object_id.binary())
             if pin:
                 self._arena_pins.add(object_id)
+            self._track_view(object_id, value)
             return value
         if kind == "err":
             raise serialization.unpack_payload(desc[1])
@@ -431,7 +441,53 @@ class Runtime:
         pending = [o for o in object_ids if o not in set(ready)]
         return ready, pending
 
+    def _track_view(self, oid: ObjectID, value: Any) -> None:
+        """The returned value aliases shared memory: freeing the object
+        must wait for the value's death (or never happen if the value
+        can't carry a weakref)."""
+        import weakref
+        with self._ref_lock:
+            if oid in self._view_immortal:
+                return
+            try:
+                weakref.finalize(value, self._on_view_dead, oid)
+            except TypeError:
+                self._view_immortal.add(oid)
+                self._pending_free.discard(oid)
+                return
+            self._view_counts[oid] = self._view_counts.get(oid, 0) + 1
+
+    def _on_view_dead(self, oid: ObjectID) -> None:
+        # weakref.finalize callback: may fire at arbitrary GC points
+        # (possibly with _ref_lock held on this thread) — lock-free
+        # enqueue only, like ObjectRef.__del__.
+        if self._gc_enabled and not self._shutdown:
+            self._ref_drop_q.put(("view", oid))
+
+    def _view_dead(self, oid: ObjectID) -> None:
+        with self._ref_lock:
+            n = self._view_counts.get(oid, 0) - 1
+            if n > 0:
+                self._view_counts[oid] = n
+                return
+            self._view_counts.pop(oid, None)
+            run_free = oid in self._pending_free
+            self._pending_free.discard(oid)
+        if run_free:
+            self.free([oid])
+
     def free(self, object_ids: List[ObjectID]) -> None:
+        # Objects with live zero-copy views defer their free to view death.
+        deferred = []
+        with self._ref_lock:
+            for oid in object_ids:
+                if self._view_counts.get(oid, 0) > 0 or \
+                        oid in self._view_immortal:
+                    if oid not in self._view_immortal:
+                        self._pending_free.add(oid)
+                    deferred.append(oid)
+        if deferred:
+            object_ids = [o for o in object_ids if o not in set(deferred)]
         for oid in object_ids:
             with self._ref_lock:
                 self._local_refs.pop(oid, None)
@@ -490,15 +546,19 @@ class Runtime:
     def enqueue_ref_drop(self, oid: ObjectID) -> None:
         """GC-safe entry point for ObjectRef.__del__ (lock-free put)."""
         if self._gc_enabled and not self._shutdown:
-            self._ref_drop_q.put(oid)
+            self._ref_drop_q.put(("drop", oid))
 
     def _ref_drop_loop(self) -> None:
         while True:
-            oid = self._ref_drop_q.get()
-            if oid is None or self._shutdown:
+            item = self._ref_drop_q.get()
+            if item is None or self._shutdown:
                 return
+            kind, oid = item
             try:
-                self.remove_local_ref(oid)
+                if kind == "drop":
+                    self.remove_local_ref(oid)
+                else:
+                    self._view_dead(oid)
             except Exception:
                 pass
 
@@ -575,8 +635,10 @@ class Runtime:
     def _record_lineage(self, spec: TaskSpec) -> None:
         # Only stateless task outputs are reconstructable by re-execution
         # (actor method results depend on actor state; reference semantics).
+        # Streaming tasks are excluded: partial streams can't re-execute
+        # idempotently (matches the reference's streaming-generator caveat).
         if spec.actor_id is not None or spec.create_actor_id is not None \
-                or not spec.return_ids:
+                or not spec.return_ids or getattr(spec, "streaming", False):
             return
         with self._lineage_lock:
             self._lineage[spec.task_id] = spec
@@ -979,6 +1041,8 @@ class Runtime:
                 for oid in (spec.return_ids if spec
                             else [r[0] for r in msg.results]):
                     self.mark_ready(oid, msg.error)
+                if spec is not None and getattr(spec, "streaming", False):
+                    self._fail_stream(msg.task_id, msg.error)
                 self._finish_recovery(msg.task_id)
         else:
             self.events.record(msg.task_id.hex(), FINISHED)
@@ -1023,7 +1087,23 @@ class Runtime:
         desc = ("err", serialization.pack_payload(exc))
         for oid in spec.return_ids:
             self.mark_ready(oid, desc)
+        if getattr(spec, "streaming", False):
+            self._fail_stream(spec.task_id, desc)
         self._finish_recovery(spec.task_id)
+
+    def _fail_stream(self, task_id: TaskID, err_desc) -> None:
+        """Publish an error at the first unpublished stream index so a
+        blocked ObjectRefGenerator raises instead of hanging forever."""
+        i = 0
+        while True:
+            st = self._state(ObjectID.of(task_id, i))
+            if not st.event.is_set():
+                st.mark_ready(err_desc)
+                self.scheduler.notify_object_ready(ObjectID.of(task_id, i))
+                return
+            i += 1
+            if i > 1 << 20:
+                return
 
     def on_worker_died(self, worker_id: WorkerID, node_id: NodeID,
                        running_tasks: List[TaskID],

@@ -322,6 +322,31 @@ class WorkerLoop:
                     method = getattr(self.actor_instance, spec.method_name)
                     out = method(*args, **kwargs)
                 value_list = self._split_returns(out, spec)
+            elif spec.streaming:
+                # Streaming generator (reference: ObjectRefStream,
+                # task_manager.h:86): each yielded item is published
+                # immediately as ObjectID.of(task_id, i); the final
+                # ("end",) marker closes the stream, and a mid-stream
+                # exception lands as an err descriptor at the failing
+                # index so the consumer raises at the right position.
+                fn = serialization.loads_control(spec.fn_blob)
+                count = 0
+                try:
+                    for item in fn(*args, **kwargs):
+                        oid = ObjectID.of(spec.task_id, count)
+                        rt.send(PutFromWorker(
+                            oid, _serialize_result(rt, oid, item)))
+                        count += 1
+                except BaseException as exc:  # noqa: BLE001
+                    stream_err = TaskError(exc, spec.name,
+                                           traceback.format_exc())
+                    results.append((
+                        ObjectID.of(spec.task_id, count),
+                        ("err", serialization.pack_payload(stream_err))))
+                else:
+                    results.append((ObjectID.of(spec.task_id, count),
+                                    ("end",)))
+                value_list = []
             else:
                 fn = serialization.loads_control(spec.fn_blob)
                 out = fn(*args, **kwargs)

@@ -1,0 +1,20 @@
+"""ray_tpu.llm — TPU-native LLM serving and batch inference.
+
+Reference analog: python/ray/llm/ (vLLM-backed serve + batch,
+llm/_internal/serve/engines/vllm/, batch/stages/vllm_engine_stage.py).
+The reference delegates the engine to vLLM (CUDA); here the engine is
+JAX-native: paged KV cache laid out for the TPU paged-attention kernel,
+jit-compiled continuous-batching decode over all active slots, and
+length-bucketed prefill — served either as a serve deployment
+(``build_llm_deployment``) or driven directly for offline batch
+inference (``InferenceEngine.generate``).
+"""
+
+from ._cache import PagePool
+from .engine import InferenceEngine, Request, SamplingParams
+from .serving import LLMServer, build_llm_deployment
+
+__all__ = [
+    "InferenceEngine", "SamplingParams", "Request", "PagePool",
+    "LLMServer", "build_llm_deployment",
+]

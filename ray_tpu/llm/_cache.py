@@ -1,0 +1,43 @@
+"""Paged KV cache: fixed page pool + per-sequence block tables.
+
+Reference analog: the vLLM engine the reference wraps (reference:
+python/ray/llm/_internal/serve/engines/vllm/ — PagedAttention block
+manager); here the cache is a functional JAX structure laid out for the
+TPU paged-attention kernel (jax.experimental.pallas.ops.tpu.paged_attention
+expects k_pages [num_kv_heads, total_pages, page_size, head_dim]):
+
+    k_pages / v_pages : [L, Hkv, NUM_PAGES, PAGE, D]
+    block table       : [max_slots, pages_per_seq] int32 page ids
+
+Page allocation is host-side (free list in the engine); device arrays are
+donated through the jitted step so decode updates are in-place.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class PagePool:
+    """Host-side page allocator (free list).  Page 0 is reserved as the
+    null page so block tables can always point somewhere valid."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p != 0:
+                self._free.append(p)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)

@@ -1,0 +1,182 @@
+"""Llama inference forward passes with a paged KV cache.
+
+The training forward (models/llama.py) is full-sequence; inference needs
+two extra programs, both jit-compiled with static shapes:
+
+- ``prefill``: run a (padded) prompt through the model, returning the last
+  valid position's logits and the per-layer K/V to seed the cache.
+- ``decode_step``: one token per active slot, attending over the paged
+  cache via block tables — the jnp gather path is exact and runs anywhere;
+  on TPU the same layout feeds the pallas paged-attention kernel
+  (jax.experimental.pallas.ops.tpu.paged_attention).
+
+Weights are the training pytree unchanged (init_params layout), so a
+trained checkpoint serves directly.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.llama import LlamaConfig
+from ..ops.norms import rms_norm
+from ..ops.rope import rope_frequencies
+
+NEG_INF = -1e30
+
+
+def _rope_batched(x, cos, sin, positions):
+    """x: [B, H, S, D]; positions: [B, S] (per-sequence absolute)."""
+    c = cos[positions][:, None]          # [B, 1, S, D/2]
+    s = sin[positions][:, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _project_qkv(cfg, layer, h, positions):
+    """h: [B, S, E]; positions: [B, S]."""
+    dt = cfg.dtype
+    q = jnp.einsum("bse,ehd->bhsd", h, layer["wq"].astype(dt))
+    k = jnp.einsum("bse,ehd->bhsd", h, layer["wk"].astype(dt))
+    v = jnp.einsum("bse,ehd->bhsd", h, layer["wv"].astype(dt))
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    q = _rope_batched(q, cos, sin, positions)
+    k = _rope_batched(k, cos, sin, positions)
+    return q, k, v
+
+
+def _mlp(cfg, layer, h):
+    dt = cfg.dtype
+    gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(dt))
+    up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(dt))
+    return jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
+                      layer["w_down"].astype(dt))
+
+
+def prefill(params: Dict[str, Any], tokens: jax.Array, length: jax.Array,
+            cfg: LlamaConfig) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """tokens: [1, S_pad]; length: [] valid prompt length.
+
+    Returns (logits at the last valid position [vocab],
+             k [L, S_pad, Hkv, D], v [L, S_pad, Hkv, D])."""
+    dt = cfg.dtype
+    B, S = tokens.shape
+    positions = jnp.arange(S)
+    x = params["embed"].astype(dt)[tokens]
+
+    def body(x, layer):
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(cfg, layer, h, positions[None, :])
+        # Causal masking suffices: queries at/after `length` are padding
+        # whose logits are never read, and valid queries only see valid
+        # (earlier) key positions.
+        from ..ops.attention import reference_attention
+        attn = reference_attention(q, k, v, causal=True)
+        attn_out = jnp.einsum("bhsd,hde->bse", attn,
+                              layer["wo"].astype(dt))
+        x = x + attn_out
+        h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(cfg, layer, h2)
+        # [S, Hkv, D] per layer for the cache.
+        return x, (k[0].transpose(1, 0, 2), v[0].transpose(1, 0, 2))
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.clip(length - 1, 0, S - 1)
+    logits = jnp.einsum("e,ev->v", x[0, last].astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    return logits, ks, vs
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens,
+                           page_size: int):
+    """Exact jnp paged attention for one decode step.
+
+    q: [B, H, D]; k_pages/v_pages: [Hkv, NP, page, D];
+    block_table: [B, P]; seq_lens: [B] (length INCLUDING the new token).
+    """
+    B, H, D = q.shape
+    Hkv = k_pages.shape[0]
+    P = block_table.shape[1]
+    group = H // Hkv
+    # Gather each sequence's pages: [B, Hkv, P, page, D] -> [B, Hkv, S_max, D]
+    k = jnp.take(k_pages, block_table, axis=1)   # [Hkv, B, P, page, D]
+    v = jnp.take(v_pages, block_table, axis=1)
+    k = k.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, P * page_size, D)
+    v = v.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, P * page_size, D)
+    if group > 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    scores = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(D)
+    kv_pos = jnp.arange(P * page_size)
+    mask = kv_pos[None, :] < seq_lens[:, None]          # [B, S_max]
+    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhk,bhkd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def decode_step(params: Dict[str, Any], k_pages, v_pages,
+                tokens: jax.Array, positions: jax.Array,
+                block_tables: jax.Array, active: jax.Array,
+                cfg: LlamaConfig, page_size: int):
+    """One decode step for every slot.
+
+    tokens: [B] last sampled token per slot; positions: [B] their position;
+    block_tables: [B, P]; active: [B] bool.
+    Returns (logits [B, vocab], new k_pages, new v_pages) — cache arrays
+    are updated in place via donation.
+    """
+    dt = cfg.dtype
+    B = tokens.shape[0]
+    x = params["embed"].astype(dt)[tokens][:, None, :]     # [B, 1, E]
+    seq_lens = jnp.where(active, positions + 1, 0)
+    page_idx = jnp.take_along_axis(
+        block_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    page_off = positions % page_size
+
+    def body(carry, inputs):
+        x = carry
+        layer, kp, vp = inputs
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(cfg, layer, h, positions[:, None])
+        # Write the new K/V into the cache pages: kp [Hkv, NP, page, D];
+        # the advanced-index target kp[:, page_idx, page_off, :] is
+        # [Hkv, B, D], matching k_new's layout.
+        k_new = k[:, :, 0, :].transpose(1, 0, 2)           # [Hkv, B, D]
+        v_new = v[:, :, 0, :].transpose(1, 0, 2)
+        kp = kp.at[:, page_idx, page_off, :].set(
+            jnp.where(active[None, :, None],
+                      k_new, kp[:, page_idx, page_off, :]))
+        vp = vp.at[:, page_idx, page_off, :].set(
+            jnp.where(active[None, :, None],
+                      v_new, vp[:, page_idx, page_off, :]))
+        attn = paged_decode_attention(q[:, :, 0, :], kp, vp, block_tables,
+                                      seq_lens, page_size)
+        attn_out = jnp.einsum("bhd,hde->be", attn, layer["wo"].astype(dt))
+        x = x + attn_out[:, None, :]
+        h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(cfg, layer, h2)
+        return x, (kp, vp)
+
+    # Manual python loop over layers (cache arrays updated per layer).
+    n_layers = params["blocks"]["wq"].shape[0]
+    new_k, new_v = [], []
+    for li in range(n_layers):
+        layer = jax.tree.map(lambda a, li=li: a[li], params["blocks"])
+        x, (kp, vp) = body(x, (layer, k_pages[li], v_pages[li]))
+        new_k.append(kp)
+        new_v.append(vp)
+    k_pages = jnp.stack(new_k)
+    v_pages = jnp.stack(new_v)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("be,ev->bv", x[:, 0, :].astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    return logits, k_pages, v_pages

@@ -1,0 +1,119 @@
+"""LLM serving: the engine as a serve deployment.
+
+Reference analog: serve.llm build_openai_app / VLLMService (reference:
+python/ray/serve/llm, llm/_internal/serve/) — a replica owns the engine
+(and its chips via ``num_tpus``), requests join the continuous batch, and
+the serve layer provides routing/autoscaling/self-healing around it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .engine import InferenceEngine, SamplingParams
+
+
+class LLMServer:
+    """Deployment callable hosting one InferenceEngine.
+
+    A background thread drives ``engine.step()`` whenever work exists;
+    requests block on a per-request event (continuous batching means a
+    request joins mid-flight instead of waiting for a batch boundary).
+    """
+
+    def __init__(self, build_params: Callable[[], tuple],
+                 engine_options: Optional[Dict[str, Any]] = None):
+        params, cfg = build_params()
+        self.engine = InferenceEngine(params, cfg,
+                                      **(engine_options or {}))
+        self._results: Dict[int, Any] = {}
+        self._events: Dict[int, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._drive, daemon=True)
+        self._thread.start()
+
+    def _drive(self) -> None:
+        import time
+        while not self._stop.is_set():
+            if not self.engine.has_work():
+                time.sleep(0.005)
+                continue
+            for req in self.engine.step():
+                with self._lock:
+                    ev = self._events.get(req.request_id)
+                    if ev is not None:
+                        # Only store results someone is waiting for
+                        # (abandoned requests would otherwise accumulate).
+                        self._results[req.request_id] = req
+                if ev is not None:
+                    ev.set()
+
+    def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """{"prompt_tokens": [...], "max_tokens": N, ...} ->
+        {"output_tokens": [...], "finish_reason": ...}"""
+        params = SamplingParams(
+            max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            stop_token_ids=tuple(body.get("stop_token_ids", ())))
+        ev = threading.Event()
+        with self._lock:
+            rid = self.engine.add_request(
+                list(body["prompt_tokens"]), params)
+            self._events[rid] = ev
+        if not ev.wait(timeout=float(body.get("timeout_s", 300))):
+            # Abandon cleanly: release the engine slot/pages and drop the
+            # bookkeeping so repeated timeouts can't leak.
+            with self._lock:
+                self._events.pop(rid, None)
+                self._results.pop(rid, None)
+            self.engine.cancel(rid)
+            return {"error": "generation timed out"}
+        with self._lock:
+            req = self._results.pop(rid)
+            self._events.pop(rid, None)
+        return {"output_tokens": req.output_tokens,
+                "finish_reason": req.finish_reason}
+
+    def generate_batch(self, prompts: List[List[int]],
+                       max_tokens: int = 64) -> List[List[int]]:
+        """Offline batch entry point (reference: llm batch stages)."""
+        evs = []
+        with self._lock:
+            for p in prompts:
+                rid = self.engine.add_request(
+                    list(p), SamplingParams(max_tokens=max_tokens))
+                ev = threading.Event()
+                self._events[rid] = ev
+                evs.append((rid, ev))
+        out = []
+        for rid, ev in evs:
+            ev.wait(timeout=600)
+            with self._lock:
+                req = self._results.pop(rid, None)
+                self._events.pop(rid, None)
+            out.append(req.output_tokens if req else [])
+        return out
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+
+def build_llm_deployment(build_params: Callable[[], tuple], *,
+                         name: str = "llm",
+                         num_replicas: int = 1,
+                         num_tpus: int = 0,
+                         max_ongoing_requests: int = 64,
+                         engine_options: Optional[Dict[str, Any]] = None,
+                         autoscaling_config=None):
+    """Wrap the engine in a serve deployment (reference:
+    serve/llm build_llm_deployment)."""
+    from .. import serve
+
+    dep = serve.deployment(
+        LLMServer, name=name, num_replicas=num_replicas,
+        num_tpus=num_tpus, max_ongoing_requests=max_ongoing_requests,
+        autoscaling_config=autoscaling_config)
+    return dep.bind(build_params, engine_options)

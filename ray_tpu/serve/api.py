@@ -162,7 +162,12 @@ class _DeploymentState:
         with self._lock:
             if not self.replicas:
                 return
-            r = self.replicas.pop()
+            # Prefer draining an idle replica (reference: deployment_state
+            # drains before stopping); fall back to the least-loaded one.
+            idx = min(range(len(self.replicas)),
+                      key=lambda i: self.inflight.get(
+                          id(self.replicas[i]), 0))
+            r = self.replicas.pop(idx)
             self.inflight.pop(id(r), None)
         try:
             ray_tpu.kill(r)

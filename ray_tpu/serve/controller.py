@@ -117,7 +117,7 @@ class ServeController:
         pending = self._pending_scale.get(key)
         now = time.monotonic()
         if pending is None or pending[0] != direction:
-            self._pending_scale[key] = (direction, now, desired)
+            self._pending_scale[key] = (direction, now)
             return
         if now - pending[1] >= delay:
             state.target_replicas = desired

@@ -229,3 +229,59 @@ class TestFaultTolerance:
             os._exit(1)
         with pytest.raises(ray_tpu.WorkerCrashedError):
             ray_tpu.get(die.remote(), timeout=60)
+
+
+class TestStreamingGenerators:
+    """num_returns='streaming' (reference: ObjectRefStream,
+    src/ray/core_worker/task_manager.h:86)."""
+
+    def test_stream_yields_refs_in_order(self, ray_start):
+        @ray_tpu.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i * i
+
+        out = [ray_tpu.get(ref) for ref in gen.remote(5)]
+        assert out == [0, 1, 4, 9, 16]
+
+    def test_stream_consumed_while_producing(self, ray_start):
+        import time as _t
+
+        @ray_tpu.remote(num_returns="streaming")
+        def slow_gen():
+            for i in range(4):
+                _t.sleep(0.3)
+                yield i
+
+        t0 = _t.monotonic()
+        it = iter(slow_gen.remote())
+        first = ray_tpu.get(next(it))
+        t_first = _t.monotonic() - t0
+        rest = [ray_tpu.get(r) for r in it]
+        t_all = _t.monotonic() - t0
+        assert first == 0 and rest == [1, 2, 3]
+        assert t_first < t_all * 0.6  # items arrive before the stream ends
+
+    def test_stream_error_raises_at_position(self, ray_start):
+        @ray_tpu.remote(num_returns="streaming")
+        def bad_gen():
+            yield 1
+            yield 2
+            raise ValueError("boom")
+
+        it = iter(bad_gen.remote())
+        assert ray_tpu.get(next(it)) == 1
+        assert ray_tpu.get(next(it)) == 2
+        with pytest.raises(Exception, match="boom"):
+            ray_tpu.get(next(it))
+
+    def test_large_streamed_items(self, ray_start):
+        import numpy as np
+
+        @ray_tpu.remote(num_returns="streaming")
+        def big_gen():
+            for i in range(3):
+                yield np.full(100_000, float(i))
+
+        vals = [ray_tpu.get(r) for r in big_gen.remote()]
+        assert [v[0] for v in vals] == [0.0, 1.0, 2.0]
