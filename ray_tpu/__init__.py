@@ -98,7 +98,8 @@ def __getattr__(name: str):
     # collective / tune / serve / rl / util.
     import importlib
     if name in ("train", "data", "parallel", "ops", "models", "collective",
-                "tune", "serve", "rl", "util", "accelerators", "llm"):
+                "tune", "serve", "rl", "util", "accelerators", "llm",
+                "dashboard", "autoscaler"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

@@ -156,6 +156,41 @@ class ObjectRef:
         return asyncio.wrap_future(self.future()).__await__()
 
 
+_env_cache: Dict[tuple, Any] = {}
+
+
+def _env_cache_key(runtime_env) -> Optional[tuple]:
+    try:
+        return (
+            runtime_env.get("working_dir"),
+            tuple(runtime_env.get("py_modules") or ()),
+            tuple(sorted((runtime_env.get("env_vars") or {}).items())),
+        )
+    except Exception:
+        return None
+
+
+def _prepare_env(runtime_env):
+    """Resolve working_dir/py_modules local paths into content-addressed
+    package blobs (reference: runtime_env packaging.py).
+
+    Cached per env spec: a directory is snapshotted ONCE per distinct
+    spec (Ray's working_dir-upload-at-first-use semantics), so per-call
+    re-zipping and per-task blob duplication don't happen — specs share
+    one prepared dict (and its blob) by reference.
+    """
+    if not runtime_env:
+        return runtime_env
+    key = _env_cache_key(runtime_env)
+    if key is not None and key in _env_cache:
+        return _env_cache[key]
+    from .runtime_env import prepare_runtime_env
+    out = prepare_runtime_env(runtime_env)
+    if key is not None and len(_env_cache) < 256:
+        _env_cache[key] = out
+    return out
+
+
 def _pack_arg(value: Any):
     """Convert one call argument into a TaskSpec descriptor."""
     if isinstance(value, ObjectRef):
@@ -243,7 +278,7 @@ class RemoteFunction:
                 "max_retries", Config.get("task_max_retries_default")),
             placement_group=pg, bundle_index=bundle,
             scheduling_strategy=strategy,
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_prepare_env(opts.get("runtime_env")),
             streaming=streaming)
         rt.submit_spec(spec)
         if streaming:
@@ -381,7 +416,7 @@ class ActorClass:
             create_actor_id=actor_id,
             placement_group=pg, bundle_index=bundle,
             scheduling_strategy=strategy,
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_prepare_env(opts.get("runtime_env")),
             max_concurrency=opts.get("max_concurrency", 1))
         _control("actor_creation_spec", actor_id.binary(), spec)
         rt.submit_spec(spec)

@@ -58,6 +58,7 @@ class RegisterNode:
     resources: Dict[str, float]
     num_tpu_chips: int
     data_address: Tuple[str, int]
+    os_pid: int = 0
 
 
 @dataclass
@@ -490,7 +491,7 @@ class HeadServer:
             return
         node_id = NodeID.from_random()
         info = NodeInfo(node_id, msg.hostname, ResourceSet(msg.resources),
-                        is_head=False)
+                        labels={"os_pid": str(msg.os_pid)}, is_head=False)
         proxy = RemoteNodeProxy(self, conn, info, msg.data_address)
         rt = self.runtime
         with self._lock:
@@ -730,7 +731,8 @@ class NodeServer:
         from .node import NodeManager
 
         self.conn.send(RegisterNode(socket.gethostname(), node_resources,
-                                    int(num_tpus or 0), ("pending", 0)))
+                                    int(num_tpus or 0), ("pending", 0),
+                                    os_pid=os.getpid()))
         ack: RegisterAck = self.conn.recv()
         if not isinstance(ack, RegisterAck):
             raise RuntimeError(f"unexpected registration reply: {ack!r}")

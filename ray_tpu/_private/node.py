@@ -200,6 +200,13 @@ class NodeManager:
         """Send a fully-resolved task to a worker (lease grant + push)."""
         env_vars: Dict[str, str] = dict(
             spec.runtime_env.get("env_vars", {})) if spec.runtime_env else {}
+        if spec.runtime_env and (spec.runtime_env.get("working_dir")
+                                 or spec.runtime_env.get("py_modules")):
+            # Extract content-addressed packages into the node session dir;
+            # workers apply them at boot (reference: runtime-env agent
+            # GetOrCreateRuntimeEnv before the lease grant).
+            from .runtime_env import node_setup_env_vars
+            env_vars.update(node_setup_env_vars(spec.runtime_env))
         # TPU chip pinning: integral chip grants get exclusive visibility via
         # spawn-time env (libtpu/jax read it at process boot).
         n_chips = int(spec.resources.get(TPU))
@@ -251,7 +258,7 @@ class NodeManager:
         else:
             env_key = ""
             if env_vars:
-                env_key = repr(sorted(env_vars.items()))
+                env_key = repr(sorted(env_vars.items()))  # boot-env identity
             try:
                 if grant:
                     # Chip-holding workers are never pooled: the process

@@ -445,6 +445,10 @@ class Runtime:
         """The returned value aliases shared memory: freeing the object
         must wait for the value's death (or never happen if the value
         can't carry a weakref)."""
+        if not self._gc_enabled:
+            # Without GC there is no deferred-free machinery (no drain
+            # thread): frees behave exactly as before view tracking.
+            return
         import weakref
         with self._ref_lock:
             if oid in self._view_immortal:

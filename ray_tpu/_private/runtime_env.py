@@ -1,0 +1,145 @@
+"""Runtime environments beyond env_vars: working_dir and py_modules.
+
+Reference analog: python/ray/_private/runtime_env/ (working_dir.py,
+py_modules.py, packaging.py) executed by the per-node runtime-env agent
+(agent/runtime_env_agent.py:165).  Here the packaging is the same idea —
+zip the directory, content-address it by hash — but the transport is the
+task spec itself (the blob rides to the node once; extraction is cached
+per hash in the node's session dir), and application happens at worker
+boot via env vars (the worker chdirs into working_dir and prepends
+py_modules to sys.path).
+
+``pip``/``conda`` isolation is intentionally not implemented: this
+framework targets hermetic TPU pod images where interpreter-level env
+mutation is an anti-pattern (and the build env has no package index);
+requesting them raises a clear error rather than silently ignoring.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import tempfile
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+# Blobs ride the control plane; keep them bounded.
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+_extract_lock = threading.Lock()
+
+
+def package_dir(path: str) -> Tuple[bytes, str]:
+    """Zip a directory into (blob, content_hash)."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory not found: {path}")
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, path))
+    blob = buf.getvalue()
+    if len(blob) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path} is {len(blob)} bytes "
+            f"(cap {MAX_PACKAGE_BYTES}); ship large assets via the object "
+            "store or shared storage instead")
+    return blob, hashlib.sha256(blob).hexdigest()[:16]
+
+
+def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]]
+                        ) -> Optional[Dict[str, Any]]:
+    """Driver-side: resolve local paths into content-addressed blobs."""
+    if not runtime_env:
+        return runtime_env
+    for key in ("pip", "conda", "uv", "container"):
+        if runtime_env.get(key):
+            raise NotImplementedError(
+                f"runtime_env[{key!r}] is not supported: ray_tpu targets "
+                "hermetic pod images (bake dependencies into the image); "
+                "working_dir/py_modules/env_vars are supported")
+    out = dict(runtime_env)
+    wd = out.get("working_dir")
+    if wd and not str(wd).startswith("pkg:"):
+        blob, h = package_dir(wd)
+        out["working_dir"] = f"pkg:{h}"
+        out["_packages"] = dict(out.get("_packages", {}), **{h: blob})
+    mods = out.get("py_modules")
+    if mods:
+        refs = []
+        pkgs = dict(out.get("_packages", {}))
+        for m in mods:
+            if str(m).startswith("pkg:"):
+                refs.append(m)
+                continue
+            blob, h = package_dir(m)
+            pkgs[h] = blob
+            refs.append(f"pkg:{h}")
+        out["py_modules"] = refs
+        out["_packages"] = pkgs
+    return out
+
+
+def _extract(pkg_hash: str, blob: bytes, session_dir: str) -> str:
+    """Node-side: extract a package once per hash (content-addressed)."""
+    dest = os.path.join(session_dir, "runtime_env", pkg_hash)
+    with _extract_lock:
+        if os.path.isdir(dest):
+            return dest
+        tmp = dest + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            z.extractall(tmp)
+        os.replace(tmp, dest)
+    return dest
+
+
+def node_setup_env_vars(runtime_env: Optional[Dict[str, Any]],
+                        session_dir: Optional[str] = None
+                        ) -> Dict[str, str]:
+    """Node-side: extract packages, return spawn-time env vars the worker
+    applies at boot (RAY_TPU_WORKING_DIR / RAY_TPU_PY_MODULES)."""
+    if not runtime_env:
+        return {}
+    session_dir = session_dir or os.path.join(
+        tempfile.gettempdir(), "ray_tpu_session")
+    pkgs = runtime_env.get("_packages", {})
+    env: Dict[str, str] = {}
+    wd = runtime_env.get("working_dir")
+    if wd and str(wd).startswith("pkg:"):
+        h = str(wd)[4:]
+        if h not in pkgs:
+            raise ValueError(f"working_dir package {h} missing its blob")
+        env["RAY_TPU_WORKING_DIR"] = _extract(h, pkgs[h], session_dir)
+    mods: List[str] = []
+    for m in runtime_env.get("py_modules") or ():
+        if str(m).startswith("pkg:"):
+            h = str(m)[4:]
+            if h not in pkgs:
+                raise ValueError(f"py_modules package {h} missing its blob")
+            mods.append(_extract(h, pkgs[h], session_dir))
+    if mods:
+        env["RAY_TPU_PY_MODULES"] = os.pathsep.join(mods)
+    return env
+
+
+def apply_worker_env() -> None:
+    """Worker boot: chdir into working_dir, prepend py_modules to sys.path
+    (reference: working_dir/py_modules activation in the worker setup)."""
+    import sys
+    wd = os.environ.get("RAY_TPU_WORKING_DIR")
+    if wd:
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+    mods = os.environ.get("RAY_TPU_PY_MODULES")
+    if mods:
+        for m in reversed(mods.split(os.pathsep)):
+            if m and m not in sys.path:
+                sys.path.insert(0, m)

@@ -449,3 +449,21 @@ class ClusterScheduler:
         with self._lock:
             return self._ready_count + sum(
                 len(v) for v in self._waiting.values())
+
+    def pending_demand(self) -> List[Dict[str, float]]:
+        """Unplaced resource shapes (one entry per queued task) — the
+        autoscaler's demand feed (reference: GcsAutoscalerStateManager
+        resource demand -> v2/scheduler.py bin-packing)."""
+        with self._lock:
+            out: List[Dict[str, float]] = []
+            for bucket in self._ready.values():
+                for t in bucket:
+                    out.append(t.spec.resources.to_dict())
+            for t in self._infeasible:
+                out.append(t.spec.resources.to_dict())
+            pending_pg_shapes = []
+            for pg in self._pending_pgs:
+                for b in pg.bundles:
+                    if b.node_id is None:
+                        pending_pg_shapes.append(b.resources.to_dict())
+            return out + pending_pg_shapes

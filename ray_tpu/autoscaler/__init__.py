@@ -1,0 +1,21 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference analog: autoscaler v2 (python/ray/autoscaler/v2/autoscaler.py:51
+Autoscaler, v2/scheduler.py:822 ResourceDemandScheduler, declarative
+instance_manager/) fed by the GCS resource-demand view.  Here the
+reconciler reads the scheduler's unplaced shapes directly, bin-packs them
+onto configured node types, and drives a NodeProvider to converge —
+LocalSubprocessProvider boots real NodeServer processes (the test story,
+reference: FakeMultiNodeProvider autoscaler/_private/fake_multi_node/
+node_provider.py:237); TPUPodProvider is the GKE/QueuedResources-shaped
+seam for real TPU fleets.
+"""
+
+from .autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
+from .providers import (LocalSubprocessProvider, NodeProvider,
+                        TPUPodProvider)
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "NodeTypeConfig", "NodeProvider",
+    "LocalSubprocessProvider", "TPUPodProvider",
+]

@@ -1,0 +1,191 @@
+"""The reconciler: demand -> desired node set -> provider actions.
+
+Reference: v2 Autoscaler (autoscaler.py:51) update loop — read demand,
+run the ResourceDemandScheduler bin-packing (v2/scheduler.py:822), diff
+against the instance manager's view, launch/terminate.  Simplifications
+kept honest: first-fit-decreasing bin-packing over configured node types,
+idle-timeout downscaling (a node with no running work past the timeout),
+min/max clamps per type.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .providers import NodeProvider
+
+
+@dataclass
+class NodeTypeConfig:
+    """reference: available_node_types entries in the autoscaler yaml."""
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, NodeTypeConfig]
+    idle_timeout_s: float = 30.0
+    update_interval_s: float = 1.0
+
+
+class Autoscaler:
+    """Reconciles cluster size against scheduler demand."""
+
+    def __init__(self, runtime, provider: NodeProvider,
+                 config: AutoscalerConfig):
+        self.runtime = runtime
+        self.provider = provider
+        self.config = config
+        # provider_id -> (node_type, launch_ts)
+        self._launched: Dict[str, tuple] = {}
+        # node_id (runtime) -> first-seen-idle timestamp
+        self._idle_since: Dict = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="autoscaler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    # -- loop ---------------------------------------------------------------
+
+    def _loop(self) -> None:
+        # Satisfy min_workers immediately.
+        for name, ntc in self.config.node_types.items():
+            for _ in range(ntc.min_workers):
+                self._launch(name, ntc)
+        while not self._stop.wait(self.config.update_interval_s):
+            try:
+                self._reconcile()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _count_by_type(self) -> Dict[str, int]:
+        live = set(self.provider.non_terminated_nodes())
+        counts: Dict[str, int] = {}
+        for pid, (ntype, _ts) in list(self._launched.items()):
+            if pid in live:
+                counts[ntype] = counts.get(ntype, 0) + 1
+            else:
+                self._launched.pop(pid, None)
+        return counts
+
+    def _launch(self, name: str, ntc: NodeTypeConfig) -> None:
+        pid = self.provider.create_node(name, ntc.resources)
+        self._launched[pid] = (name, time.monotonic())
+
+    def _reconcile(self) -> None:
+        demand = self.runtime.scheduler.pending_demand()
+        counts = self._count_by_type()
+
+        # -- upscale: first-fit-decreasing bin-pack of unmet demand onto
+        # node types (reference: v2/scheduler.py bin-packing). Capacity
+        # already free in the cluster absorbs demand first (aggregate
+        # pool approximation; per-node packing is the scheduler's job).
+        pool = dict(self.runtime.ctl_available_resources())
+
+        def fits_pool(shape: Dict[str, float]) -> bool:
+            return all(pool.get(k, 0.0) >= v for k, v in shape.items())
+
+        unmet: List[Dict[str, float]] = []
+        for shape in sorted(demand, key=lambda s: -sum(s.values())):
+            if fits_pool(shape):
+                for k, v in shape.items():
+                    pool[k] = pool.get(k, 0.0) - v
+            else:
+                unmet.append(shape)
+
+        to_launch: Dict[str, int] = {}
+        virtual: List[Dict[str, float]] = []
+        for shape in unmet:
+            placed = False
+            for v in virtual:
+                if all(v.get(k, 0.0) >= amt for k, amt in shape.items()):
+                    for k, amt in shape.items():
+                        v[k] = v.get(k, 0.0) - amt
+                    placed = True
+                    break
+            if placed:
+                continue
+            for name, ntc in self.config.node_types.items():
+                have = counts.get(name, 0) + to_launch.get(name, 0)
+                if have >= ntc.max_workers:
+                    continue
+                if all(ntc.resources.get(k, 0.0) >= amt
+                       for k, amt in shape.items()):
+                    to_launch[name] = to_launch.get(name, 0) + 1
+                    v = dict(ntc.resources)
+                    for k, amt in shape.items():
+                        v[k] = v.get(k, 0.0) - amt
+                    virtual.append(v)
+                    placed = True
+                    break
+            # Unplaceable on any type: stays pending (surfaced by status).
+        for name, n in to_launch.items():
+            for _ in range(n):
+                self._launch(name, self.config.node_types[name])
+
+        # -- downscale: terminate nodes idle past the timeout, respecting
+        # per-type minimums (reference: idle node termination in v1/v2).
+        if not demand:
+            self._downscale_idle(counts)
+
+    def _downscale_idle(self, counts: Dict[str, int]) -> None:
+        rt = self.runtime
+        now = time.monotonic()
+        busy_nodes = set()
+        with rt._running_lock:
+            for t in rt._running.values():
+                busy_nodes.add(t.node_id)
+        with rt._actors_lock:
+            for ast in rt._actors.values():
+                if ast.node_id is not None:
+                    busy_nodes.add(ast.node_id)
+
+        # Match provider nodes to runtime nodes by recency of launch: the
+        # provider only knows pids; the runtime only knows node ids.  Idle
+        # detection operates on runtime node ids; termination picks the
+        # youngest idle provider node of a type over its minimum.
+        alive = [n for n in rt.controller.alive_nodes() if not n.is_head]
+        idle_os_pids = set()
+        for n in alive:
+            if n.node_id in busy_nodes:
+                self._idle_since.pop(n.node_id, None)
+                continue
+            first = self._idle_since.setdefault(n.node_id, now)
+            if now - first >= self.config.idle_timeout_s:
+                try:
+                    idle_os_pids.add(int(n.labels.get("os_pid", 0)))
+                except (TypeError, ValueError):
+                    pass
+        idle_os_pids.discard(0)
+        if not idle_os_pids:
+            return
+        # Terminate exactly the IDLE provider nodes (matched by the OS pid
+        # each node reported at registration), respecting type minimums.
+        get_pid = getattr(self.provider, "node_os_pid", None)
+        remaining = dict(counts)
+        for pid, (ntype, _ts) in list(self._launched.items()):
+            if remaining.get(ntype, 0) <=                     self.config.node_types[ntype].min_workers:
+                continue
+            os_pid = get_pid(pid) if get_pid else None
+            if os_pid is not None and os_pid in idle_os_pids:
+                self.provider.terminate_node(pid)
+                self._launched.pop(pid, None)
+                remaining[ntype] = remaining.get(ntype, 0) - 1
+
+    # -- introspection ------------------------------------------------------
+
+    def status(self) -> Dict:
+        return {
+            "nodes_by_type": self._count_by_type(),
+            "pending_demand": len(self.runtime.scheduler.pending_demand()),
+        }

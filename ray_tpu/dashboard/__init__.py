@@ -1,0 +1,14 @@
+"""ray_tpu.dashboard — cluster observability HTTP surface.
+
+Reference analog: python/ray/dashboard/ (head.py:49 DashboardHead + aiohttp
+module system under dashboard/modules/ — node, state, metrics, job, event).
+The reference splits head/agent processes and a React frontend; here one
+aiohttp server on the head serves JSON APIs straight off the in-process
+state feeds (events buffer, controller tables, scheduler, user metrics) plus
+a minimal HTML overview — the data plumbing is the same, the surface is
+deliberately lean.
+"""
+
+from .server import DashboardServer, start_dashboard
+
+__all__ = ["DashboardServer", "start_dashboard"]

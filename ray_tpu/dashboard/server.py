@@ -1,0 +1,177 @@
+"""Dashboard HTTP server: JSON state APIs + Prometheus metrics + overview.
+
+Endpoints (reference: dashboard/modules/*):
+    GET /                       — HTML overview
+    GET /api/cluster            — resources, node/actor/task counts
+    GET /api/nodes              — node table (state API)
+    GET /api/actors             — actor table
+    GET /api/tasks?limit=N      — task events
+    GET /api/tasks/summary      — per-function state counts
+    GET /api/objects            — object directory
+    GET /api/placement_groups   — PG table
+    GET /api/jobs               — job table
+    GET /api/timeline           — chrome-trace events
+    GET /metrics                — Prometheus exposition (user metrics)
+    GET /-/healthz              — liveness
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+_PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 8px}h2{margin-top:1.2em}</style>
+</head><body><h1>ray_tpu</h1>
+<div id=out>loading…</div>
+<script>
+async function refresh(){
+  const c = await (await fetch('/api/cluster')).json();
+  const nodes = await (await fetch('/api/nodes')).json();
+  const actors = await (await fetch('/api/actors')).json();
+  const summary = await (await fetch('/api/tasks/summary')).json();
+  let h = '<h2>cluster</h2><table>';
+  for (const [k,v] of Object.entries(c.total_resources))
+    h += `<tr><td>${k}</td><td>${c.available_resources[k]??0} / ${v}</td></tr>`;
+  h += '</table><h2>nodes</h2><table><tr><th>id</th><th>state</th><th>host</th><th>head</th></tr>';
+  for (const n of nodes) h += `<tr><td>${n.node_id.slice(0,12)}</td><td>${n.alive?'ALIVE':'DEAD'}</td><td>${n.hostname}</td><td>${n.is_head}</td></tr>`;
+  h += '</table><h2>actors</h2><table><tr><th>id</th><th>class</th><th>state</th><th>restarts</th></tr>';
+  for (const a of actors) h += `<tr><td>${a.actor_id.slice(0,12)}</td><td>${a.class_name}</td><td>${a.state}</td><td>${a.num_restarts}</td></tr>`;
+  h += '</table><h2>tasks</h2><table><tr><th>name</th><th>states</th></tr>';
+  for (const [name,states] of Object.entries(summary))
+    h += `<tr><td>${name}</td><td>${JSON.stringify(states)}</td></tr>`;
+  h += '</table>';
+  document.getElementById('out').innerHTML = h;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class DashboardServer:
+    def __init__(self, runtime, port: int = 0, host: str = "127.0.0.1"):
+        self.runtime = runtime
+        self._started = threading.Event()
+        self._loop = None
+        self._error: Optional[BaseException] = None
+        self.port: Optional[int] = None
+        self._thread = threading.Thread(
+            target=self._serve, args=(host, port), name="dashboard",
+            daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("dashboard failed to start")
+        if self._error is not None:
+            raise RuntimeError(
+                f"dashboard failed to start: {self._error!r}")
+
+    # -- handlers -----------------------------------------------------------
+
+    def _json(self, payload):
+        from aiohttp import web
+        return web.Response(text=json.dumps(payload, default=str),
+                            content_type="application/json")
+
+    def _routes(self, app):
+        from aiohttp import web
+        rt = self.runtime
+
+        async def index(req):
+            return web.Response(text=_PAGE, content_type="text/html")
+
+        async def cluster(req):
+            return self._json({
+                "total_resources": rt.ctl_cluster_resources(),
+                "available_resources": rt.ctl_available_resources(),
+                "num_nodes": len(rt.controller.nodes),
+                "num_actors": len(rt.controller.actors),
+            })
+
+        async def nodes(req):
+            return self._json(rt.ctl_nodes())
+
+        async def actors(req):
+            return self._json(rt.ctl_list_actors())
+
+        async def tasks(req):
+            limit = int(req.query.get("limit", 1000))
+            return self._json(rt.ctl_list_tasks(limit=limit))
+
+        async def tasks_summary(req):
+            return self._json(rt.ctl_summarize_tasks())
+
+        async def objects(req):
+            return self._json(rt.ctl_list_objects())
+
+        async def pgs(req):
+            return self._json(rt.ctl_list_placement_groups())
+
+        async def jobs(req):
+            return self._json(rt.ctl_list_jobs())
+
+        async def timeline(req):
+            return self._json(rt.ctl_timeline())
+
+        async def metrics(req):
+            from ..util.metrics import prometheus_text
+            return web.Response(text=prometheus_text(),
+                                content_type="text/plain")
+
+        async def healthz(req):
+            return web.Response(text="ok")
+
+        app.router.add_get("/", index)
+        app.router.add_get("/api/cluster", cluster)
+        app.router.add_get("/api/nodes", nodes)
+        app.router.add_get("/api/actors", actors)
+        app.router.add_get("/api/tasks", tasks)
+        app.router.add_get("/api/tasks/summary", tasks_summary)
+        app.router.add_get("/api/objects", objects)
+        app.router.add_get("/api/placement_groups", pgs)
+        app.router.add_get("/api/jobs", jobs)
+        app.router.add_get("/api/timeline", timeline)
+        app.router.add_get("/metrics", metrics)
+        app.router.add_get("/-/healthz", healthz)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _serve(self, host: str, port: int):
+        import asyncio
+
+        from aiohttp import web
+
+        async def main():
+            app = web.Application()
+            self._routes(app)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, host, port)
+            await site.start()
+            self.port = site._server.sockets[0].getsockname()[1]
+            self._started.set()
+            while True:
+                await asyncio.sleep(3600)
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(main())
+        except Exception as e:  # noqa: BLE001
+            if not self._started.is_set():
+                self._error = e
+                self._started.set()
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+def start_dashboard(port: int = 0, host: str = "127.0.0.1"
+                    ) -> DashboardServer:
+    """Start the dashboard against the current driver runtime."""
+    from .._private.runtime import driver_runtime
+    rt = driver_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() first")
+    return DashboardServer(rt, port=port, host=host)

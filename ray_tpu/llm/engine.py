@@ -85,8 +85,13 @@ class InferenceEngine:
 
         self.waiting: List[Request] = []
         self.running: Dict[int, Request] = {}
+        # Requests that finish during admission (immediate stop token,
+        # max_tokens=1, rejections) never occupy a slot; step() drains them.
+        self._admission_finished: List[Request] = []
         self._req_ids = itertools.count()
-        self._lock = threading.Lock()
+        # RLock: step() -> _admit() nests; server threads call
+        # add_request/cancel concurrently with the drive thread's step().
+        self._lock = threading.RLock()
         self._rng = np.random.default_rng(0)
 
         self._decode = jax.jit(
@@ -134,6 +139,7 @@ class InferenceEngine:
                 req.finish_reason = "prompt_too_long"
                 self.waiting.pop(0)
                 self.running.pop(req.request_id, None)
+                self._admission_finished.append(req)
                 continue
             bucket = self._bucket_for(n)
             if bucket is None:
@@ -141,6 +147,7 @@ class InferenceEngine:
                 req.finish_reason = "prompt_too_long"
                 self.waiting.pop(0)
                 self.running.pop(req.request_id, None)
+                self._admission_finished.append(req)
                 continue
             n_pages = math.ceil(total / self.page_size)
             if n_pages > self.pool.num_pages - 1:
@@ -150,6 +157,7 @@ class InferenceEngine:
                 req.finish_reason = "kv_capacity_exceeded"
                 self.waiting.pop(0)
                 self.running.pop(req.request_id, None)
+                self._admission_finished.append(req)
                 continue
             pages = self.pool.alloc(n_pages)
             if pages is None:
@@ -187,6 +195,8 @@ class InferenceEngine:
             bt[:n_pages] = pages
             self.block_tables[slot] = bt
             self._maybe_finish(req, int(first_tok))
+            if req.finished:
+                self._admission_finished.append(req)
 
     def _sample_host(self, logits: np.ndarray,
                      params: SamplingParams) -> int:
@@ -233,32 +243,41 @@ class InferenceEngine:
     # -- stepping -----------------------------------------------------------
 
     def has_work(self) -> bool:
-        return bool(self.waiting or any(self.slot_active))
+        with self._lock:
+            return bool(self.waiting or any(self.slot_active)
+                        or self._admission_finished)
 
     def step(self) -> List[Request]:
-        """Admit + one batched decode step; returns requests finished now."""
+        """Admit + one batched decode step; returns requests finished now.
+
+        Runs under the engine lock: add_request/cancel from server threads
+        must not interleave with slot/page mutation (a cancel between page
+        alloc and table write would let two sequences share pages)."""
         jnp = self._jnp
-        self._admit()
-        if not any(self.slot_active):
-            return []
-        logits, self.k_pages, self.v_pages = self._decode(
-            self.params, self.k_pages, self.v_pages,
-            jnp.asarray(self.slot_tokens), jnp.asarray(self.slot_pos),
-            jnp.asarray(self.block_tables), jnp.asarray(self.slot_active))
-        logits = np.asarray(logits)
-        finished = []
-        for slot in range(self.max_slots):
-            if not self.slot_active[slot]:
-                continue
-            req = self.slot_req[slot]
-            tok = self._sample_host(logits[slot], req.params)
-            req.output_tokens.append(tok)
-            self.slot_pos[slot] += 1
-            self.slot_tokens[slot] = tok
-            self._maybe_finish(req, tok)
-            if req.finished:
-                finished.append(req)
-        return finished
+        with self._lock:
+            self._admit()
+            finished = list(self._admission_finished)
+            self._admission_finished.clear()
+            if not any(self.slot_active):
+                return finished
+            logits, self.k_pages, self.v_pages = self._decode(
+                self.params, self.k_pages, self.v_pages,
+                jnp.asarray(self.slot_tokens), jnp.asarray(self.slot_pos),
+                jnp.asarray(self.block_tables),
+                jnp.asarray(self.slot_active))
+            logits = np.asarray(logits)
+            for slot in range(self.max_slots):
+                if not self.slot_active[slot]:
+                    continue
+                req = self.slot_req[slot]
+                tok = self._sample_host(logits[slot], req.params)
+                req.output_tokens.append(tok)
+                self.slot_pos[slot] += 1
+                self.slot_tokens[slot] = tok
+                self._maybe_finish(req, tok)
+                if req.finished:
+                    finished.append(req)
+            return finished
 
     # -- offline batch API --------------------------------------------------
 

@@ -24,6 +24,8 @@ def main(argv=None) -> int:
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=int, default=None)
     p.add_argument("--address-file", default="/tmp/ray_tpu/head_address")
+    p.add_argument("--dashboard-port", type=int, default=8266,
+                   help="dashboard HTTP port (0 = ephemeral, -1 = off)")
     args = p.parse_args(argv)
 
     import ray_tpu
@@ -36,6 +38,15 @@ def main(argv=None) -> int:
                       cluster_token=token_str.encode())
     manager = JobManager()
     server = JobServer(manager, port=args.port)
+    dashboard = None
+    if args.dashboard_port >= 0:
+        try:
+            from ray_tpu.dashboard import start_dashboard
+            dashboard = start_dashboard(port=args.dashboard_port)
+            print(f"dashboard at http://127.0.0.1:{dashboard.port}",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"dashboard failed to start: {e!r}", flush=True)
 
     node_addr = "%s:%d" % rt.head_server.address
     os.makedirs(os.path.dirname(args.address_file), exist_ok=True)
@@ -62,6 +73,8 @@ def main(argv=None) -> int:
     while not stop["flag"]:
         time.sleep(0.2)
     server.stop()
+    if dashboard is not None:
+        dashboard.stop()
     ray_tpu.shutdown()
     try:
         os.unlink(args.address_file)

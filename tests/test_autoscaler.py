@@ -1,0 +1,110 @@
+"""Autoscaler tests over the local subprocess provider (reference analog:
+python/ray/tests/test_autoscaler_fake_multinode.py over
+FakeMultiNodeProvider)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (Autoscaler, AutoscalerConfig,
+                                LocalSubprocessProvider, NodeTypeConfig)
+
+
+@pytest.fixture()
+def head():
+    rt = ray_tpu.init(num_cpus=0, num_tpus=0, head_port=0,
+                      cluster_token=b"astok")
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _make(rt, node_types, idle_timeout_s=3600.0):
+    provider = LocalSubprocessProvider(rt.head_server.address, b"astok")
+    asc = Autoscaler(rt, provider, AutoscalerConfig(
+        node_types=node_types, idle_timeout_s=idle_timeout_s,
+        update_interval_s=0.3))
+    return provider, asc
+
+
+def _wait(pred, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+class TestAutoscaler:
+    def test_demand_driven_scale_up(self, head):
+        provider, asc = _make(head, {
+            "cpu2": NodeTypeConfig(resources={"CPU": 2}, max_workers=3)})
+        try:
+            # No nodes yet: this task is infeasible until a node appears.
+            @ray_tpu.remote(num_cpus=1)
+            def f(x):
+                return x + 1
+
+            ref = f.remote(41)
+            assert ray_tpu.get(ref, timeout=90) == 42
+            assert len(provider.non_terminated_nodes()) >= 1
+        finally:
+            asc.stop()
+            provider.shutdown()
+
+    def test_scale_up_to_fit_parallel_demand(self, head):
+        provider, asc = _make(head, {
+            "cpu2": NodeTypeConfig(resources={"CPU": 2}, max_workers=4)})
+        try:
+            @ray_tpu.remote(num_cpus=2)
+            def hold(t):
+                time.sleep(t)
+                return 1
+
+            refs = [hold.remote(3.0) for _ in range(3)]
+            assert sum(ray_tpu.get(refs, timeout=120)) == 3
+            # 3 concurrent 2-CPU tasks needed 3 nodes.
+            assert _wait(lambda: len(provider.non_terminated_nodes()) >= 3,
+                         timeout=5)
+        finally:
+            asc.stop()
+            provider.shutdown()
+
+    def test_max_workers_cap(self, head):
+        provider, asc = _make(head, {
+            "cpu1": NodeTypeConfig(resources={"CPU": 1}, max_workers=2)})
+        try:
+            @ray_tpu.remote(num_cpus=1)
+            def hold(t):
+                time.sleep(t)
+                return 1
+
+            refs = [hold.remote(2.0) for _ in range(5)]
+            assert sum(ray_tpu.get(refs, timeout=120)) == 5
+            assert len(provider.non_terminated_nodes()) <= 2
+        finally:
+            asc.stop()
+            provider.shutdown()
+
+    def test_idle_downscale_respects_min(self, head):
+        provider, asc = _make(head, {
+            "cpu2": NodeTypeConfig(resources={"CPU": 2}, min_workers=1,
+                                   max_workers=3)},
+            idle_timeout_s=1.0)
+        try:
+            @ray_tpu.remote(num_cpus=2)
+            def hold(t):
+                time.sleep(t)
+                return 1
+
+            refs = [hold.remote(2.0) for _ in range(3)]
+            assert sum(ray_tpu.get(refs, timeout=120)) == 3
+            # After the work drains, idle nodes terminate down to min=1.
+            assert _wait(lambda: len(provider.non_terminated_nodes()) == 1,
+                         timeout=60)
+        finally:
+            asc.stop()
+            provider.shutdown()

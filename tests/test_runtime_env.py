@@ -1,0 +1,112 @@
+"""Runtime env tests: working_dir / py_modules packaging + worker
+application, dashboard HTTP surface (reference analogs:
+python/ray/tests/test_runtime_env_working_dir*.py, dashboard tests)."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def rt():
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+class TestRuntimeEnv:
+    def test_working_dir_ships_files(self, rt):
+        d = tempfile.mkdtemp(prefix="wd_")
+        with open(os.path.join(d, "payload.txt"), "w") as f:
+            f.write("hello from working_dir")
+
+        @ray_tpu.remote(runtime_env={"working_dir": d})
+        def read_payload():
+            # Worker chdir'd into the extracted package.
+            with open("payload.txt") as f:
+                return f.read()
+
+        assert ray_tpu.get(read_payload.remote(),
+                           timeout=60) == "hello from working_dir"
+
+    def test_py_modules_importable(self, rt):
+        d = tempfile.mkdtemp(prefix="mod_")
+        os.makedirs(os.path.join(d, "shipped_pkg"))
+        with open(os.path.join(d, "shipped_pkg", "__init__.py"), "w") as f:
+            f.write("MAGIC = 1234\n")
+
+        @ray_tpu.remote(runtime_env={"py_modules": [d]})
+        def use_module():
+            import shipped_pkg
+            return shipped_pkg.MAGIC
+
+        assert ray_tpu.get(use_module.remote(), timeout=60) == 1234
+
+    def test_working_dir_actor(self, rt):
+        d = tempfile.mkdtemp(prefix="wda_")
+        with open(os.path.join(d, "conf.json"), "w") as f:
+            json.dump({"x": 7}, f)
+
+        @ray_tpu.remote(runtime_env={"working_dir": d})
+        class Reader:
+            def __init__(self):
+                with open("conf.json") as f:
+                    self.conf = json.load(f)
+
+            def x(self):
+                return self.conf["x"]
+
+        a = Reader.remote()
+        assert ray_tpu.get(a.x.remote(), timeout=60) == 7
+        ray_tpu.kill(a)
+
+    def test_pip_rejected_clearly(self, rt):
+        with pytest.raises(NotImplementedError, match="pip"):
+            @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+            def f():
+                return 1
+            f.remote()
+
+    def test_missing_dir_raises(self, rt):
+        with pytest.raises(ValueError, match="not found"):
+            @ray_tpu.remote(runtime_env={"working_dir": "/no/such/dir"})
+            def f():
+                return 1
+            f.remote()
+
+
+class TestDashboard:
+    def test_endpoints(self, rt):
+        from ray_tpu.dashboard import start_dashboard
+
+        @ray_tpu.remote
+        def noop():
+            return 1
+        ray_tpu.get([noop.remote() for _ in range(3)])
+
+        dash = start_dashboard(port=0)
+        base = f"http://127.0.0.1:{dash.port}"
+
+        def get_json(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return json.loads(r.read())
+
+        cluster = get_json("/api/cluster")
+        assert cluster["total_resources"].get("CPU") == 4.0
+        nodes = get_json("/api/nodes")
+        assert len(nodes) == 1 and nodes[0]["is_head"]
+        summary = get_json("/api/tasks/summary")
+        assert "noop" in summary
+        assert get_json("/api/jobs")
+        with urllib.request.urlopen(base + "/-/healthz", timeout=10) as r:
+            assert r.read() == b"ok"
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            assert b"ray_tpu" in r.read()
+        dash.stop()
