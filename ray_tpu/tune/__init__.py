@@ -8,13 +8,15 @@ early-stop signals flow through the KV store.
 """
 
 from .search import choice, grid_search, loguniform, randint, uniform
-from .tuner import (ResultGrid, TrialResult, TuneConfig, Tuner, report,
-                    TuneStopException)
-from .schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from .tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
+                    get_checkpoint, report, TuneStopException)
+from .schedulers import (ASHAScheduler, FIFOScheduler, HyperBandScheduler,
+                         MedianStoppingRule, PopulationBasedTraining)
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
-    "TuneStopException",
+    "get_checkpoint", "TuneStopException",
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
+    "HyperBandScheduler", "PopulationBasedTraining",
 ]

@@ -26,13 +26,21 @@ class TuneStopException(Exception):
 _trial_ctx: Optional[Dict[str, Any]] = None
 
 
-def report(metrics: Dict[str, Any]) -> None:
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Dict[str, Any]] = None) -> None:
     """Report intermediate metrics from inside a trial; raises
-    TuneStopException when the scheduler has stopped this trial."""
+    TuneStopException when the scheduler has stopped this trial.
+
+    ``checkpoint`` (a picklable dict) is stored as the trial's latest
+    checkpoint — PBT exploits clone it into restarted trials."""
     if _trial_ctx is None:
         raise RuntimeError("tune.report() called outside a tune trial")
     from .._private.api import _control
     _trial_ctx["seq"] += 1
+    if checkpoint is not None:
+        _control("kv_put",
+                 f"tune/{_trial_ctx['run_id']}/ckpt/"
+                 f"{_trial_ctx['trial_id']}", pickle.dumps(checkpoint))
     _control("kv_put",
              f"tune/{_trial_ctx['run_id']}/report/{_trial_ctx['trial_id']}/"
              f"{_trial_ctx['seq']}",
@@ -46,12 +54,22 @@ def report(metrics: Dict[str, Any]) -> None:
         raise TuneStopException()
 
 
+def get_checkpoint() -> Optional[Dict[str, Any]]:
+    """Inside a trial: the checkpoint this trial was (re)started from
+    (PBT exploit), or None for a fresh start."""
+    if _trial_ctx is None:
+        raise RuntimeError("tune.get_checkpoint() outside a tune trial")
+    return _trial_ctx.get("initial_checkpoint")
+
+
 def _run_trial(fn_blob: bytes, config: Dict[str, Any], run_id: str,
-               trial_id: str):
+               trial_id: str, ckpt_blob: Optional[bytes] = None):
     global _trial_ctx
     from .._private import serialization
     fn = serialization.loads_control(fn_blob)
-    _trial_ctx = {"run_id": run_id, "trial_id": trial_id, "seq": 0}
+    _trial_ctx = {"run_id": run_id, "trial_id": trial_id, "seq": 0,
+                  "initial_checkpoint":
+                      pickle.loads(ckpt_blob) if ckpt_blob else None}
     try:
         out = fn(config)
         return {"final": out if isinstance(out, dict) else {},
@@ -80,6 +98,7 @@ class TrialResult:
     error: Optional[str] = None
     stopped_early: bool = False
     history: List[Dict[str, Any]] = field(default_factory=list)
+    restarts: int = 0  # PBT exploit relaunches
 
 
 class ResultGrid:
@@ -145,8 +164,11 @@ class Tuner:
         for cfg in variants:
             tid = uuid.uuid4().hex[:8]
             trials[tid] = {"config": cfg, "ref": None, "history": [],
-                           "seen": set()}
+                           "seen": set(), "ckpt_blob": None, "restarts": 0,
+                           "kv_tid": tid}
             queue.append(tid)
+            if hasattr(scheduler, "register_trial"):
+                scheduler.register_trial(tid, cfg)
 
         in_flight: Dict[Any, str] = {}
         results: List[TrialResult] = []
@@ -154,11 +176,16 @@ class Tuner:
         def poll_reports():
             for key in _control("kv_keys", f"tune/{run_id}/report/"):
                 parts = key.split("/")
-                tid, seq = parts[-2], int(parts[-1])
+                kv_tid, seq = parts[-2], int(parts[-1])
+                # kv ids are generation-namespaced (tid.g<N> after a PBT
+                # restart) so a relaunched trial's seqs can't collide with
+                # its previous incarnation's.
+                tid = kv_tid.split(".g")[0]
                 t = trials.get(tid)
-                if t is None or seq in t["seen"]:
+                if t is None or t["kv_tid"] != kv_tid \
+                        or (kv_tid, seq) in t["seen"]:
                     continue
-                t["seen"].add(seq)
+                t["seen"].add((kv_tid, seq))
                 payload = pickle.loads(_control("kv_get", key))
                 t["history"].append(payload["metrics"])
                 metric_val = payload["metrics"].get(self._cfg.metric)
@@ -166,15 +193,16 @@ class Tuner:
                     decision = scheduler.on_result(tid, seq,
                                                    float(metric_val))
                     if decision == STOP:
-                        _control("kv_put", f"tune/{run_id}/stop/{tid}",
-                                 b"1")
+                        _control("kv_put",
+                                 f"tune/{run_id}/stop/{kv_tid}", b"1")
 
         while queue or in_flight:
             while queue and len(in_flight) < self._cfg.max_concurrent_trials:
                 tid = queue.pop(0)
                 ref = run_remote.options(
                     name=f"trial-{tid}").remote(
-                        fn_blob, trials[tid]["config"], run_id, tid)
+                        fn_blob, trials[tid]["config"], run_id,
+                        trials[tid]["kv_tid"], trials[tid]["ckpt_blob"])
                 trials[tid]["ref"] = ref
                 in_flight[ref] = tid
             done, _ = ray_tpu.wait(list(in_flight.keys()), num_returns=1,
@@ -192,9 +220,34 @@ class Tuner:
                     stopped = out["stopped"]
                 except Exception as e:  # noqa: BLE001
                     error = repr(e)
+                # PBT exploit: the stop was a pause — relaunch the trial
+                # with the mutated config seeded from a top performer's
+                # checkpoint (reference: pbt.py exploit/explore cycle).
+                restart = None
+                if hasattr(scheduler, "take_restart"):
+                    # Always drain the directive: a STOP landing on the
+                    # trial's final report leaves one behind, which must
+                    # not leak (the trial completed anyway).
+                    restart = scheduler.take_restart(tid)
+                _control("kv_del", f"tune/{run_id}/stop/{t['kv_tid']}")
+                if stopped and restart is not None and t["restarts"] < 16:
+                    new_config, source = restart
+                    t["config"] = new_config
+                    t["restarts"] += 1
+                    t["kv_tid"] = f"{tid}.g{t['restarts']}"
+                    src_kv = trials[source]["kv_tid"] \
+                        if source in trials else source
+                    t["ckpt_blob"] = _control(
+                        "kv_get", f"tune/{run_id}/ckpt/{src_kv}") or \
+                        _control("kv_get", f"tune/{run_id}/ckpt/{source}")
+                    if hasattr(scheduler, "register_trial"):
+                        scheduler.register_trial(tid, new_config)
+                    queue.append(tid)
+                    continue
                 last = t["history"][-1] if t["history"] else {}
                 metrics = {**last, **final}
-                results.append(TrialResult(tid, t["config"], metrics,
-                                           error, stopped, t["history"]))
+                results.append(TrialResult(
+                    tid, t["config"], metrics, error, stopped,
+                    t["history"], restarts=t["restarts"]))
         poll_reports()
         return ResultGrid(results, self._cfg.metric, self._cfg.mode)
