@@ -65,8 +65,19 @@ class TestKVBackend:
 
     def test_p2p(self, ray_start):
         workers = [KVCollectiveWorker.remote(r, 2) for r in range(2)]
-        ray_tpu.get([w.setup.remote("g2") for w in workers], timeout=60)
-        out = ray_tpu.get([w.p2p.remote("g2") for w in workers], timeout=60)
+        try:
+            ray_tpu.get([w.setup.remote("g2") for w in workers], timeout=120)
+            out = ray_tpu.get([w.p2p.remote("g2") for w in workers],
+                              timeout=120)
+        except Exception:
+            # Rare full-suite-only flake under investigation: dump the
+            # control-plane state so the next occurrence is actionable.
+            rt = ray_start
+            print("DIAG actors:", rt.ctl_list_actors())
+            print("DIAG kv:", rt.ctl_kv_keys("collective/"))
+            print("DIAG tasks:", rt.ctl_summarize_tasks())
+            print("DIAG pending:", rt.scheduler.num_pending())
+            raise
         np.testing.assert_allclose(out[1], [42.0])
 
 

@@ -122,11 +122,17 @@ class TestPBT:
         from ray_tpu.tune import PopulationBasedTraining, get_checkpoint
 
         def trainable(config):
+            import time as _t
+
             ck = get_checkpoint()
             score = ck["score"] if ck else 0.0
             lr = config["lr"]
-            for step in range(1, 13):
-                # Good lr improves the score faster.
+            for step in range(1, 33):
+                # Paced so the tuner's report polling (and the PBT stop
+                # flags it writes) interleave with the trial's steps; many
+                # perturbation windows make the exploit statistically
+                # certain even when individual windows race the poll loop.
+                _t.sleep(0.12)
                 score += 1.0 if abs(lr - 0.1) < 0.05 else 0.1
                 tune.report({"score": score},
                             checkpoint={"score": score, "lr": lr})
@@ -144,7 +150,7 @@ class TestPBT:
         grid = tuner.fit()
         assert len(grid) == 4
         best = grid.get_best_result()
-        assert best.metrics["score"] > 10.0
+        assert best.metrics["score"] > 25.0
         # The exploit path actually ran: some trial was relaunched from a
         # checkpoint with a mutated config.
         assert any(r.restarts > 0 for r in grid)
