@@ -35,7 +35,8 @@ from ._private.api import (ActorClass, ActorHandle, ActorMethod, ObjectRef,
                            get_actor, kill, nodes, placement_group, put,
                            remote, remove_placement_group, wait)
 from ._private.exceptions import (ActorError, GetTimeoutError, ObjectLostError,
-                                  RayTpuError, TaskError, WorkerCrashedError)
+                                  OutOfMemoryError, RayTpuError, TaskError,
+                                  WorkerCrashedError)
 from ._private.scheduler import (NodeAffinitySchedulingStrategy,
                                  PlacementGroupSchedulingStrategy)
 
@@ -49,6 +50,7 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[int] = None,
          namespace: str = "default", ignore_reinit_error: bool = True,
          head_port: Optional[int] = None,
          cluster_token: Optional[bytes] = None,
+         address: Optional[str] = None,
          **_compat: Any):
     """Start the ray_tpu runtime in this process (driver).
 
@@ -60,8 +62,23 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[int] = None,
     nodes can register via ``ray-tpu start --address=<host:port>``
     (reference: ray start joining a GCS).  The bound address is
     ``runtime.head_server.address``.
+
+    ``address="host:port"`` connects as a remote driver instead of starting
+    a runtime (reference: ray.init("ray://...") via python/ray/util/client):
+    API calls are proxied to the running head.  ``cluster_token`` must match
+    the head's.
     """
     with _init_lock:
+        if address is not None:
+            from ._private import client as _client_mod
+            from ._private import cluster as _cluster_mod
+            existing = _runtime_mod.current_runtime()
+            if existing is not None:
+                if ignore_reinit_error:
+                    return existing
+                raise RuntimeError("ray_tpu.init() already called")
+            return _client_mod.connect(
+                address, cluster_token or _cluster_mod.DEFAULT_TOKEN)
         if _runtime_mod.driver_runtime() is not None:
             if ignore_reinit_error:
                 return _runtime_mod.driver_runtime()
@@ -83,6 +100,10 @@ def timeline(filename: Optional[str] = None) -> str:
 
 
 def shutdown() -> None:
+    from ._private.client import ClientRuntime, disconnect as _client_disconnect
+    if isinstance(_runtime_mod.current_runtime(), ClientRuntime):
+        _client_disconnect()
+        return
     rt = _runtime_mod.driver_runtime()
     if rt is not None:
         rt.shutdown()
@@ -115,5 +136,6 @@ __all__ = [
     "ActorMethod", "RemoteFunction",
     "NodeAffinitySchedulingStrategy", "PlacementGroupSchedulingStrategy",
     "RayTpuError", "TaskError", "ActorError", "WorkerCrashedError",
+    "OutOfMemoryError",
     "ObjectLostError", "GetTimeoutError",
 ]

@@ -1,0 +1,193 @@
+"""Remote-driver client: drive a running cluster from another process.
+
+Reference analog: python/ray/util/client/ (the "Ray Client" — a gRPC proxy
+that lets `ray.init("ray://host:port")` run driver code against a remote
+cluster).  Here the client speaks the same dataclass protocol as workers
+(protocol.py) over the head's TCP join point, authenticated by the cluster
+token; the head runs a ClientProxy (cluster.py) that executes each call
+against the driver Runtime and materializes get-results into raw payloads
+(clients have no shared-memory store).
+
+Usage:
+    ray_tpu.init(address="host:port", cluster_token=...)
+    # then the normal API: remote/get/put/wait/actors/placement groups.
+
+Limitations (mirroring the reference client's): ObjectRefGenerator
+iteration (streaming tasks) is driver-side only, and client-held refs are
+not reference-counted — objects created through a client session are freed
+when the session's job exits or via explicit ray_tpu.free().
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+from typing import Any, Dict, List, Optional
+
+from multiprocessing.connection import Client as _TcpClient
+
+from . import serialization
+from .config import Config
+from .exceptions import GetTimeoutError, RayTpuError
+from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from .protocol import (GetReply, GetRequest, PutFromWorker, RpcCall,
+                       RpcReply, SubmitFromWorker, WaitReply, WaitRequest)
+
+
+class ClientRuntime:
+    """Runtime facade for a remote driver process.
+
+    Implements the same surface WorkerRuntime exposes to the public API
+    (submit/get/put/wait/control), carried over the head's client channel.
+    """
+
+    is_client = True
+
+    def __init__(self, address, token: bytes):
+        from .cluster import ClientAck, RegisterClient
+        if isinstance(address, str):
+            host, port = address.rsplit(":", 1)
+            address = (host, int(port))
+        self.conn = _TcpClient(tuple(address), authkey=token)
+        self.conn.send(RegisterClient(socket.gethostname()))
+        ack = self.conn.recv()
+        if not isinstance(ack, ClientAck):
+            raise RayTpuError(f"unexpected client handshake reply: {ack!r}")
+        Config.initialize(json.loads(ack.config_blob))
+        self.job_id = JobID(ack.job_id_bytes)
+        self.worker_id = WorkerID(ack.client_id_bytes)
+        # Put-object IDs must be unique per client session (many clients
+        # share one head job): derive them from a session-unique task id,
+        # not the deterministic driver task id.
+        self._put_task_id = TaskID.from_random()
+        self.current_task_id: Optional[TaskID] = None
+        self.current_actor_id: Optional[ActorID] = None
+        self._send_lock = threading.Lock()
+        self._req_lock = threading.Lock()
+        self._next_req = 0
+        self._pending: Dict[int, queue.Queue] = {}
+        self._obj_index_lock = threading.Lock()
+        # Client puts live above both return indices and head driver puts.
+        self._obj_index = 1 << 21
+        self._closed = False
+        self._reader = threading.Thread(target=self._reader_loop,
+                                        name="client-reader", daemon=True)
+        self._reader.start()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def send(self, msg) -> None:
+        if self._closed:
+            raise RayTpuError("client session is disconnected")
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def _reader_loop(self) -> None:
+        while True:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                break
+            if isinstance(msg, (GetReply, WaitReply, RpcReply)):
+                with self._req_lock:
+                    q = self._pending.get(msg.request_id)
+                if q is not None:
+                    q.put(msg)
+        self._closed = True
+        # Wake every waiter so blocked gets fail fast instead of hanging.
+        with self._req_lock:
+            for q in self._pending.values():
+                q.put(None)
+
+    def _call(self, make_msg):
+        with self._req_lock:
+            self._next_req += 1
+            rid = self._next_req
+            q: queue.Queue = queue.Queue()
+            self._pending[rid] = q
+        try:
+            self.send(make_msg(rid))
+            reply = q.get()
+        finally:
+            with self._req_lock:
+                self._pending.pop(rid, None)
+        if reply is None:
+            raise RayTpuError("client connection to the head was lost")
+        return reply
+
+    # -- API surface --------------------------------------------------------
+
+    def submit_spec(self, spec) -> None:
+        self.send(SubmitFromWorker(spec))
+
+    def get(self, object_ids: List[ObjectID],
+            timeout: Optional[float] = None) -> List[Any]:
+        reply: GetReply = self._call(
+            lambda rid: GetRequest(rid, self.worker_id, object_ids, timeout))
+        if reply.timed_out:
+            raise GetTimeoutError(f"get timed out on {object_ids}")
+        values = []
+        for d in reply.values:
+            if d[0] == "inline":
+                values.append(serialization.unpack_payload(d[1]))
+            elif d[0] == "err":
+                raise serialization.unpack_payload(d[1])
+            else:
+                raise RayTpuError(f"unexpected client get descriptor {d!r}")
+        return values
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int,
+             timeout: Optional[float], fetch_local: bool = True):
+        reply: WaitReply = self._call(
+            lambda rid: WaitRequest(rid, self.worker_id, object_ids,
+                                    num_returns, timeout, fetch_local))
+        ready_set = set(reply.ready)
+        ready = [o for o in object_ids if o in ready_set]
+        not_ready = [o for o in object_ids if o not in ready_set]
+        return ready, not_ready
+
+    def put(self, value: Any) -> ObjectID:
+        with self._obj_index_lock:
+            self._obj_index += 1
+            idx = self._obj_index
+        object_id = ObjectID.of(self._put_task_id, idx)
+        meta, buffers = serialization.serialize_payload(value)
+        nbytes = serialization.payload_nbytes(meta, buffers)
+        buf = bytearray(nbytes)
+        serialization.write_payload_into(memoryview(buf), meta, buffers)
+        # Always inline on the wire; the head promotes large payloads into
+        # its store (HeadServer._promote_client_put).
+        self.send(PutFromWorker(object_id, ("inline", bytes(buf))))
+        return object_id
+
+    def control(self, method: str, *args, **kwargs):
+        reply: RpcReply = self._call(
+            lambda rid: RpcCall(rid, self.worker_id, method, args, kwargs))
+        if reply.error is not None:
+            raise RuntimeError(reply.error)
+        return reply.value
+
+    def disconnect(self) -> None:
+        self._closed = True
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def connect(address, token: bytes) -> ClientRuntime:
+    """Open a client session and install it as the process's runtime."""
+    from . import runtime as _rtmod
+    rt = ClientRuntime(address, token)
+    _rtmod.set_worker_runtime(rt)
+    return rt
+
+
+def disconnect() -> None:
+    from . import runtime as _rtmod
+    rt = _rtmod.current_runtime()
+    if isinstance(rt, ClientRuntime):
+        rt.disconnect()
+        _rtmod.set_worker_runtime(None)

@@ -62,6 +62,22 @@ class RegisterNode:
 
 
 @dataclass
+class RegisterClient:
+    """Remote-driver handshake (reference: python/ray/util/client/ —
+    ray client connecting to the cluster's client server)."""
+    hostname: str
+    os_pid: int = 0
+
+
+@dataclass
+class ClientAck:
+    client_id_bytes: bytes
+    job_id_bytes: bytes
+    config_blob: str
+    head_node_id_bytes: bytes
+
+
+@dataclass
 class RegisterAck:
     node_id_bytes: bytes
     job_id_bytes: bytes
@@ -145,6 +161,17 @@ class UpWorkerDied:
     worker_id: WorkerID
     running: List[TaskID]
     actor_id: Optional[ActorID]
+    reason: str = ""
+
+
+@dataclass
+class UpSyncView:
+    """Node -> head versioned resource/load view (reference:
+    src/ray/ray_syncer/ray_syncer.h:91 — ResourceViewSyncMessage broadcast;
+    sent only when the view changes, with a monotonically increasing
+    version so stale messages are dropped on receipt)."""
+    version: int
+    view: Dict[str, Any]
 
 
 @dataclass
@@ -256,7 +283,7 @@ class DataServer:
         try:
             while True:
                 desc = conn.recv()
-                payload = self._read(desc)
+                payload = read_raw_payload(self._store, desc)
                 conn.send(payload)  # None = gone
         except (EOFError, OSError):
             pass
@@ -266,31 +293,33 @@ class DataServer:
             except Exception:
                 pass
 
-    def _read(self, desc) -> Optional[bytes]:
-        try:
-            if desc[0] == "shma":
-                return self._store.read_raw_by_key(desc[4])
-            if desc[0] == "shm":
-                # Per-object segment (Python store or worker-written):
-                # readable by name from any process on this host.
-                from .object_store import _open_untracked
-                seg = _open_untracked(desc[1], create=False)
-                try:
-                    return bytes(seg.buf[: desc[2]])
-                finally:
-                    seg.close()
-        except FileNotFoundError:
-            return None
-        except Exception:
-            return None
-        return None
-
     def shutdown(self) -> None:
         self._closed = True
         try:
             self._listener.close()
         except Exception:
             pass
+
+
+def read_raw_payload(store, desc) -> Optional[bytes]:
+    """Raw serialized payload bytes of a store-resident descriptor (the
+    push side of object transfer, and the materialization path for
+    store-less remote clients)."""
+    try:
+        if desc[0] == "shma":
+            return store.read_raw_by_key(desc[4])
+        if desc[0] == "shm":
+            # Per-object segment (Python store or worker-written):
+            # readable by name from any process on this host.
+            from .object_store import _open_untracked
+            seg = _open_untracked(desc[1], create=False)
+            try:
+                return bytes(seg.buf[: desc[2]])
+            finally:
+                seg.close()
+    except Exception:
+        return None
+    return None
 
 
 class DataClient:
@@ -446,6 +475,44 @@ class RemoteNodeProxy:
         self.send(NodeShutdown())
 
 
+class ClientProxy:
+    """Head-side endpoint for a remote driver (reference:
+    python/ray/util/client/server — the ray-client proxy that executes
+    API calls against the cluster on the client's behalf).
+
+    Clients have no object store: get replies carry raw inline payloads
+    (materialized head-side from whichever node owns the object), and puts
+    arrive as inline payloads that the head promotes into its store when
+    large.  Everything else (submit/wait/kill/ctl) reuses the worker
+    protocol directly against the head Runtime."""
+
+    is_remote = False
+    is_client = True
+
+    def __init__(self, head: "HeadServer", conn, client_id: WorkerID):
+        self.head = head
+        self.conn = conn
+        self.client_id = client_id
+        self.store = head.runtime.node.store
+        self._send_lock = threading.Lock()
+        self.last_seen = time.monotonic()
+
+    def send(self, msg) -> None:
+        try:
+            with self._send_lock:
+                self.conn.send(msg)
+        except (BrokenPipeError, OSError):
+            pass
+
+    # on_get_request/on_wait_request reply through this NodeManager-shaped
+    # surface; the client is its own single "worker".
+    def send_to_worker(self, worker_id: WorkerID, msg) -> None:
+        self.send(msg)
+
+    def track_get_pins(self, worker_id, request_id, keys) -> None:
+        pass  # client replies are raw copies; nothing stays pinned
+
+
 class HeadServer:
     """TCP join point on the head: accepts NodeServer registrations, routes
     upstream runtime callbacks, detects node death (EOF + ping timeouts)."""
@@ -486,6 +553,9 @@ class HeadServer:
         except (EOFError, OSError):
             conn.close()
             return
+        if isinstance(msg, RegisterClient):
+            self._register_client(conn)
+            return
         if not isinstance(msg, RegisterNode):
             conn.close()
             return
@@ -507,6 +577,64 @@ class HeadServer:
         threading.Thread(target=self._reader_loop, args=(proxy,),
                          name=f"head-node-{node_id.hex()[:8]}",
                          daemon=True).start()
+
+    def _register_client(self, conn) -> None:
+        rt = self.runtime
+        client_id = WorkerID.from_random()
+        proxy = ClientProxy(self, conn, client_id)
+        proxy.send(ClientAck(client_id.binary(), rt.job_id.binary(),
+                             Config.blob(), rt.node_id.binary()))
+        threading.Thread(target=self._client_reader, args=(proxy,),
+                         name=f"head-client-{client_id.hex()[:8]}",
+                         daemon=True).start()
+
+    def _client_reader(self, proxy: ClientProxy) -> None:
+        rt = self.runtime
+        conn = proxy.conn
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            try:
+                self._handle_client(proxy, msg)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def _handle_client(self, proxy: ClientProxy, msg) -> None:
+        from .protocol import (GetRequest, PutFromWorker, RpcCall,
+                               SubmitFromWorker, WaitRequest)
+        rt = self.runtime
+        proxy.last_seen = time.monotonic()
+        if isinstance(msg, SubmitFromWorker):
+            rt.submit_spec(msg.spec)
+        elif isinstance(msg, GetRequest):
+            rt.on_get_request(proxy, msg)
+        elif isinstance(msg, WaitRequest):
+            rt.on_wait_request(proxy, msg)
+        elif isinstance(msg, PutFromWorker):
+            rt.on_put_from_worker(self._promote_client_put(msg))
+        elif isinstance(msg, RpcCall):
+            rt.on_rpc_call(proxy, msg)
+        elif isinstance(msg, Pong):
+            pass
+
+    def _promote_client_put(self, msg) -> Any:
+        """Large client puts ride the control pipe as inline payloads;
+        promote them into the head store so they live under normal store
+        accounting (spill/evict) instead of the directory."""
+        desc = msg.desc
+        if isinstance(desc, tuple) and desc and desc[0] == "inline" \
+                and len(desc[1]) > Config.get("max_inline_object_size"):
+            local = self.runtime.node.store.put_raw(msg.object_id, desc[1])
+            if local is not None:
+                msg.desc = local
+        return msg
 
     def _ping_loop(self) -> None:
         """Liveness probes (reference: gcs_health_check_manager.h:46): a
@@ -564,7 +692,10 @@ class HeadServer:
         elif isinstance(msg, UpNoteTaskRunning):
             rt.note_task_running(msg.task_id, nid, msg.worker_id)
         elif isinstance(msg, UpWorkerDied):
-            rt.on_worker_died(msg.worker_id, nid, msg.running, msg.actor_id)
+            rt.on_worker_died(msg.worker_id, nid, msg.running, msg.actor_id,
+                              reason=msg.reason)
+        elif isinstance(msg, UpSyncView):
+            rt.on_node_view(nid, msg.version, msg.view)
         elif isinstance(msg, UpDispatchFailed):
             rt.on_dispatch_failed(msg.spec, msg.reason,
                                   lost_object_bytes=msg.lost_object_bytes)
@@ -669,8 +800,10 @@ class _NodeServerRuntime:
         self._server.send_up(UpDispatchFailed(spec, reason,
                                               lost_object_bytes))
 
-    def on_worker_died(self, worker_id, node_id, running, actor_id) -> None:
-        self._server.send_up(UpWorkerDied(worker_id, running, actor_id))
+    def on_worker_died(self, worker_id, node_id, running, actor_id,
+                       reason: str = "") -> None:
+        self._server.send_up(UpWorkerDied(worker_id, running, actor_id,
+                                          reason))
 
     def bind_actor_worker(self, actor_id, node_id, worker_id) -> None:
         self._server.send_up(UpBindActor(actor_id, worker_id))
@@ -776,6 +909,28 @@ class NodeServer:
         # Second message completes the handshake with the real data address.
         self.send_up(RegisterNode(socket.gethostname(), node_resources,
                                   int(num_tpus or 0), self.data_address))
+        threading.Thread(target=self._syncer_loop, name="node-syncer",
+                         daemon=True).start()
+
+    def _syncer_loop(self) -> None:
+        """Versioned resource-view reporter (reference: ray_syncer.h:91
+        ReporterInterface — a snapshot is broadcast only when it differs
+        from the last sent one; the version lets the head drop reordered
+        updates)."""
+        period = float(Config.get("syncer_period_s"))
+        version = 0
+        last_view: Optional[Dict[str, Any]] = None
+        while not self._closed:
+            time.sleep(period)
+            try:
+                view = self.node.local_view()
+            except Exception:  # noqa: BLE001
+                continue
+            if view == last_view:
+                continue
+            last_view = view
+            version += 1
+            self.send_up(UpSyncView(version, view))
 
     def _resolve_address(self, node_id_bytes: bytes):
         if node_id_bytes == self.head_node_id_bytes:

@@ -186,6 +186,28 @@ D("task_events_max_num_task_in_gcs", int, 10000,
   "Bounded task-event history size (reference: ray_config_def.h "
   "task_events_max_num_task_in_gcs).")
 
+# --- Syncer ----------------------------------------------------------------
+D("syncer_period_s", float, 1.0,
+  "Node resource-view sampling period; views are sent to the head only "
+  "when changed (reference: ray_syncer.h versioned broadcast).")
+
+# --- Memory monitor / OOM killing ------------------------------------------
+# 0 disables the monitor (the reference defaults to 250ms-on; here the
+# default is off so shared CI hosts under external memory pressure don't
+# nondeterministically kill test workers — production nodes enable it).
+D("memory_monitor_refresh_ms", int, 0,
+  "Memory monitor poll period; 0 disables (reference: ray_config_def.h "
+  "memory_monitor_refresh_ms).")
+D("memory_usage_threshold", float, 0.95,
+  "Node memory usage fraction above which a worker is OOM-killed "
+  "(reference: memory_usage_threshold).")
+D("memory_monitor_kill_interval_s", float, 2.0,
+  "Minimum time between successive OOM kills (reference: "
+  "min_memory_free_bytes backoff semantics).")
+D("memory_monitor_test_fraction", float, 0.0,
+  "Testing hook: fake observed memory usage fraction (>0 overrides real "
+  "sampling so OOM paths are deterministically testable).")
+
 # --- Logging ---------------------------------------------------------------
 D("log_level", str, "INFO", "Runtime log level.")
 D("session_dir", str, "", "Session directory (empty = /tmp/ray_tpu/session_*).")

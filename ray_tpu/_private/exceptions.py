@@ -48,6 +48,12 @@ class WorkerCrashedError(RayTpuError):
     """The worker process died mid-task (reference: WorkerCrashedError)."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """Worker was OOM-killed by the node memory monitor (reference:
+    ray.exceptions.OutOfMemoryError raised by the raylet's worker-killing
+    policy, src/ray/raylet/worker_killing_policy*.h)."""
+
+
 class ObjectLostError(RayTpuError):
     """Object value was lost from the cluster (reference: ObjectLostError).
 

@@ -57,7 +57,10 @@ class WorkerHandle:
     # are pooled per env signature instead.
     dedicated: bool = False
     env_key: str = ""
+    death_reason: str = ""
     running: Set[TaskID] = field(default_factory=set)
+    # task_id -> (start_monotonic, retriable) for the OOM kill policy.
+    task_meta: Dict[TaskID, Any] = field(default_factory=dict)
     reader: Optional[threading.Thread] = None
     ready: threading.Event = field(default_factory=threading.Event)
     send_lock: threading.Lock = field(default_factory=threading.Lock)
@@ -104,6 +107,11 @@ class NodeManager:
                 if "=" in part:
                     m, p = part.split("=")
                     self._drop_probs[m.strip()] = float(p)
+        # OOM protection (reference: raylet MemoryMonitor + worker-killing
+        # policy); no-op unless memory_monitor_refresh_ms > 0.
+        from .memory_monitor import MemoryMonitor
+        self.memory_monitor = MemoryMonitor(self)
+        self.memory_monitor.start()
 
     # -- worker lifecycle ---------------------------------------------------
 
@@ -302,6 +310,10 @@ class NodeManager:
             if not ok:
                 return
         handle.running.add(spec.task_id)
+        handle.task_meta[spec.task_id] = (
+            time.monotonic(),
+            spec.create_actor_id is None and spec.actor_id is None
+            and spec.retry_count < spec.max_retries)
         self.runtime.note_task_running(spec.task_id, self.info.node_id,
                                        handle.worker_id)
         self._send(handle, RunTask(spec, resolved_args, resolved_kwargs))
@@ -423,6 +435,7 @@ class NodeManager:
             handle.ready.set()
         elif isinstance(msg, TaskDone):
             handle.running.discard(msg.task_id)
+            handle.task_meta.pop(msg.task_id, None)
             if self._native_store:
                 keys = handle.arg_pins.pop(msg.task_id, [])
                 if keys:
@@ -522,7 +535,50 @@ class NodeManager:
                 except KeyError:
                     pass
         self.runtime.on_worker_died(handle.worker_id, self.info.node_id,
-                                    running, handle.actor_id)
+                                    running, handle.actor_id,
+                                    reason=handle.death_reason)
+
+    # -- OOM killing (reference: worker_killing_policy_retriable_fifo) ------
+
+    def select_oom_victim(self) -> Optional[WorkerHandle]:
+        """Pick the worker to sacrifice under memory pressure.
+
+        Idle pooled workers first (killing them fails nothing), then busy
+        workers via the retriable-LIFO policy in memory_monitor.select_victim.
+        Actor workers count as non-retriable here — the node can't see how
+        many restarts the actor has left, so they're protected last.
+        """
+        from .memory_monitor import select_victim
+        with self._lock:
+            for bucket in self._idle.values():
+                for wid in bucket:
+                    h = self._workers.get(wid)
+                    if h is not None and h.state == IDLE:
+                        return h
+            candidates = []
+            for h in self._workers.values():
+                if h.state != BUSY or not h.running:
+                    continue
+                metas = [h.task_meta.get(t) for t in h.running]
+                metas = [m for m in metas if m is not None]
+                if not metas:
+                    continue
+                retriable = all(m[1] for m in metas) and h.actor_id is None
+                earliest = min(m[0] for m in metas)
+                candidates.append((h, retriable, earliest))
+        return select_victim(candidates)
+
+    def oom_kill_worker(self, handle: WorkerHandle, reason: str) -> None:
+        handle.death_reason = f"OOM-killed: {reason}"
+        with self._lock:
+            bucket = self._idle.get(handle.env_key)
+            if bucket and handle.worker_id in bucket:
+                bucket.remove(handle.worker_id)
+        try:
+            if handle.proc.poll() is None:
+                handle.proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
 
     # -- misc ---------------------------------------------------------------
 
@@ -544,6 +600,32 @@ class NodeManager:
         with self._lock:
             return len(self._workers)
 
+    def local_view(self) -> Dict[str, Any]:
+        """Load/resource snapshot for the syncer (reference:
+        ResourceViewSyncMessage contents — resources + load by node)."""
+        with self._lock:
+            n_workers = len(self._workers)
+            n_idle = sum(len(b) for b in self._idle.values())
+            n_running = sum(len(h.running) for h in self._workers.values())
+            free_chips = len(self._chip_pool)
+        view: Dict[str, Any] = {
+            "workers": n_workers,
+            "idle_workers": n_idle,
+            "running_tasks": n_running,
+            "free_tpu_chips": free_chips,
+        }
+        try:
+            snap = self.memory_monitor.snapshot()
+            view["memory_used_bytes"] = snap.used_bytes
+            view["memory_total_bytes"] = snap.total_bytes
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            view["store_bytes_used"] = int(self.store.stats()["used_bytes"])
+        except Exception:  # noqa: BLE001
+            pass
+        return view
+
     def prestart_workers(self, n: int) -> None:
         for _ in range(n):
             h = self._spawn_worker()
@@ -552,6 +634,7 @@ class NodeManager:
 
     def shutdown(self) -> None:
         self._closed = True
+        self.memory_monitor.stop()
         try:
             self._listener.close()
         except Exception:

@@ -33,7 +33,7 @@ from .controller import (ALIVE, DEAD, PENDING_CREATION, RESTARTING,
                          ActorInfo, Controller, JobInfo, NodeInfo,
                          PlacementGroupInfo)
 from .exceptions import (ActorError, GetTimeoutError, ObjectLostError,
-                         TaskError, WorkerCrashedError)
+                         OutOfMemoryError, TaskError, WorkerCrashedError)
 from .ids import (ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID,
                   WorkerID)
 from .node import NodeManager
@@ -229,6 +229,9 @@ class Runtime:
 
         self._running: Dict[TaskID, _RunningTask] = {}
         self._running_lock = threading.Lock()
+        # Syncer receiver state: node -> (version, view, recv_time).
+        self._node_views: Dict[NodeID, tuple] = {}
+        self._node_views_lock = threading.Lock()
         self._actors: Dict[ActorID, _ActorRuntimeState] = {}
         self._actors_lock = threading.Lock()
         self._put_index = 0
@@ -1111,7 +1114,8 @@ class Runtime:
 
     def on_worker_died(self, worker_id: WorkerID, node_id: NodeID,
                        running_tasks: List[TaskID],
-                       actor_id: Optional[ActorID]) -> None:
+                       actor_id: Optional[ActorID],
+                       reason: str = "") -> None:
         if self._shutdown:
             return
         specs: List[TaskSpec] = []
@@ -1120,6 +1124,7 @@ class Runtime:
                 rt = self._running.pop(tid, None)
                 if rt is not None:
                     specs.append(rt.spec)
+        oom = reason.startswith("OOM-killed")
         for spec in specs:
             if spec.create_actor_id is None and (
                     not spec.resources.is_empty()
@@ -1132,10 +1137,14 @@ class Runtime:
                 self.submit_spec(spec)
             elif spec.actor_id is not None:
                 self._fail_task(spec, ActorError(
-                    spec.actor_id, f"worker died while running {spec.name}"))
+                    spec.actor_id,
+                    f"worker died while running {spec.name}"
+                    + (f" ({reason})" if reason else "")))
             elif spec.create_actor_id is None:
-                self._fail_task(spec, WorkerCrashedError(
-                    f"worker {worker_id} died while running {spec.name}"))
+                err_cls = OutOfMemoryError if oom else WorkerCrashedError
+                self._fail_task(spec, err_cls(
+                    f"worker {worker_id} died while running {spec.name}"
+                    + (f" ({reason})" if reason else "")))
         if actor_id is not None:
             self._on_actor_worker_death(actor_id, node_id)
 
@@ -1187,6 +1196,8 @@ class Runtime:
         if self._shutdown:
             return
         self.nodes.pop(node_id, None)
+        with self._node_views_lock:
+            self._node_views.pop(node_id, None)
         self.controller.mark_node_dead(node_id, "connection lost")
         self.scheduler.remove_node(node_id)
 
@@ -1255,6 +1266,7 @@ class Runtime:
         lock = threading.Lock()
         replied = {"done": False}
         is_remote = getattr(node, "is_remote", False)
+        is_client = getattr(node, "is_client", False)
 
         def finish(timed_out: bool):
             with lock:
@@ -1296,6 +1308,18 @@ class Runtime:
                         "err", serialization.pack_payload(ObjectLostError(
                             "remote object without a cluster data plane",
                             object_id_bytes=oid.binary())))
+                if is_client:
+                    # Store-less remote driver: materialize to a raw inline
+                    # payload (shm offsets mean nothing across the wire).
+                    if isinstance(d, tuple) and d and d[0] in ("shm", "shma"):
+                        from .cluster import read_raw_payload
+                        raw = read_raw_payload(node.store, d)
+                        d = ("inline", raw) if raw is not None else (
+                            "err", serialization.pack_payload(ObjectLostError(
+                                "object was evicted or freed",
+                                object_id_bytes=oid.binary())))
+                    values.append(d)
+                    continue
                 if isinstance(d, tuple) and d and d[0] == "shma":
                     # Refresh + pin so the offset stays valid until the
                     # worker's ReadDone (plasma client-pin semantics).
@@ -1448,6 +1472,30 @@ class Runtime:
                  "resources": n.total_resources.to_dict(),
                  "is_head": n.is_head}
                 for n in self.controller.nodes.values()]
+
+    # -- syncer (reference: src/ray/ray_syncer/ray_syncer.h:91) -------------
+
+    def on_node_view(self, node_id: NodeID, version: int, view: dict) -> None:
+        """Receive a versioned resource view; stale versions are dropped
+        (reference: ray_syncer receiver version check)."""
+        with self._node_views_lock:
+            cur = self._node_views.get(node_id)
+            if cur is not None and cur[0] >= version:
+                return
+            self._node_views[node_id] = (version, view, time.time())
+
+    def ctl_node_views(self):
+        """Latest per-node load views; the head's own node is sampled live
+        (it needs no sync channel)."""
+        out = {}
+        with self._node_views_lock:
+            for nid, (version, view, ts) in self._node_views.items():
+                out[nid.hex()] = dict(view, _version=version, _ts=ts)
+        local = self.nodes.get(self.node_id)
+        if local is not None and not getattr(local, "is_remote", False):
+            out[self.node_id.hex()] = dict(local.local_view(),
+                                           _version=-1, _ts=time.time())
+        return out
 
     def ctl_list_actors(self):
         return [{"actor_id": a.actor_id.hex(), "state": a.state,

@@ -17,9 +17,11 @@ from .api import (Application, Deployment, DeploymentHandle, deployment,
 from .batching import batch
 from .controller import AutoscalingConfig
 from .long_poll import LongPollBroker
+from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment", "run", "shutdown", "status", "Deployment", "Application",
     "DeploymentHandle", "get_deployment_handle", "batch",
     "AutoscalingConfig", "LongPollBroker",
+    "multiplexed", "get_multiplexed_model_id",
 ]

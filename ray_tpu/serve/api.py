@@ -86,16 +86,24 @@ class _ReplicaActor:
         else:
             self._callable = target
 
-    def handle_request(self, method: str, args, kwargs):
-        fn = (self._callable if method == "__call__"
-              and not hasattr(self._callable, "__call__.__self__")
-              else None)
+    def handle_request(self, method: str, args, kwargs,
+                       multiplexed_model_id: Optional[str] = None):
         target = getattr(self._callable, method, None)
         if target is None and method == "__call__":
             target = self._callable
         if target is None:
             raise AttributeError(f"deployment has no method {method!r}")
-        return target(*args, **kwargs)
+        if multiplexed_model_id is None:
+            return target(*args, **kwargs)
+        # Multiplexed request: expose the model id for the duration of the
+        # call (reference: serve.get_multiplexed_model_id()).
+        from .multiplex import _set_current_model_id
+        token = _set_current_model_id(multiplexed_model_id)
+        try:
+            return target(*args, **kwargs)
+        finally:
+            from .multiplex import _current_model_id
+            _current_model_id.reset(token)
 
     def ping(self):
         return "ok"
@@ -116,6 +124,18 @@ class _DeploymentState:
         ac = dep.autoscaling_config
         self.target_replicas = max(dep.num_replicas, ac.min_replicas) \
             if ac is not None else dep.num_replicas
+        from .multiplex import RouterAffinity, _MultiplexedDescriptor
+        # Mirror the replica LRU size so the router stops preferring a
+        # replica once it would have evicted the model (avoids reload
+        # thrash pinning all hot models to one replica).
+        cap = None
+        target = dep.cls_or_fn
+        if isinstance(target, type):
+            for attr in vars(target).values():
+                if isinstance(attr, _MultiplexedDescriptor):
+                    cap = attr._max
+                    break
+        self.affinity = RouterAffinity(cap if cap is not None else 8)
         self._lock = threading.Lock()
         self._opts: Optional[Dict[str, Any]] = None
         self._cls_blob: Optional[bytes] = None
@@ -169,6 +189,7 @@ class _DeploymentState:
                           id(self.replicas[i]), 0))
             r = self.replicas.pop(idx)
             self.inflight.pop(id(r), None)
+            self.affinity.drop_replica(id(r))
         try:
             ray_tpu.kill(r)
         except Exception:
@@ -180,13 +201,21 @@ class _DeploymentState:
                 for _ in range(self.target_replicas)]
         ray_tpu.get(refs, timeout=120)
 
-    def pick_replica(self):
+    def pick_replica(self, multiplexed_model_id: Optional[str] = None):
         """Power-of-two-choices on in-flight counts (reference:
-        pow_2_router.py).  Returns a replica handle."""
+        pow_2_router.py), preferring model-affine replicas for multiplexed
+        requests (reference: multiplex-aware request router)."""
         with self._lock:
             n = len(self.replicas)
             if n == 0:
                 return None
+            if multiplexed_model_id is not None and n > 1:
+                affine = set(self.affinity.replicas_for(multiplexed_model_id))
+                if affine:
+                    cands = [r for r in self.replicas if id(r) in affine]
+                    if cands:
+                        return min(cands, key=lambda r:
+                                   self.inflight.get(id(r), 0))
             if n == 1:
                 return self.replicas[0]
             ia, ib = random.sample(range(n), 2)
@@ -210,17 +239,22 @@ class _DeploymentState:
 class DeploymentHandle:
     """reference: serve/handle.py:1041 — .remote() routes a request."""
 
-    def __init__(self, name: str, method: str = "__call__"):
+    def __init__(self, name: str, method: str = "__call__",
+                 multiplexed_model_id: Optional[str] = None):
         self._name = name
         self._method = method
+        self._model_id = multiplexed_model_id
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self._name, method_name)
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(self._name, method_name or self._method,
+                                multiplexed_model_id or self._model_id)
 
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
-        return DeploymentHandle(self._name, item)
+        return DeploymentHandle(self._name, item, self._model_id)
 
     def remote(self, *args, **kwargs):
         with _app_lock:
@@ -232,7 +266,7 @@ class DeploymentHandle:
         # request (reference: router retries against the long-poll set).
         deadline = time.monotonic() + 60
         while True:
-            replica = state.pick_replica()
+            replica = state.pick_replica(self._model_id)
             if replica is not None:
                 break
             if time.monotonic() > deadline:
@@ -242,7 +276,13 @@ class DeploymentHandle:
         with state._lock:
             state.inflight[id(replica)] = \
                 state.inflight.get(id(replica), 0) + 1
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+        if self._model_id is not None:
+            state.affinity.note(id(replica), self._model_id)
+            ref = replica.handle_request.remote(
+                self._method, args, kwargs,
+                multiplexed_model_id=self._model_id)
+        else:
+            ref = replica.handle_request.remote(self._method, args, kwargs)
 
         def _done():
             with state._lock:

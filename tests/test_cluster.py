@@ -62,6 +62,29 @@ class TestClusterBasics:
         # Cross-node arg (dispatch-side localization):
         assert ray_tpu.get(consume.remote(ref)) == float(arr.sum())
 
+    def test_syncer_node_views(self, cluster):
+        """Versioned resource-view sync (reference: ray_syncer.h:91):
+        remote nodes report load views; versions only move forward."""
+        rt = cluster.runtime
+        deadline = time.time() + 15
+        views = {}
+        while time.time() < deadline:
+            views = rt.ctl_node_views()
+            remote = {k: v for k, v in views.items() if v["_version"] >= 1}
+            if len(remote) >= 3:
+                break
+            time.sleep(0.2)
+        remote = {k: v for k, v in views.items() if v["_version"] >= 1}
+        assert len(remote) >= 3, f"missing node views: {views}"
+        for v in remote.values():
+            assert "workers" in v and "running_tasks" in v
+            assert v["memory_total_bytes"] > 0
+        # Stale versions are dropped on receipt.
+        nid = next(iter(rt._node_views))
+        cur_version = rt._node_views[nid][0]
+        rt.on_node_view(nid, cur_version - 1, {"stale": True})
+        assert "stale" not in rt._node_views[nid][1]
+
     def test_worker_nested_get_of_remote_object(self, cluster):
         @ray_tpu.remote(num_cpus=1)
         def make():

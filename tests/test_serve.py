@@ -94,6 +94,67 @@ class TestBatching:
         serve.shutdown()
 
 
+class TestMultiplex:
+    def test_lru_cache_and_eviction(self, ray_start):
+        @serve.deployment(num_replicas=1)
+        class MultiModel:
+            def __init__(self):
+                self.loads = []
+
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                self.loads.append(model_id)
+                return f"model:{model_id}"
+
+            def __call__(self, x):
+                model = self.get_model()
+                return {"model": model, "loads": list(self.loads),
+                        "resident": self.get_model.loaded_model_ids}
+
+        handle = serve.run(MultiModel.bind())
+
+        def ask(mid):
+            return ray_tpu.get(
+                handle.options(multiplexed_model_id=mid).remote(0),
+                timeout=60)
+
+        r1 = ask("m1")
+        assert r1["model"] == "model:m1" and r1["loads"] == ["m1"]
+        ask("m2")
+        r3 = ask("m1")          # cached — no reload
+        assert r3["loads"] == ["m1", "m2"]
+        r4 = ask("m3")          # evicts m2 (LRU)
+        assert r4["loads"] == ["m1", "m2", "m3"]
+        assert sorted(r4["resident"]) == ["m1", "m3"]
+        r5 = ask("m2")          # m2 was evicted: reloaded
+        assert r5["loads"] == ["m1", "m2", "m3", "m2"]
+        serve.shutdown()
+
+    def test_router_model_affinity(self, ray_start):
+        @serve.deployment(num_replicas=2)
+        class PidModel:
+            @serve.multiplexed(max_num_models_per_replica=4)
+            def get_model(self, model_id):
+                return model_id
+
+            def __call__(self, x):
+                import os
+                self.get_model()
+                return os.getpid()
+
+        handle = serve.run(PidModel.bind())
+        h = handle.options(multiplexed_model_id="alpha")
+        pids = [ray_tpu.get(h.remote(i), timeout=60) for i in range(6)]
+        # After the first request establishes affinity, every later
+        # request for the same model lands on the same replica.
+        assert len(set(pids[1:])) == 1
+        serve.shutdown()
+
+    def test_model_id_outside_request_is_none(self, ray_start):
+        from ray_tpu.serve import get_multiplexed_model_id
+        assert get_multiplexed_model_id() is None
+
+
 class TestServeControlPlane:
     """Reconciliation + autoscaling (reference:
     serve/_private/deployment_state.py:2795 reconcile loops,
