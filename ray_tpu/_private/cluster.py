@@ -598,12 +598,32 @@ class HeadServer:
                 break
             try:
                 self._handle_client(proxy, msg)
-            except Exception:
+            except Exception as e:  # noqa: BLE001
                 import traceback
                 traceback.print_exc()
+                # The client blocks on a reply keyed by request_id — a
+                # swallowed error would hang it forever, so always answer.
+                self._client_error_reply(proxy, msg, e)
         try:
             conn.close()
         except Exception:
+            pass
+
+    @staticmethod
+    def _client_error_reply(proxy: ClientProxy, msg, exc: Exception) -> None:
+        from . import serialization
+        from .protocol import (GetReply, GetRequest, RpcCall, RpcReply,
+                               WaitReply, WaitRequest)
+        try:
+            if isinstance(msg, GetRequest):
+                err = ("err", serialization.pack_payload(exc))
+                proxy.send(GetReply(msg.request_id,
+                                    [err] * len(msg.object_ids)))
+            elif isinstance(msg, WaitRequest):
+                proxy.send(WaitReply(msg.request_id, []))
+            elif isinstance(msg, RpcCall):
+                proxy.send(RpcReply(msg.request_id, None, repr(exc)))
+        except Exception:  # noqa: BLE001
             pass
 
     def _handle_client(self, proxy: ClientProxy, msg) -> None:

@@ -1356,8 +1356,11 @@ class Runtime:
 
     def on_wait_request(self, node: NodeManager, msg: WaitRequest) -> None:
         def run():
-            ready, _ = self.wait(msg.object_ids, msg.num_returns,
-                                 msg.timeout_s)
+            try:
+                ready, _ = self.wait(msg.object_ids, msg.num_returns,
+                                     msg.timeout_s)
+            except Exception:  # noqa: BLE001 — a lost reply hangs the caller
+                ready = []
             node.send_to_worker(msg.worker_id,
                                 WaitReply(msg.request_id, ready))
         threading.Thread(target=run, daemon=True).start()

@@ -19,18 +19,38 @@ Quick start::
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
+from .connectors import (ClipActions, Connector, ConnectorPipeline,
+                         FrameStack, LambdaConnector, MeanStdFilter)
 from .dqn import DQN, DQNConfig
-from .env import CartPole, Env, StatelessGuess, VectorEnv, make_env, register_env
+from .env import (CartPole, Env, Pendulum, StatelessGuess, TargetReach,
+                  VectorEnv, make_env, register_env)
 from .env_runner import EnvRunner, EnvRunnerGroup
+from .impala import IMPALA, IMPALAConfig, vtrace
 from .learner import JaxLearner, LearnerGroup
+from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
+                          MultiAgentPPOConfig, MultiGuess)
+from .offline import (BC, BCConfig, CQL, CQLConfig, MARWIL, MARWILConfig,
+                      OfflineData, collect_from_env, save_shard)
 from .ppo import PPO, PPOConfig, compute_gae
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
-from .rl_module import DiscretePolicyModule, QModule, RLModuleSpec
+from .rl_module import (ContinuousModuleSpec, DiscretePolicyModule,
+                        GaussianPolicyModule, QModule, RLModuleSpec,
+                        TwinQModule)
+from .sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
-    "Env", "CartPole", "StatelessGuess", "VectorEnv", "make_env",
+    "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "vtrace",
+    "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
+    "OfflineData", "collect_from_env", "save_shard",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
+    "MultiAgentPPOConfig", "MultiGuess",
+    "Connector", "ConnectorPipeline", "MeanStdFilter", "FrameStack",
+    "LambdaConnector", "ClipActions",
+    "Env", "CartPole", "StatelessGuess", "Pendulum", "TargetReach",
+    "VectorEnv", "make_env",
     "register_env", "EnvRunner", "EnvRunnerGroup", "JaxLearner",
     "LearnerGroup", "ReplayBuffer", "PrioritizedReplayBuffer",
-    "DiscretePolicyModule", "QModule", "RLModuleSpec", "compute_gae",
+    "DiscretePolicyModule", "GaussianPolicyModule", "TwinQModule",
+    "ContinuousModuleSpec", "QModule", "RLModuleSpec", "compute_gae",
 ]

@@ -29,6 +29,10 @@ class AlgorithmConfig:
         self.num_env_runners = 0
         self.num_envs_per_runner = 4
         self.rollout_fragment_length = 128
+        # Factory returning a list of env-to-module connectors (reference:
+        # AlgorithmConfig.env_runners(env_to_module_connector=...)); a
+        # factory (not an instance) so every runner gets its own state.
+        self.env_to_module_fn: Optional[Callable] = None
         self.num_learners = 0
         self.lr = 3e-4
         self.gamma = 0.99
@@ -45,7 +49,8 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector: Optional[Callable] = None
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -53,7 +58,20 @@ class AlgorithmConfig:
             self.num_envs_per_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_fn = env_to_module_connector
         return self
+
+    def build_env_to_module(self):
+        """Instantiate the connector pipeline (fresh state per runner)."""
+        if self.env_to_module_fn is None:
+            return None
+        from .connectors import ConnectorPipeline
+        made = self.env_to_module_fn()
+        if isinstance(made, ConnectorPipeline):
+            return made
+        return ConnectorPipeline(list(made) if isinstance(made, (list, tuple))
+                                 else [made])
 
     def learners(self, *, num_learners: Optional[int] = None
                  ) -> "AlgorithmConfig":
@@ -91,7 +109,10 @@ class AlgorithmConfig:
 
     def module_spec(self) -> RLModuleSpec:
         probe = make_env(self.env_spec)
-        return RLModuleSpec(probe.observation_dim, probe.num_actions,
+        obs_dim = probe.observation_dim
+        if self.env_to_module_fn is not None:
+            obs_dim *= self.build_env_to_module().output_dim_factor
+        return RLModuleSpec(obs_dim, probe.num_actions,
                             tuple(self.module_hidden))
 
     def build_algo(self) -> "Algorithm":
@@ -120,7 +141,9 @@ class Algorithm:
                 lambda: make_env(config.env_spec),
                 num_env_runners=config.num_env_runners,
                 num_envs_per_runner=config.num_envs_per_runner,
-                module_spec=config.module_spec(), seed=config.seed)
+                module_spec=config.module_spec(), seed=config.seed,
+                env_to_module_fn=config.env_to_module_fn
+                and config.build_env_to_module)
         self.setup(config)
 
     # -- subclass hooks ---------------------------------------------------- #

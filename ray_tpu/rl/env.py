@@ -20,15 +20,27 @@ class Env:
     ``reset(seed) -> (obs, info)``; ``step(action) -> (obs, reward,
     terminated, truncated, info)`` — the gymnasium 5-tuple convention the
     reference's EnvRunners consume.
+
+    Discrete envs set ``num_actions``; continuous envs set ``action_dim``
+    (+ ``action_low``/``action_high`` bounds) and take float vectors in
+    ``step``.
     """
 
     observation_dim: int
-    num_actions: int
+    num_actions: int = 0
+    # Continuous action space (None = discrete).
+    action_dim: Optional[int] = None
+    action_low: float = -1.0
+    action_high: float = 1.0
+
+    @property
+    def is_continuous(self) -> bool:
+        return self.action_dim is not None
 
     def reset(self, seed: Optional[int] = None) -> Tuple[np.ndarray, Dict]:
         raise NotImplementedError
 
-    def step(self, action: int
+    def step(self, action
              ) -> Tuple[np.ndarray, float, bool, bool, Dict]:
         raise NotImplementedError
 
@@ -103,9 +115,81 @@ class StatelessGuess(Env):
         return obs, reward, True, False, {}
 
 
+class Pendulum(Env):
+    """Classic underactuated pendulum swing-up (gymnasium Pendulum-v1
+    dynamics): obs [cos th, sin th, th_dot], torque in [-2, 2], reward
+    -(th^2 + 0.1 th_dot^2 + 0.001 a^2), 200-step episodes."""
+
+    observation_dim = 3
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, max_steps: int = 200):
+        self._rng = np.random.default_rng(0)
+        self.max_steps = max_steps
+        self._th = 0.0
+        self._thdot = 0.0
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array([np.cos(self._th), np.sin(self._th), self._thdot],
+                        np.float32)
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._thdot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        g, m, length, dt = 10.0, 1.0, 1.0, 0.05
+        u = float(np.clip(np.asarray(action).reshape(-1)[0], -2.0, 2.0))
+        th = ((self._th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th ** 2 + 0.1 * self._thdot ** 2 + 0.001 * u ** 2
+        thdot = self._thdot + (
+            3 * g / (2 * length) * np.sin(th)
+            + 3.0 / (m * length ** 2) * u) * dt
+        thdot = float(np.clip(thdot, -8.0, 8.0))
+        self._th = self._th + thdot * dt
+        self._thdot = thdot
+        self._t += 1
+        return self._obs(), -float(cost), False, self._t >= self.max_steps, {}
+
+
+class TargetReach(Env):
+    """One-step continuous env for fast learning tests: obs is a target in
+    [-0.8, 0.8]; reward is -(action - target)^2.  An optimal policy earns
+    ~0; a random tanh policy ~-0.5."""
+
+    observation_dim = 1
+    action_dim = 1
+    action_low = -1.0
+    action_high = 1.0
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._target = 0.0
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._target = float(self._rng.uniform(-0.8, 0.8))
+        return np.array([self._target], np.float32), {}
+
+    def step(self, action):
+        a = float(np.asarray(action).reshape(-1)[0])
+        reward = -(a - self._target) ** 2
+        return np.zeros(1, np.float32), reward, True, False, {}
+
+
 _ENV_REGISTRY: Dict[str, Callable[[], Env]] = {
     "CartPole-v1": CartPole,
     "StatelessGuess": StatelessGuess,
+    "Pendulum-v1": Pendulum,
+    "TargetReach": TargetReach,
 }
 
 

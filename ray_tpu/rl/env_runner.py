@@ -22,17 +22,31 @@ class EnvRunner:
 
     def __init__(self, env_creator: Callable, *, num_envs: int = 4,
                  module_spec: Optional[RLModuleSpec] = None,
-                 seed: int = 0, explore: bool = True):
+                 seed: int = 0, explore: bool = True,
+                 env_to_module=None):
         import jax
 
         self.vec = VectorEnv(env_creator, num_envs, seed=seed)
+        # Env-to-module connector pipeline (reference: rllib ConnectorV2):
+        # observations pass through it before every forward; its state
+        # syncs with the weights via get_state/set_state.
+        from .connectors import Connector, ConnectorPipeline
+        if env_to_module is not None and \
+                not isinstance(env_to_module, ConnectorPipeline):
+            env_to_module = ConnectorPipeline(
+                [env_to_module] if isinstance(env_to_module, Connector)
+                else list(env_to_module))
+        self.env_to_module = env_to_module
+        obs_dim = self.vec.observation_dim
+        if env_to_module is not None:
+            obs_dim *= env_to_module.output_dim_factor
         self.spec = module_spec or RLModuleSpec(
-            self.vec.observation_dim, self.vec.num_actions)
+            obs_dim, self.vec.num_actions)
         self.module = DiscretePolicyModule(self.spec)
         self.explore = explore
         self._key = jax.random.key(seed)
         self.params = self.module.init(jax.random.key(seed + 1))
-        self._obs = self.vec.reset()
+        self._obs = self._connect(self.vec.reset())
         # Episode-return bookkeeping for metrics.
         self._ep_returns = np.zeros(num_envs, np.float64)
         self._ep_lens = np.zeros(num_envs, np.int64)
@@ -44,13 +58,21 @@ class EnvRunner:
         self._value_fn = jax.jit(
             lambda p, o: self.module.forward_train(p, o)["value"])
 
+    def _connect(self, obs: np.ndarray) -> np.ndarray:
+        return obs if self.env_to_module is None else self.env_to_module(obs)
+
     # -- weights --------------------------------------------------------- #
 
     def get_state(self) -> Dict[str, Any]:
-        return {"params": self.params}
+        state: Dict[str, Any] = {"params": self.params}
+        if self.env_to_module is not None:
+            state["connectors"] = self.env_to_module.get_state()
+        return state
 
     def set_state(self, state: Dict[str, Any]) -> bool:
         self.params = state["params"]
+        if self.env_to_module is not None and "connectors" in state:
+            self.env_to_module.set_state(state["connectors"])
         return True
 
     # -- sampling -------------------------------------------------------- #
@@ -60,7 +82,7 @@ class EnvRunner:
         arrays plus bootstrap values for GAE."""
         import jax
 
-        n, d = self.vec.num_envs, self.vec.observation_dim
+        n, d = self.vec.num_envs, self.spec.observation_dim
         obs_buf = np.empty((num_steps, n, d), np.float32)
         act_buf = np.empty((num_steps, n), np.int32)
         logp_buf = np.empty((num_steps, n), np.float32)
@@ -86,14 +108,24 @@ class EnvRunner:
             act_buf[t] = actions
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(values)
-            self._obs, rewards, dones, terms, final_obs = \
+            raw_obs, rewards, dones, terms, final_obs = \
                 self.vec.step(actions)
+            if self.env_to_module is not None and dones.any():
+                # Auto-reset rows carry a fresh episode's obs: history-
+                # keeping connectors must not leak old frames into it.
+                self.env_to_module.on_episode_boundaries(dones)
+            self._obs = self._connect(raw_obs)
             rew_buf[t] = rewards
             done_buf[t] = dones
             term_buf[t] = terms
             truncs = dones & ~terms
             if self.explore and truncs.any():
-                vals = np.asarray(self._value_fn(self.params, final_obs))
+                # Note: with a stateful FrameStack connector the truncation
+                # bootstrap sees the post-step stack — an approximation the
+                # reference shares (final_observation is a single frame).
+                fo = final_obs if self.env_to_module is None else \
+                    self.env_to_module.transform(final_obs)
+                vals = np.asarray(self._value_fn(self.params, fo))
                 boot_buf[t, truncs] = vals[truncs]
             self._ep_returns += rewards
             self._ep_lens += 1
@@ -140,11 +172,18 @@ class EnvRunnerGroup:
     def __init__(self, env_creator: Callable, *, num_env_runners: int = 0,
                  num_envs_per_runner: int = 4,
                  module_spec: Optional[RLModuleSpec] = None, seed: int = 0,
-                 runner_resources: Optional[Dict[str, float]] = None):
+                 runner_resources: Optional[Dict[str, float]] = None,
+                 env_to_module_fn=None):
         self.num_env_runners = num_env_runners
+        # Prototype pipeline used only for merge_states on gathered
+        # per-runner connector states (its own state is never consulted).
+        self._connector_proto = env_to_module_fn() if env_to_module_fn \
+            else None
         if num_env_runners == 0:
-            self.local = EnvRunner(env_creator, num_envs=num_envs_per_runner,
-                                   module_spec=module_spec, seed=seed)
+            self.local = EnvRunner(
+                env_creator, num_envs=num_envs_per_runner,
+                module_spec=module_spec, seed=seed,
+                env_to_module=env_to_module_fn and env_to_module_fn())
             self.remotes = []
         else:
             import ray_tpu
@@ -156,7 +195,8 @@ class EnvRunnerGroup:
             self.remotes = [
                 cls.options(**opts).remote(
                     env_creator, num_envs=num_envs_per_runner,
-                    module_spec=module_spec, seed=seed + 1000 * (i + 1))
+                    module_spec=module_spec, seed=seed + 1000 * (i + 1),
+                    env_to_module=env_to_module_fn and env_to_module_fn())
                 for i in range(num_env_runners)
             ]
 
@@ -167,14 +207,34 @@ class EnvRunnerGroup:
         return ray_tpu.get([r.sample.remote(num_steps) for r in self.remotes])
 
     def sync_weights(self, params) -> None:
-        """Broadcast learner params to all runners (reference:
-        env_runner_group.py sync_weights)."""
+        """Broadcast learner params to all runners; with stateful
+        connectors, also merge per-runner connector stats into one
+        canonical state and broadcast it back (reference:
+        env_runner_group.py sync_weights + rllib's distributed
+        MeanStdFilter aggregation)."""
         state = {"params": params}
         if self.local is not None:
             self.local.set_state(state)
             return
         import ray_tpu
+        if self._connector_proto is not None:
+            states = ray_tpu.get([r.get_state.remote()
+                                  for r in self.remotes])
+            merged = self._connector_proto.merge_states(
+                [s.get("connectors", {}) for s in states])
+            state["connectors"] = merged
         ray_tpu.get([r.set_state.remote(state) for r in self.remotes])
+
+    def connector_state(self):
+        """Canonical connector state for evaluation/inference consumers."""
+        if self.local is not None:
+            return self.local.get_state().get("connectors")
+        if self._connector_proto is None:
+            return None
+        import ray_tpu
+        states = ray_tpu.get([r.get_state.remote() for r in self.remotes])
+        return self._connector_proto.merge_states(
+            [s.get("connectors", {}) for s in states])
 
     def aggregate_metrics(self) -> Dict[str, float]:
         if self.local is not None:

@@ -1,0 +1,201 @@
+"""IMPALA: asynchronous actor-critic with V-trace off-policy correction.
+
+Reference: rllib/algorithms/impala/ (IMPALAConfig; decoupled sampling —
+env runners produce rollouts asynchronously while the learner consumes
+them, with V-trace (Espeholt et al. 2018) correcting for the policy lag)
+and rllib's vtrace_* helpers.  The async shape here: every remote runner
+always has exactly one ``sample`` call in flight; the learner waits for
+whichever finishes first, corrects its (stale-policy) rollout with
+V-trace, updates, and syncs fresh weights only to that runner before
+relaunching it — sampling and learning overlap instead of lock-stepping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .learner import JaxLearner
+from .rl_module import DiscretePolicyModule
+
+
+def vtrace(behavior_logp: np.ndarray, target_logp: np.ndarray,
+           rewards: np.ndarray, values: np.ndarray, dones: np.ndarray,
+           terminateds: np.ndarray, bootstrap_values: np.ndarray,
+           last_values: np.ndarray, gamma: float,
+           rho_clip: float = 1.0, c_clip: float = 1.0):
+    """V-trace targets + policy-gradient advantages over [T, N] rollouts.
+
+    ``values`` must be the *current* (learner) policy's value estimates of
+    the rollout observations; ``behavior_logp`` is the logp recorded at
+    sampling time.  Episode boundaries (``dones``) stop the vs recursion;
+    terminated steps bootstrap 0, truncated steps bootstrap
+    ``bootstrap_values[t]`` (V(final_obs) under the current policy is
+    approximated by the sampler's estimate — consistent with how the
+    runner records it).
+    """
+    T, N = rewards.shape
+    rho = np.minimum(np.exp(target_logp - behavior_logp), rho_clip)
+    c = np.minimum(np.exp(target_logp - behavior_logp), c_clip)
+    vs = np.zeros((T, N), np.float32)
+    vs_next = last_values.astype(np.float32)
+    v_next = last_values.astype(np.float32)
+    for t in reversed(range(T)):
+        done = dones[t].astype(np.float32)
+        term = terminateds[t].astype(np.float32)
+        boundary_v = (1.0 - term) * bootstrap_values[t]
+        v_tp1 = (1.0 - done) * v_next + done * boundary_v
+        vs_tp1 = (1.0 - done) * vs_next + done * boundary_v
+        delta = rho[t] * (rewards[t] + gamma * v_tp1 - values[t])
+        vs[t] = values[t] + delta + gamma * c[t] * (1.0 - done) * \
+            (vs_next - v_next)
+        vs_next = vs[t]
+        v_next = values[t]
+    # PG advantage: rho * (r + gamma * vs_{t+1} - V(x_t))
+    vs_tp1_full = np.zeros((T, N), np.float32)
+    vs_tp1_full[:-1] = vs[1:]
+    vs_tp1_full[-1] = last_values
+    done_f = dones.astype(np.float32)
+    term_f = terminateds.astype(np.float32)
+    boundary = (1.0 - term_f) * bootstrap_values
+    vs_tp1_full = (1.0 - done_f) * vs_tp1_full + done_f * boundary
+    pg_adv = rho * (rewards + gamma * vs_tp1_full - values)
+    return vs, pg_adv.astype(np.float32)
+
+
+def impala_loss(module: DiscretePolicyModule, params, batch):
+    import jax
+    import jax.numpy as jnp
+    out = module.forward_train(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(out["action_logits"])
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    pg_loss = -jnp.mean(logp * batch["pg_advantages"])
+    vf_loss = jnp.mean((out["value"] - batch["vs_targets"]) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    vf_coeff = batch["vf_coeff"][0]
+    ent_coeff = batch["ent_coeff"][0]
+    total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(IMPALA)
+        self.num_env_runners = 2       # async needs remote runners
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rho_clip = 1.0
+        self.c_clip = 1.0
+        self.batches_per_iteration = 4
+
+    def training(self, *, vf_loss_coeff=None, entropy_coeff=None,
+                 rho_clip=None, c_clip=None, batches_per_iteration=None,
+                 **kw) -> "IMPALAConfig":
+        super().training(**kw)
+        for name, val in (("vf_loss_coeff", vf_loss_coeff),
+                          ("entropy_coeff", entropy_coeff),
+                          ("rho_clip", rho_clip), ("c_clip", c_clip),
+                          ("batches_per_iteration", batches_per_iteration)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class IMPALA(Algorithm):
+    """Async actor-critic (reference: rllib/algorithms/impala).
+
+    With ``num_env_runners=0`` it degrades to synchronous A2C-with-vtrace
+    (useful for deterministic tests); with remote runners, sampling
+    overlaps learning and stale rollouts are V-trace-corrected.
+    """
+
+    def setup(self, config: IMPALAConfig) -> None:
+        import jax
+        spec = config.module_spec()
+        self.module = DiscretePolicyModule(spec)
+        self.learner = JaxLearner(self.module, impala_loss,
+                                  learning_rate=config.lr, seed=config.seed)
+        self._fwd = jax.jit(self.module.forward_train)
+        self.env_runner_group.sync_weights(self.learner.params)
+        # In-flight sample refs per remote runner (async pipeline).
+        self._inflight: Dict[Any, Any] = {}
+        self._steps_sampled = 0
+
+    def _correct_and_update(self, rollout: Dict[str, np.ndarray]
+                            ) -> Dict[str, float]:
+        cfg: IMPALAConfig = self.config
+        T, N = rollout["rewards"].shape
+        obs_flat = rollout["obs"].reshape(T * N, -1)
+        out = self._fwd(self.learner.params, obs_flat)
+        import jax
+        import jax.numpy as jnp
+        logits = np.asarray(jax.nn.log_softmax(out["action_logits"]))
+        cur_values = np.asarray(out["value"]).reshape(T, N)
+        actions_flat = rollout["actions"].reshape(-1)
+        target_logp = logits[np.arange(T * N), actions_flat].reshape(T, N)
+        vs, pg_adv = vtrace(
+            rollout["logp"], target_logp, rollout["rewards"], cur_values,
+            rollout["dones"], rollout["terminateds"],
+            rollout["bootstrap_values"], rollout["last_values"],
+            cfg.gamma, cfg.rho_clip, cfg.c_clip)
+        batch = {
+            "obs": obs_flat,
+            "actions": actions_flat.astype(np.int32),
+            "pg_advantages": pg_adv.reshape(-1),
+            "vs_targets": vs.reshape(-1),
+            "vf_coeff": np.array([cfg.vf_loss_coeff], np.float32),
+            "ent_coeff": np.array([cfg.entropy_coeff], np.float32),
+        }
+        self._steps_sampled += T * N
+        return self.learner.update(batch)
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        cfg: IMPALAConfig = self.config
+        group = self.env_runner_group
+        metrics: Dict[str, float] = {}
+        if not group.remotes:
+            # Synchronous fallback: local runner, still vtrace-corrected.
+            for _ in range(cfg.batches_per_iteration):
+                rollout = group.sample(cfg.rollout_fragment_length)[0]
+                metrics = self._correct_and_update(rollout)
+                group.sync_weights(self.learner.params)
+            return {"learner": metrics,
+                    "num_env_steps_sampled": self._steps_sampled}
+        # Async: keep one sample in flight per runner; consume as ready.
+        for r in group.remotes:
+            if r not in self._inflight:
+                self._inflight[r] = r.sample.remote(
+                    cfg.rollout_fragment_length)
+        consumed = 0
+        while consumed < cfg.batches_per_iteration:
+            refs = list(self._inflight.values())
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=60)
+            if not ready:
+                break
+            ready_ref = ready[0]
+            runner = next(r for r, ref in self._inflight.items()
+                          if ref == ready_ref)
+            rollout = ray_tpu.get(ready_ref)
+            metrics = self._correct_and_update(rollout)
+            # Fresh weights to the runner that just finished, then relaunch
+            # (the other runners keep sampling with slightly stale policy —
+            # that lag is exactly what V-trace corrects).
+            ray_tpu.get(runner.set_state.remote(
+                {"params": self.learner.params}))
+            self._inflight[runner] = runner.sample.remote(
+                cfg.rollout_fragment_length)
+            consumed += 1
+        return {"learner": metrics,
+                "num_env_steps_sampled": self._steps_sampled}
+
+    def get_weights(self):
+        return self.learner.params
+
+    def set_weights(self, params) -> None:
+        self.learner.set_weights(params)
+        self.env_runner_group.sync_weights(params)

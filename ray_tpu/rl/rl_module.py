@@ -81,6 +81,87 @@ class DiscretePolicyModule:
         return actions, alogp, out["value"]
 
 
+@dataclass(frozen=True)
+class ContinuousModuleSpec:
+    """Spec for continuous-action modules (reference: rllib catalog for
+    Box action spaces)."""
+    observation_dim: int
+    action_dim: int
+    action_low: float = -1.0
+    action_high: float = 1.0
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
+class GaussianPolicyModule:
+    """Tanh-squashed diagonal Gaussian policy for continuous control
+    (reference: rllib DefaultSACRLModule's squashed-Gaussian action dist).
+
+    ``sample`` returns (action, log_prob) with the tanh change-of-variables
+    correction; actions are affinely mapped to [low, high].
+    """
+
+    def __init__(self, spec: ContinuousModuleSpec):
+        self.spec = spec
+        self._scale = (spec.action_high - spec.action_low) / 2.0
+        self._mid = (spec.action_high + spec.action_low) / 2.0
+
+    def init(self, key: jax.Array) -> Params:
+        dims = [self.spec.observation_dim, *self.spec.hidden,
+                2 * self.spec.action_dim]
+        return {"pi": _init_mlp(key, dims)}
+
+    def _dist(self, params: Params, obs: jax.Array):
+        out = _mlp(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        return mean, log_std
+
+    def sample(self, params: Params, obs: jax.Array, key: jax.Array):
+        mean, log_std = self._dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre_tanh = mean + std * eps
+        # log N(x; mean, std) summed over action dims
+        logp = jnp.sum(
+            -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi)), axis=-1)
+        # tanh squash correction: log det |d tanh / dx| with the
+        # numerically stable softplus form.
+        logp -= jnp.sum(
+            2.0 * (jnp.log(2.0) - pre_tanh - jax.nn.softplus(-2 * pre_tanh)),
+            axis=-1)
+        squashed = jnp.tanh(pre_tanh)
+        action = self._mid + self._scale * squashed
+        # The affine rescale also shifts the density.
+        logp -= self.spec.action_dim * jnp.log(self._scale)
+        return action, logp
+
+    def forward_inference(self, params: Params, obs: jax.Array) -> jax.Array:
+        mean, _ = self._dist(params, obs)
+        return self._mid + self._scale * jnp.tanh(mean)
+
+
+class TwinQModule:
+    """Two independent Q(s, a) towers (clipped double-Q, reference: rllib
+    SAC's twin critic)."""
+
+    def __init__(self, spec: ContinuousModuleSpec):
+        self.spec = spec
+
+    def init(self, key: jax.Array) -> Params:
+        k1, k2 = jax.random.split(key)
+        dims = [self.spec.observation_dim + self.spec.action_dim,
+                *self.spec.hidden, 1]
+        return {"q1": _init_mlp(k1, dims), "q2": _init_mlp(k2, dims)}
+
+    def q_values(self, params: Params, obs: jax.Array, actions: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+        x = jnp.concatenate([obs, actions], axis=-1)
+        return _mlp(params["q1"], x)[..., 0], _mlp(params["q2"], x)[..., 0]
+
+
 class QModule:
     """Single Q-tower for value-based algorithms (reference: rllib
     DefaultDQNRLModule without dueling/distributional extras)."""

@@ -1,0 +1,243 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference: rllib/algorithms/sac/ (SACConfig, SAC training_step: env step ->
+replay -> twin-Q TD update with entropy bonus -> policy update -> alpha
+update -> polyak target sync).  Here the whole update — critic, actor,
+temperature, target polyak — is one jitted function of (state, batch, key),
+the XLA-friendly shape for TPU training: no Python between the four
+optimizer steps, so the compiler fuses them into a single program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import numpy as np
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .env import make_env
+from .replay_buffer import ReplayBuffer
+from .rl_module import ContinuousModuleSpec, GaussianPolicyModule, TwinQModule
+
+
+class SACState(NamedTuple):
+    pi_params: Any
+    q_params: Any
+    q_target: Any
+    log_alpha: Any
+    pi_opt: Any
+    q_opt: Any
+    alpha_opt: Any
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(SAC)
+        self.buffer_size = 100_000
+        self.learning_starts = 500
+        self.tau = 0.005            # polyak coefficient
+        self.train_batch_size = 256
+        self.updates_per_step = 1
+        self.initial_alpha = 0.2
+        self.target_entropy = None  # default: -action_dim
+        self.actor_lr = None        # default: lr
+        self.critic_lr = None
+        self.alpha_lr = 3e-4
+
+    def training(self, *, buffer_size=None, learning_starts=None, tau=None,
+                 updates_per_step=None, initial_alpha=None,
+                 target_entropy=None, actor_lr=None, critic_lr=None,
+                 alpha_lr=None, **kw) -> "SACConfig":
+        super().training(**kw)
+        for name, val in (("buffer_size", buffer_size),
+                          ("learning_starts", learning_starts),
+                          ("tau", tau),
+                          ("updates_per_step", updates_per_step),
+                          ("initial_alpha", initial_alpha),
+                          ("target_entropy", target_entropy),
+                          ("actor_lr", actor_lr),
+                          ("critic_lr", critic_lr),
+                          ("alpha_lr", alpha_lr)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class SAC(Algorithm):
+    """Off-policy; drives its own env loop like DQN."""
+
+    _use_env_runner_group = False
+
+    def setup(self, config: SACConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        env = make_env(config.env_spec)
+        if not env.is_continuous:
+            raise ValueError("SAC requires a continuous-action env "
+                             "(set env.action_dim)")
+        self.env = env
+        spec = ContinuousModuleSpec(env.observation_dim, env.action_dim,
+                                    env.action_low, env.action_high,
+                                    tuple(config.module_hidden))
+        self.pi = GaussianPolicyModule(spec)
+        self.q = TwinQModule(spec)
+        target_entropy = (config.target_entropy
+                          if config.target_entropy is not None
+                          else -float(env.action_dim))
+        actor_lr = config.actor_lr or config.lr
+        critic_lr = config.critic_lr or config.lr
+        pi_optim = optax.adam(actor_lr)
+        q_optim = optax.adam(critic_lr)
+        alpha_optim = optax.adam(config.alpha_lr)
+        gamma, tau = config.gamma, config.tau
+
+        key = jax.random.key(config.seed)
+        kp, kq = jax.random.split(key)
+        pi_params = self.pi.init(kp)
+        q_params = self.q.init(kq)
+        log_alpha = jnp.log(jnp.asarray(config.initial_alpha, jnp.float32))
+        self.state = SACState(
+            pi_params, q_params, q_params, log_alpha,
+            pi_optim.init(pi_params), q_optim.init(q_params),
+            alpha_optim.init(log_alpha))
+
+        pi, q = self.pi, self.q
+
+        def update(state: SACState, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(state.log_alpha)
+
+            # -- critic: soft TD target from the target twin (clipped) ----
+            next_a, next_logp = pi.sample(state.pi_params,
+                                          batch["next_obs"], k1)
+            tq1, tq2 = q.q_values(state.q_target, batch["next_obs"], next_a)
+            next_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target = batch["rewards"] + gamma * \
+                (1.0 - batch["terminateds"]) * next_v
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(qp):
+                q1, q2 = q.q_values(qp, batch["obs"], batch["actions"])
+                return jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2), \
+                    (jnp.mean(q1), jnp.mean(jnp.abs(q1 - target)))
+
+            (closs, (q_mean, td_abs)), q_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(state.q_params)
+            q_updates, q_opt = q_optim.update(q_grads, state.q_opt,
+                                              state.q_params)
+            q_params = optax.apply_updates(state.q_params, q_updates)
+
+            # -- actor: maximize E[min Q - alpha log pi] ------------------
+            def actor_loss(pp):
+                a, logp = pi.sample(pp, batch["obs"], k2)
+                q1, q2 = q.q_values(q_params, batch["obs"], a)
+                return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), \
+                    jnp.mean(logp)
+
+            (aloss, logp_mean), pi_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(state.pi_params)
+            pi_updates, pi_opt = pi_optim.update(pi_grads, state.pi_opt,
+                                                 state.pi_params)
+            pi_params = optax.apply_updates(state.pi_params, pi_updates)
+
+            # -- temperature: drive entropy toward the target -------------
+            def alpha_loss(la):
+                return -jnp.exp(la) * jax.lax.stop_gradient(
+                    logp_mean + target_entropy)
+
+            al, a_grads = jax.value_and_grad(alpha_loss)(state.log_alpha)
+            a_updates, alpha_opt = alpha_optim.update(a_grads,
+                                                      state.alpha_opt)
+            log_alpha = optax.apply_updates(state.log_alpha, a_updates)
+
+            # -- polyak target sync ---------------------------------------
+            q_target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                    state.q_target, q_params)
+            metrics = {"critic_loss": closs, "actor_loss": aloss,
+                       "alpha": alpha, "q_mean": q_mean,
+                       "td_abs": td_abs, "logp_mean": logp_mean}
+            return SACState(pi_params, q_params, q_target, log_alpha,
+                            pi_opt, q_opt, alpha_opt), metrics
+
+        self._update = jax.jit(update)
+        self._sample_act = jax.jit(pi.sample)
+        self._infer_act = jax.jit(pi.forward_inference)
+
+        self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self._key = jax.random.key(config.seed + 1)
+        self._obs, _ = self.env.reset(seed=config.seed)
+        self._steps = 0
+        self._rng = np.random.default_rng(config.seed)
+        self._ep_return = 0.0
+        self._returns: list = []
+
+    def _act(self, obs: np.ndarray) -> np.ndarray:
+        import jax
+        cfg: SACConfig = self.config
+        if self._steps < cfg.learning_starts:
+            # Warmup: uniform random actions across the bounds.
+            return self._rng.uniform(
+                self.env.action_low, self.env.action_high,
+                self.env.action_dim).astype(np.float32)
+        self._key, sub = jax.random.split(self._key)
+        a, _ = self._sample_act(self.state.pi_params, obs[None], sub)
+        return np.asarray(a)[0]
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        cfg: SACConfig = self.config
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.rollout_fragment_length):
+            action = self._act(self._obs)
+            next_obs, r, term, trunc, _ = self.env.step(action)
+            self.buffer.add(
+                obs=self._obs[None], actions=action[None].astype(np.float32),
+                rewards=np.array([r], np.float32), next_obs=next_obs[None],
+                terminateds=np.array([float(term)], np.float32))
+            self._ep_return += r
+            self._steps += 1
+            if term or trunc:
+                self._returns.append(self._ep_return)
+                self._ep_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = next_obs
+            if self._steps >= cfg.learning_starts and \
+                    self._steps % cfg.updates_per_step == 0:
+                batch = self.buffer.sample(cfg.train_batch_size)
+                self._key, sub = jax.random.split(self._key)
+                self.state, m = self._update(self.state, batch, sub)
+                metrics = {k: float(v) for k, v in m.items()}
+        recent = self._returns[-100:]
+        return {
+            "learner": metrics,
+            "num_env_steps_sampled": self._steps,
+            "buffer_size": len(self.buffer),
+            "env_runners": {
+                "episode_return_mean":
+                    float(np.mean(recent)) if recent else float("nan"),
+                "num_episodes": len(self._returns),
+            },
+        }
+
+    def get_weights(self):
+        return {"pi": self.state.pi_params, "q": self.state.q_params,
+                "q_target": self.state.q_target,
+                "log_alpha": self.state.log_alpha}
+
+    def set_weights(self, params) -> None:
+        self.state = self.state._replace(
+            pi_params=params["pi"], q_params=params["q"],
+            q_target=params["q_target"], log_alpha=params["log_alpha"])
+
+    def compute_single_action(self, obs: np.ndarray,
+                              explore: bool = False) -> np.ndarray:
+        import jax
+        if explore:
+            self._key, sub = jax.random.split(self._key)
+            a, _ = self._sample_act(self.state.pi_params, obs[None], sub)
+            return np.asarray(a)[0]
+        return np.asarray(self._infer_act(self.state.pi_params,
+                                          obs[None]))[0]

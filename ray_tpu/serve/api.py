@@ -131,9 +131,12 @@ class _DeploymentState:
         cap = None
         target = dep.cls_or_fn
         if isinstance(target, type):
-            for attr in vars(target).values():
-                if isinstance(attr, _MultiplexedDescriptor):
-                    cap = attr._max
+            for klass in target.__mro__:  # loaders may be inherited
+                for attr in vars(klass).values():
+                    if isinstance(attr, _MultiplexedDescriptor):
+                        cap = attr._max
+                        break
+                if cap is not None:
                     break
         self.affinity = RouterAffinity(cap if cap is not None else 8)
         self._lock = threading.Lock()
