@@ -899,6 +899,14 @@ class NodeServer:
         info = NodeInfo(self.node_id, socket.gethostname(),
                         ResourceSet(node_resources), is_head=False)
         self._rt = _NodeServerRuntime(self, self.job_id)
+        # Per-node session dir: this node's workers log locally, tailed to
+        # the node server's stdout (reference: per-node log dirs + log
+        # monitor; cross-node shipping rides the job/log tooling).
+        from .log_monitor import LogMonitor, create_session_dir
+        session = create_session_dir()
+        self._rt.session_logs_dir = os.path.join(session, "logs")
+        self._log_monitor = LogMonitor(self._rt.session_logs_dir)
+        self._log_monitor.start()
         self.node = NodeManager(info, self._rt,
                                 num_tpu_chips=int(num_tpus or 0))
         self.data_server = DataServer(self.node.store, token,
@@ -1082,6 +1090,7 @@ class NodeServer:
             pass
         self.data_server.shutdown()
         self.data_client.shutdown()
+        self._log_monitor.stop()
         self.node.shutdown()
 
 

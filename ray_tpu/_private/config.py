@@ -191,6 +191,16 @@ D("syncer_period_s", float, 1.0,
   "Node resource-view sampling period; views are sent to the head only "
   "when changed (reference: ray_syncer.h versioned broadcast).")
 
+# --- Resource isolation (reference: src/ray/common/cgroup2/) ---------------
+D("enable_resource_isolation", bool, False,
+  "Isolate worker processes (cgroup v2 when writable, RLIMIT_AS fallback) "
+  "— reference: cgroup_manager.h opt-in isolation.")
+D("worker_memory_limit_bytes", int, 0,
+  "Per-worker-tree memory cap (cgroup memory.max / worker RLIMIT_AS); "
+  "0 = unlimited.")
+D("worker_cgroup_cpu_weight", int, 0,
+  "cpu.weight for the workers cgroup (cgroup tier only); 0 = default.")
+
 # --- Memory monitor / OOM killing ------------------------------------------
 # 0 disables the monitor (the reference defaults to 250ms-on; here the
 # default is off so shared CI hosts under external memory pressure don't
@@ -211,3 +221,7 @@ D("memory_monitor_test_fraction", float, 0.0,
 # --- Logging ---------------------------------------------------------------
 D("log_level", str, "INFO", "Runtime log level.")
 D("session_dir", str, "", "Session directory (empty = /tmp/ray_tpu/session_*).")
+D("redirect_worker_logs", bool, True,
+  "Redirect worker stdout/stderr to per-worker session log files, tailed "
+  "back to the driver by the log monitor (reference: log_monitor.py:116).")
+D("log_monitor_poll_ms", int, 200, "Log monitor tail poll period.")

@@ -101,9 +101,25 @@ class Controller:
 
     # -- nodes --------------------------------------------------------------
 
+    # Optional sink for structured export events (reference:
+    # RayEventRecorder / export_*.proto); set by the Runtime to the
+    # session's JSONL writer.  Signature: (source_type, event_dict).
+    event_sink: Optional[Callable[[str, Dict[str, Any]], None]] = None
+
+    def _export(self, source_type: str, event: Dict[str, Any]) -> None:
+        sink = self.event_sink
+        if sink is not None:
+            try:
+                sink(source_type, event)
+            except Exception:  # noqa: BLE001 — observability must not break
+                pass
+
     def register_node(self, info: NodeInfo) -> None:
         with self._lock:
             self.nodes[info.node_id] = info
+        self._export("EXPORT_NODE", {"node_id": info.node_id.hex(),
+                                     "state": "ALIVE",
+                                     "hostname": info.hostname})
         self.publish("node_added", info)
 
     def heartbeat(self, node_id: NodeID) -> None:
@@ -118,6 +134,8 @@ class Controller:
             if not n or not n.alive:
                 return
             n.alive = False
+        self._export("EXPORT_NODE", {"node_id": node_id.hex(),
+                                     "state": "DEAD", "reason": reason})
         self.publish("node_removed", node_id)
 
     def alive_nodes(self) -> List[NodeInfo]:
@@ -163,6 +181,9 @@ class Controller:
                 a.node_id = node_id
             if death_cause is not None:
                 a.death_cause = death_cause
+        self._export("EXPORT_ACTOR", {"actor_id": actor_id.hex(),
+                                      "state": state,
+                                      "death_cause": death_cause})
         self.publish("actor_state", (actor_id, state))
 
     def get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:

@@ -1,0 +1,174 @@
+"""Session directory, worker log redirection + tailing, export events.
+
+Reference analogs: per-process log files under /tmp/ray/session_*/logs
+tailed by the LogMonitor (python/ray/_private/log_monitor.py:116) and
+republished to the driver; structured export events (export_*.proto +
+RayEventRecorder, src/ray/observability/ray_event_recorder.h:36) written
+for external consumers.
+
+Here: each worker's stdout/stderr is redirected to
+``<session>/logs/worker-<id>.out|.err``; a driver-side LogMonitor thread
+tails the directory and echoes fresh lines prefixed ``(worker-xxxxxxx
+.err)`` — the reference's "(pid=...) ..." stream — while keeping the files
+for the state API (``ctl_log_tail``).  Export events are JSON lines in
+``<session>/logs/events.jsonl``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .config import Config
+
+
+def create_session_dir() -> str:
+    base = Config.get("session_dir") or "/tmp/ray_tpu"
+    path = os.path.join(
+        base, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}")
+    os.makedirs(os.path.join(path, "logs"), exist_ok=True)
+    # Convenience symlink like the reference's session_latest.
+    link = os.path.join(base, "session_latest")
+    try:
+        if os.path.islink(link) or os.path.exists(link):
+            os.remove(link)
+        os.symlink(path, link)
+    except OSError:
+        pass
+    return path
+
+
+class LogMonitor:
+    """Tails every log file in a directory, emitting new lines.
+
+    Reference: _private/log_monitor.py:116 — there the tail is pushed
+    through GCS pubsub to drivers; here the monitor runs in the driver
+    process itself, so it just writes to the driver's stderr.
+    """
+
+    def __init__(self, logs_dir: str,
+                 emit: Optional[Callable[[str, str], None]] = None):
+        self.logs_dir = logs_dir
+        self._emit = emit or self._default_emit
+        self._offsets: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._period = Config.get("log_monitor_poll_ms") / 1000.0
+
+    @staticmethod
+    def _default_emit(fname: str, line: str) -> None:
+        tag = fname.rsplit(".", 1)[0]
+        stream = sys.stderr if fname.endswith(".err") else sys.stdout
+        print(f"({tag}) {line}", file=stream)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="log-monitor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Join so a caller's post-stop flush poll cannot race an in-flight
+        # poll (duplicate emission / concurrent offset writes); the loop
+        # waits on a 200ms event, so this returns promptly.
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._period):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — monitor must never die
+                pass
+
+    def poll_once(self) -> int:
+        """Scan files once; returns number of lines emitted."""
+        emitted = 0
+        try:
+            names = sorted(os.listdir(self.logs_dir))
+        except OSError:
+            return 0
+        for fname in names:
+            if not (fname.endswith(".out") or fname.endswith(".err")):
+                continue
+            path = os.path.join(self.logs_dir, fname)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            off = self._offsets.get(fname, 0)
+            if size <= off:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read(size - off)
+            except OSError:
+                continue
+            # Only emit complete lines; keep the partial tail for later.
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            self._offsets[fname] = off + last_nl + 1
+            for raw in chunk[:last_nl].split(b"\n"):
+                line = raw.decode("utf-8", "replace").rstrip("\r")
+                if line:
+                    self._emit(fname, line)
+                    emitted += 1
+        return emitted
+
+    def tail(self, fname: str, n: int = 100) -> List[str]:
+        """Last n lines of one log file (state-API surface)."""
+        path = os.path.join(self.logs_dir, os.path.basename(fname))
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 256 * 1024))
+                data = f.read()
+        except OSError:
+            return []
+        lines = data.decode("utf-8", "replace").splitlines()
+        return lines[-n:]
+
+    def list_files(self) -> List[Tuple[str, int]]:
+        try:
+            return sorted(
+                (f, os.path.getsize(os.path.join(self.logs_dir, f)))
+                for f in os.listdir(self.logs_dir))
+        except OSError:
+            return []
+
+
+class ExportEventWriter:
+    """Append-only JSONL of structured lifecycle events (reference:
+    export_*.proto events recorded by RayEventRecorder for external
+    pipelines)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a", buffering=1)
+
+    def write(self, source_type: str, event: Dict[str, Any]) -> None:
+        rec = {"timestamp": time.time(), "source_type": source_type,
+               **event}
+        try:
+            with self._lock:
+                self._f.write(json.dumps(rec, default=str) + "\n")
+        except ValueError:
+            pass  # closed during shutdown race
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except Exception:  # noqa: BLE001
+                pass

@@ -112,6 +112,10 @@ class NodeManager:
         from .memory_monitor import MemoryMonitor
         self.memory_monitor = MemoryMonitor(self)
         self.memory_monitor.start()
+        # Worker resource isolation (reference: cgroup2/cgroup_manager.h);
+        # no-op unless enable_resource_isolation.
+        from .cgroup import CgroupManager
+        self.cgroup = CgroupManager()
 
     # -- worker lifecycle ---------------------------------------------------
 
@@ -160,9 +164,31 @@ class NodeManager:
             "RAY_TPU_ARENA_SEG":
                 self.store.segment_name if self._native_store else "",
         })
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=child_env, cwd=os.getcwd())
+        # Per-worker log files in the session dir, tailed back to the
+        # driver by the log monitor (reference: workers log to
+        # /tmp/ray/session_*/logs, republished by log_monitor.py:116).
+        popen_kw: Dict[str, Any] = {}
+        logs_dir = getattr(self.runtime, "session_logs_dir", None)
+        if logs_dir and Config.get("redirect_worker_logs"):
+            tag = f"worker-{worker_id.hex()[:8]}"
+            out = None
+            try:
+                out = open(os.path.join(logs_dir, tag + ".out"), "ab")
+                err = open(os.path.join(logs_dir, tag + ".err"), "ab")
+                popen_kw = {"stdout": out, "stderr": err}
+            except OSError:
+                if out is not None:
+                    out.close()
+                popen_kw = {}
+        child_env.update(self.cgroup.spawn_env())
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=child_env, cwd=os.getcwd(), **popen_kw)
+        finally:
+            for f in popen_kw.values():
+                f.close()  # child holds the fd; parent must not leak it
+        self.cgroup.add_process(proc.pid)
         handle = WorkerHandle(worker_id, proc, None)
         with self._lock:
             self._workers[worker_id] = handle
@@ -635,6 +661,7 @@ class NodeManager:
     def shutdown(self) -> None:
         self._closed = True
         self.memory_monitor.stop()
+        self.cgroup.cleanup()
         try:
             self._listener.close()
         except Exception:

@@ -221,6 +221,20 @@ class Runtime:
         self._recovering: Dict[TaskID, threading.Event] = {}
         self._recover_attempts: Dict[TaskID, int] = {}
 
+        # Session directory + worker-log tailing + export events
+        # (reference: /tmp/ray/session_* with log_monitor.py:116 and
+        # RayEventRecorder export events).  Created before the NodeManager
+        # so the first spawned worker already redirects into it.
+        from .log_monitor import (ExportEventWriter, LogMonitor,
+                                  create_session_dir)
+        self.session_dir = create_session_dir()
+        self.session_logs_dir = os.path.join(self.session_dir, "logs")
+        self.log_monitor = LogMonitor(self.session_logs_dir)
+        self.log_monitor.start()
+        self.export_events = ExportEventWriter(
+            os.path.join(self.session_logs_dir, "events.jsonl"))
+        self.controller.event_sink = self.export_events.write
+
         self.scheduler = ClusterScheduler(self.controller, self._object_ready)
         self.scheduler.on_dispatch_error = self._fail_task
         self.node = NodeManager(node_info, self, num_tpu_chips=int(num_tpus or 0))
@@ -1545,6 +1559,18 @@ class Runtime:
                  "end_time": j.end_time, "entrypoint": j.entrypoint}
                 for j in self.controller.jobs.values()]
 
+    def ctl_log_files(self):
+        """Session log files + sizes (reference: state API list_logs)."""
+        return self.log_monitor.list_files()
+
+    def ctl_log_tail(self, filename: str, n: int = 100):
+        """Last n lines of a session log file (reference: state API
+        get_log)."""
+        return self.log_monitor.tail(filename, n)
+
+    def ctl_session_dir(self):
+        return self.session_dir
+
     def ctl_timeline(self):
         return self.events.chrome_trace()
 
@@ -1575,6 +1601,9 @@ class Runtime:
         if self._data_client is not None:
             self._data_client.shutdown()
         self.node.shutdown()
+        self.log_monitor.stop()
+        self.log_monitor.poll_once()  # flush buffered worker output
+        self.export_events.close()
         for shm in self._mapped_segments.values():
             try:
                 shm.close()

@@ -28,6 +28,8 @@ def main() -> None:
     from .worker import WorkerLoop
 
     Config.initialize()
+    from .cgroup import apply_worker_rlimits
+    apply_worker_rlimits()  # rlimit isolation tier (see cgroup.py)
     from .runtime_env import apply_worker_env
     apply_worker_env()
     conn = Client(sock_path, "AF_UNIX", authkey=authkey)

@@ -56,6 +56,107 @@ def _free_port() -> int:
     return port
 
 
+class _SocketP2P:
+    """Direct rank-to-rank transport for send/recv.
+
+    Replaces the round-1 pickle-over-KV polling path: each rank lazily
+    opens a TCP listener (address published once through the KV
+    rendezvous), peers keep persistent connections, and frames are
+    (src_rank, payload) messages demultiplexed into per-source queues.
+    The reference's analog is NCCL p2p inside a group
+    (nccl_collective_group.py send/recv); on TPU, device tensors should
+    ride ppermute inside jit — this path carries host-side numpy.
+    """
+
+    def __init__(self, group_name: str, rank: int, token: bytes):
+        self.group = group_name
+        self.rank = rank
+        self.token = token
+        self._listener = None
+        self._out: dict = {}          # dst rank -> Connection
+        self._in_queues: dict = {}    # src rank -> queue.Queue
+        self._qlock = None
+        self._closed = False
+
+    # -- wiring -------------------------------------------------------------
+
+    def _addr_key(self, rank: int) -> str:
+        return f"collective/{self.group}/p2p_addr/{rank}"
+
+    def ensure_listener(self) -> None:
+        if self._listener is not None:
+            return
+        import os
+        import threading
+        from multiprocessing.connection import Listener
+        self._qlock = threading.Lock()
+        # Bind the wildcard but advertise a peer-reachable host so ranks
+        # on different nodes can connect (same convention as the cluster
+        # data plane, cluster.py DataServer).
+        self._listener = Listener(("0.0.0.0", 0), authkey=self.token)
+        advertise = os.environ.get("RAY_TPU_ADVERTISE_HOST", "127.0.0.1")
+        _kv_put(self._addr_key(self.rank),
+                pickle.dumps((advertise, self._listener.address[1])))
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"p2p-accept-{self.group}-{self.rank}").start()
+
+    def _accept_loop(self) -> None:
+        import threading
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                return
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn) -> None:
+        import queue as _q
+        while not self._closed:
+            try:
+                src, payload = conn.recv()
+            except (EOFError, OSError):
+                return
+            with self._qlock:
+                q = self._in_queues.setdefault(src, _q.Queue())
+            q.put(payload)
+
+    def send(self, dst_rank: int, payload: bytes) -> None:
+        from multiprocessing.connection import Client
+        conn = self._out.get(dst_rank)
+        if conn is None:
+            addr = pickle.loads(_wait_for(self._addr_key(dst_rank)))
+            conn = Client(tuple(addr), authkey=self.token)
+            self._out[dst_rank] = conn
+        conn.send((self.rank, payload))
+
+    def recv(self, src_rank: int,
+             timeout: float = _RENDEZVOUS_TIMEOUT_S) -> bytes:
+        import queue as _q
+        self.ensure_listener()
+        with self._qlock:
+            q = self._in_queues.setdefault(src_rank, _q.Queue())
+        try:
+            return q.get(timeout=timeout)
+        except _q.Empty:
+            raise TimeoutError(
+                f"p2p recv from rank {src_rank} timed out") from None
+
+    def close(self) -> None:
+        self._closed = True
+        for conn in self._out.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except Exception:
+                pass
+            _kv_del(self._addr_key(self.rank))
+
+
 class XlaBackend:
     """Group ops lower to XLA collectives over a jax.distributed world.
 
@@ -71,8 +172,16 @@ class XlaBackend:
         self.group_name = group_name
         self._mesh = None
         self._np = None
+        # (kind, op, shape, dtype) -> compiled fn.  jit caches by callable
+        # identity, so fresh lambdas per call would re-trace every op.
+        self._jit_cache: dict = {}
+        self._p2p = _SocketP2P(group_name, rank,
+                               b"rt-p2p-" + group_name.encode())
 
     def setup(self) -> None:
+        # Open the p2p listener up-front so a peer's first send never has
+        # to wait for this rank's first recv to publish the address.
+        self._p2p.ensure_listener()
         key = f"collective/{self.group_name}/addr"
         if self.rank == 0:
             addr = f"127.0.0.1:{_free_port()}"
@@ -101,6 +210,7 @@ class XlaBackend:
         self._devices_per_proc = len(jax.local_devices())
 
     def teardown(self) -> None:
+        self._p2p.close()
         try:
             import jax
             jax.distributed.shutdown()
@@ -114,24 +224,31 @@ class XlaBackend:
     def _global(self, local):
         """Local [*, ...] -> global [n_devices, ...] sharded on axis 0.
 
-        With d devices per process the local row is repeated d times;
-        reductions de-duplicate with a stride-d slice so multi-device
-        processes contribute once.
+        With d devices per process the local row appears d times — as a
+        zero-copy broadcast view, not a materialized repeat; reductions
+        de-duplicate with a stride-d slice so multi-device processes
+        contribute once.
         """
         import jax
         import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
-        local = np.asarray(local)
+        local = np.ascontiguousarray(local)
         sharding = NamedSharding(self._mesh, P("world"))
-        return jax.make_array_from_process_local_data(
-            sharding, np.repeat(local[None], self._devices_per_proc, 0))
+        view = np.broadcast_to(local[None],
+                               (self._devices_per_proc, *local.shape))
+        return jax.make_array_from_process_local_data(sharding, view)
 
-    def _replicated_result(self, computation, arr):
+    def _replicated_result(self, kind: str, computation, arr, op: str = ""):
         import jax
         import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
-        out = jax.jit(computation,
-                      out_shardings=NamedSharding(self._mesh, P()))(arr)
+        cache_key = (kind, op, arr.shape, str(arr.dtype))
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            fn = jax.jit(computation,
+                         out_shardings=NamedSharding(self._mesh, P()))
+            self._jit_cache[cache_key] = fn
+        out = fn(arr)
         return np.asarray(out.addressable_shards[0].data)
 
     @staticmethod
@@ -146,12 +263,13 @@ class XlaBackend:
         fn = self._op_fn(op)
         arr = self._global(tensor)
         k = self._devices_per_proc
-        return self._replicated_result(lambda a: fn(a[::k], axis=0), arr)
+        return self._replicated_result(
+            "allreduce", lambda a: fn(a[::k], axis=0), arr, op)
 
     def allgather(self, tensor):
         arr = self._global(tensor)
         k = self._devices_per_proc
-        return self._replicated_result(lambda a: a[::k], arr)
+        return self._replicated_result("allgather", lambda a: a[::k], arr)
 
     def reducescatter(self, tensor, op: str = "sum"):
         """Input per rank: [world * chunk, ...]; returns this rank's chunk."""
@@ -179,23 +297,11 @@ class XlaBackend:
         self.allreduce(np.zeros(1, np.float32), "sum")
 
     def send(self, tensor, dst_rank: int) -> None:
-        if not hasattr(self, "_p2p_out"):
-            self._p2p_out = {}
-        seq = self._p2p_out[dst_rank] = self._p2p_out.get(dst_rank, 0) + 1
-        _kv_put(
-            f"collective/{self.group_name}/p2p/"
-            f"{self.rank}->{dst_rank}/{seq}",
-            pickle.dumps(self._np.asarray(tensor)))
+        import numpy as np
+        self._p2p.send(dst_rank, pickle.dumps(np.asarray(tensor)))
 
     def recv(self, shape, dtype, src_rank: int):
-        if not hasattr(self, "_p2p_in"):
-            self._p2p_in = {}
-        seq = self._p2p_in[src_rank] = self._p2p_in.get(src_rank, 0) + 1
-        key = (f"collective/{self.group_name}/p2p/"
-               f"{src_rank}->{self.rank}/{seq}")
-        data = _wait_for(key)
-        _kv_del(key)
-        return pickle.loads(data)
+        return pickle.loads(self._p2p.recv(src_rank))
 
 
 class KVBackend:
@@ -212,8 +318,11 @@ class KVBackend:
         self.group_name = group_name
         self._seq = 0
         self._nonce = ""
+        self._p2p = _SocketP2P(group_name, rank,
+                               b"rt-p2p-" + group_name.encode())
 
     def setup(self) -> None:
+        self._p2p.ensure_listener()
         # Rank 0 publishes a fresh incarnation nonce so a recreated group
         # with the same name can never read a previous incarnation's rounds.
         meta_key = f"collective/{self.group_name}/meta"
@@ -230,6 +339,7 @@ class KVBackend:
             _wait_for(f"{base}/join/{r}", deadline - time.monotonic())
 
     def teardown(self) -> None:
+        self._p2p.close()
         base = f"collective/{self.group_name}/{self._nonce}"
         _kv_del(f"{base}/join/{self.rank}")
         for s in (self._seq, self._seq - 1):
@@ -300,19 +410,7 @@ class KVBackend:
 
     def send(self, tensor, dst_rank: int) -> None:
         import numpy as np
-        if not hasattr(self, "_p2p_out"):
-            self._p2p_out = {}
-        seq = self._p2p_out[dst_rank] = self._p2p_out.get(dst_rank, 0) + 1
-        _kv_put(f"collective/{self.group_name}/p2p/"
-                f"{self.rank}->{dst_rank}/{seq}",
-                pickle.dumps(np.asarray(tensor)))
+        self._p2p.send(dst_rank, pickle.dumps(np.asarray(tensor)))
 
     def recv(self, shape, dtype, src_rank: int):
-        if not hasattr(self, "_p2p_in"):
-            self._p2p_in = {}
-        seq = self._p2p_in[src_rank] = self._p2p_in.get(src_rank, 0) + 1
-        key = (f"collective/{self.group_name}/p2p/"
-               f"{src_rank}->{self.rank}/{seq}")
-        data = _wait_for(key)
-        _kv_del(key)
-        return pickle.loads(data)
+        return pickle.loads(self._p2p.recv(src_rank))

@@ -27,7 +27,8 @@ from .channel import ShmChannel
 from .compiled_dag import CompiledDAG
 
 __all__ = ["DAGNode", "InputNode", "InputAttributeNode", "ClassMethodNode",
-           "MultiOutputNode", "CompiledDAG", "ShmChannel"]
+           "MultiOutputNode", "CompiledDAG", "ShmChannel",
+           "CollectiveOutputNode", "allreduce_bind"]
 
 
 class DAGNode:
@@ -161,3 +162,8 @@ class MultiOutputNode(DAGNode):
 
     def _eval_impl(self, memo, args, kwargs):
         return [o._eval(memo, args, kwargs) for o in self._outputs]
+
+
+# Collective nodes import DAGNode from this module, so this import must sit
+# below the class definitions (reference: dag/collective_node.py).
+from .collective import CollectiveOutputNode, allreduce_bind  # noqa: E402

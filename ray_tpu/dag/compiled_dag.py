@@ -67,6 +67,55 @@ def _dag_actor_loop(instance, plan: Dict[str, Any]) -> int:
                 if stop:
                     break
                 node_idx = step["node_idx"]
+                if step.get("kind") == "collective":
+                    # Broadcast this rank's contribution, read peers',
+                    # reduce locally (all writes precede all reads, so
+                    # capacity-1 channels cannot deadlock).
+                    from .collective import _tree_reduce
+                    _, contrib_idx = step["input"]
+                    c_err = local_errs.get(contrib_idx)
+                    if c_err is not None:
+                        for key in step["peer_writes"]:
+                            out_channels[key].write(c_err, FLAG_ERR)
+                    else:
+                        c_payload = serialization.pack_payload(
+                            local_vals[contrib_idx])
+                        for key in step["peer_writes"]:
+                            out_channels[key].write(c_payload, FLAG_DATA)
+                    values = [] if c_err is not None else \
+                        [local_vals[contrib_idx]]
+                    coll_err = c_err
+                    for key in step["peer_reads"]:
+                        flag, payload = in_channels[key].read()
+                        if flag == FLAG_STOP:
+                            stop = True
+                        elif flag == FLAG_ERR:
+                            coll_err = coll_err or payload
+                        else:
+                            values.append(
+                                serialization.unpack_payload(payload))
+                    if stop:
+                        break
+                    if coll_err is not None:
+                        local_errs[node_idx] = coll_err
+                        for key in step["writes"]:
+                            out_channels[key].write(coll_err, FLAG_ERR)
+                    else:
+                        try:
+                            reduced = _tree_reduce(step["op"], values)
+                            local_vals[node_idx] = reduced
+                            payload = serialization.pack_payload(reduced)
+                            for key in step["writes"]:
+                                out_channels[key].write(payload, FLAG_DATA)
+                        except BaseException as exc:  # noqa: BLE001
+                            import traceback
+                            e_payload = serialization.pack_payload(
+                                TaskError(exc, f"allreduce[{step['op']}]",
+                                          traceback.format_exc()))
+                            local_errs[node_idx] = e_payload
+                            for key in step["writes"]:
+                                out_channels[key].write(e_payload, FLAG_ERR)
+                    continue
                 err: Optional[bytes] = None
                 args: List[Any] = []
                 kwargs: Dict[str, Any] = {}
@@ -145,6 +194,7 @@ class CompiledDAG:
                  submit_timeout: float = 30.0):
         from . import (ClassMethodNode, DAGNode, InputAttributeNode,
                        InputNode, MultiOutputNode)
+        from .collective import CollectiveOutputNode
         self._buffer = buffer_size_bytes
         self._submit_timeout = submit_timeout
         self._lock = threading.Lock()
@@ -182,13 +232,26 @@ class CompiledDAG:
         if len({id(t) for t in terminals}) != len(terminals):
             raise ValueError("duplicate node in MultiOutputNode outputs")
         for t in terminals:
-            if not isinstance(t, ClassMethodNode):
+            if not isinstance(t, (ClassMethodNode, CollectiveOutputNode)):
                 raise ValueError(
-                    "compiled DAG outputs must be actor method calls, got "
-                    f"{type(t).__name__}")
-        compute_nodes = [n for n in order if isinstance(n, ClassMethodNode)]
-        if not compute_nodes:
+                    "compiled DAG outputs must be actor method calls or "
+                    f"collective outputs, got {type(t).__name__}")
+        compute_nodes = [n for n in order
+                         if isinstance(n, (ClassMethodNode,
+                                           CollectiveOutputNode))]
+        if not any(isinstance(n, ClassMethodNode) for n in compute_nodes):
             raise ValueError("DAG contains no actor method calls")
+        # Every output of a collective group must be part of this DAG:
+        # the peer broadcast needs all ranks resident (reference:
+        # collective_node.py binds all participants together).
+        for n in compute_nodes:
+            if isinstance(n, CollectiveOutputNode):
+                for out in n._group.outputs:
+                    if id(out) not in idx_of:
+                        raise ValueError(
+                            "all outputs of a collective group must be "
+                            "consumed by (or be outputs of) the same "
+                            "compiled DAG")
         for n in order:
             if isinstance(n, MultiOutputNode) and n is not output_node:
                 raise ValueError("MultiOutputNode must be the DAG output")
@@ -237,9 +300,48 @@ class CompiledDAG:
                 self._channels[ekey] = ShmChannel(self._buffer)
             return self._channels[ekey]
 
+        planned_groups: set = set()
+        self._peer_keys: set = set()  # collective peer edges; not consumer
         for n in compute_nodes:
             cons_idx = idx_of[id(n)]
             plan = plan_for(n._actor)
+            if isinstance(n, CollectiveOutputNode):
+                # Peer-to-peer broadcast + local reduce (one step per rank;
+                # reference: collective_node.py lowering to NCCL allreduce,
+                # here to pairwise shm channels).
+                group = n._group
+                gid = id(group)
+                out_idx = {r: idx_of[id(group.outputs[r])]
+                           for r in range(len(group.outputs))}
+                if gid not in planned_groups:
+                    planned_groups.add(gid)
+                    for i in range(len(group.outputs)):
+                        for j in range(len(group.outputs)):
+                            if i != j:
+                                pkey = (out_idx[i], out_idx[j])
+                                make_channel(pkey)
+                                self._peer_keys.add(pkey)
+                rank = n._rank
+                contrib = group.inputs[rank]
+                peer_writes = []
+                peer_reads = []
+                for j in range(len(group.outputs)):
+                    if j == rank:
+                        continue
+                    wkey = (out_idx[rank], out_idx[j])
+                    rkey = (out_idx[j], out_idx[rank])
+                    plan["out_channels"][wkey] = self._channels[wkey]
+                    plan["in_channels"][rkey] = self._channels[rkey]
+                    peer_writes.append(wkey)
+                    peer_reads.append(rkey)
+                plan["steps"].append({
+                    "kind": "collective", "node_idx": cons_idx,
+                    "op": group.op,
+                    "input": ("local", idx_of[id(contrib)]),
+                    "peer_writes": peer_writes, "peer_reads": peer_reads,
+                    "args": [], "kwargs": {}, "writes": [],
+                })
+                continue
             arg_specs: List[Tuple[str, Any]] = []
             kwarg_specs: Dict[str, Tuple[str, Any]] = {}
 
@@ -274,8 +376,14 @@ class CompiledDAG:
                 "args": arg_specs, "kwargs": kwarg_specs, "writes": [],
             })
 
-        # Producer "writes" lists: fill after all edges are known.
+        # Producer "writes" lists: fill after all edges are known.  Peer
+        # channels are excluded: the collective step writes CONTRIBUTIONS
+        # into them itself — treating them as consumer edges would push
+        # the reduced value in as well, leaving a stale payload that
+        # deadlocks the next iteration's contribution write.
         for ekey in self._channels:
+            if ekey in self._peer_keys:
+                continue
             prod_idx, cons_idx = ekey
             if prod_idx in actor_of:  # produced by an actor step
                 plan = plan_for(actor_of[prod_idx])

@@ -113,6 +113,19 @@ class DashboardServer:
         async def timeline(req):
             return self._json(rt.ctl_timeline())
 
+        async def node_views(req):
+            # Syncer load views (reference: resource view in the node
+            # table feed).
+            return self._json(rt.ctl_node_views())
+
+        async def logs(req):
+            return self._json(rt.ctl_log_files())
+
+        async def log_tail(req):
+            fname = req.match_info["fname"]
+            n = int(req.query.get("lines", 100))
+            return self._json(rt.ctl_log_tail(fname, n))
+
         async def metrics(req):
             from ..util.metrics import prometheus_text
             return web.Response(text=prometheus_text(),
@@ -131,6 +144,9 @@ class DashboardServer:
         app.router.add_get("/api/placement_groups", pgs)
         app.router.add_get("/api/jobs", jobs)
         app.router.add_get("/api/timeline", timeline)
+        app.router.add_get("/api/node_views", node_views)
+        app.router.add_get("/api/logs", logs)
+        app.router.add_get("/api/logs/{fname}", log_tail)
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/-/healthz", healthz)
 

@@ -102,74 +102,92 @@ class ConnectorPipeline(Connector):
 
 class MeanStdFilter(Connector):
     """Running mean/std observation normalization (reference: rllib's
-    MeanStdFilter connector).  Stats update on every call during
-    exploration; frozen via ``update=False`` for evaluation."""
+    MeanStdFilter connector + its distributed synchronization).
+
+    State is split into a *base* aggregate (the cluster-wide stats as of
+    the last sync) and a local *delta* (samples seen since).  Sync
+    protocol: the group gathers every runner's delta, merges them into the
+    shared base, and broadcasts the new base back — which resets deltas.
+    Merging absolute states instead would re-count the base once per
+    runner per sync (n ~ runners^iterations) and freeze the stats on
+    early data.  Aggregates are (n, mean, m2) Chan et al. triples with
+    O(1) merges.
+    """
 
     def __init__(self, clip: float = 10.0, update: bool = True):
         self.clip = clip
         self.update = update
-        self._n = 0
-        self._mean: Optional[np.ndarray] = None
-        self._m2: Optional[np.ndarray] = None
+        self._base: Optional[tuple] = None   # (n, mean, m2) at last sync
+        self._delta: Optional[tuple] = None  # local since last sync
+
+    @staticmethod
+    def _merge_agg(a: Optional[tuple], b: Optional[tuple]
+                   ) -> Optional[tuple]:
+        if a is None or a[0] == 0:
+            return b
+        if b is None or b[0] == 0:
+            return a
+        na, mean_a, m2_a = a
+        nb, mean_b, m2_b = b
+        n = na + nb
+        d = mean_b - mean_a
+        mean = mean_a + d * (nb / n)
+        m2 = m2_a + m2_b + d ** 2 * (na * nb / n)
+        return (n, mean, m2)
+
+    def _combined(self) -> Optional[tuple]:
+        return self._merge_agg(self._base, self._delta)
+
+    @property
+    def count(self) -> int:
+        agg = self._combined()
+        return 0 if agg is None else int(agg[0])
 
     def __call__(self, batch: np.ndarray) -> np.ndarray:
         batch = np.asarray(batch, np.float32)
-        if self._mean is None:
-            self._mean = np.zeros(batch.shape[-1], np.float64)
-            self._m2 = np.zeros(batch.shape[-1], np.float64)
         if self.update:
-            # Chan et al. parallel-variance merge: one vectorized batch
-            # aggregate folded into the running stats (O(1) merges, not a
-            # per-row Python loop on the rollout hot path).
             rows = batch.reshape(-1, batch.shape[-1]).astype(np.float64)
-            nb = len(rows)
-            if nb:
+            if len(rows):
                 b_mean = rows.mean(axis=0)
                 b_m2 = ((rows - b_mean) ** 2).sum(axis=0)
-                self._n, self._mean, self._m2 = self._merge_agg(
-                    self._n, self._mean, self._m2, nb, b_mean, b_m2)
+                self._delta = self._merge_agg(
+                    self._delta, (len(rows), b_mean, b_m2))
         return self._normalize(batch)
-
-    @staticmethod
-    def _merge_agg(na, mean_a, m2_a, nb, mean_b, m2_b):
-        n = na + nb
-        delta = mean_b - mean_a
-        mean = mean_a + delta * (nb / n)
-        m2 = m2_a + m2_b + delta ** 2 * (na * nb / n)
-        return n, mean, m2
 
     def transform(self, batch: np.ndarray) -> np.ndarray:
-        batch = np.asarray(batch, np.float32)
-        if self._mean is None:
-            return np.clip(batch, -self.clip, self.clip)
-        return self._normalize(batch)
+        return self._normalize(np.asarray(batch, np.float32))
 
     def _normalize(self, batch: np.ndarray) -> np.ndarray:
-        if self._n < 2:
+        agg = self._combined()
+        if agg is None or agg[0] < 2:
             return np.clip(batch, -self.clip, self.clip)
-        std = np.sqrt(self._m2 / (self._n - 1)) + 1e-8
-        out = (batch - self._mean.astype(np.float32)) / std.astype(np.float32)
+        n, mean, m2 = agg
+        std = np.sqrt(m2 / (n - 1)) + 1e-8
+        out = (batch - mean.astype(np.float32)) / std.astype(np.float32)
         return np.clip(out, -self.clip, self.clip).astype(np.float32)
 
     def get_state(self) -> Dict[str, Any]:
-        return {"n": self._n, "mean": self._mean, "m2": self._m2}
+        return {"base": self._base, "delta": self._delta}
 
     def set_state(self, state: Dict[str, Any]) -> None:
-        self._n = state["n"]
-        self._mean = state["mean"]
-        self._m2 = state["m2"]
+        """Install a state verbatim.  Sync broadcasts carry merged states
+        with ``delta=None``, so installing one resets the local delta —
+        its samples are already inside the merged base."""
+        self._base = state.get("base")
+        self._delta = state.get("delta")
 
     def merge_states(self, states: List[Dict[str, Any]]) -> Dict[str, Any]:
-        n, mean, m2 = 0, None, None
+        # Every runner shares the same base after a sync; fold each
+        # runner's delta in exactly once.
+        base = None
         for s in states:
-            if not s or s.get("mean") is None:
-                continue
-            if mean is None:
-                n, mean, m2 = s["n"], s["mean"].copy(), s["m2"].copy()
-            else:
-                n, mean, m2 = self._merge_agg(n, mean, m2,
-                                              s["n"], s["mean"], s["m2"])
-        return {"n": n, "mean": mean, "m2": m2}
+            if s and s.get("base") is not None:
+                base = s["base"]
+                break
+        for s in states:
+            if s:
+                base = self._merge_agg(base, s.get("delta"))
+        return {"base": base, "delta": None}
 
 
 class FrameStack(Connector):

@@ -61,6 +61,16 @@ class EnvRunner:
     def _connect(self, obs: np.ndarray) -> np.ndarray:
         return obs if self.env_to_module is None else self.env_to_module(obs)
 
+    def get_connector_state(self) -> Dict[str, Any]:
+        """Connector stats only — sync_weights must not ship the params
+        pytree driver-ward just to read these."""
+        return {} if self.env_to_module is None \
+            else self.env_to_module.get_state()
+
+    def set_connector_state(self, state: Dict[str, Any]) -> None:
+        if self.env_to_module is not None:
+            self.env_to_module.set_state(state)
+
     # -- weights --------------------------------------------------------- #
 
     def get_state(self) -> Dict[str, Any]:
@@ -218,11 +228,9 @@ class EnvRunnerGroup:
             return
         import ray_tpu
         if self._connector_proto is not None:
-            states = ray_tpu.get([r.get_state.remote()
+            states = ray_tpu.get([r.get_connector_state.remote()
                                   for r in self.remotes])
-            merged = self._connector_proto.merge_states(
-                [s.get("connectors", {}) for s in states])
-            state["connectors"] = merged
+            state["connectors"] = self._connector_proto.merge_states(states)
         ray_tpu.get([r.set_state.remote(state) for r in self.remotes])
 
     def connector_state(self):
@@ -232,9 +240,9 @@ class EnvRunnerGroup:
         if self._connector_proto is None:
             return None
         import ray_tpu
-        states = ray_tpu.get([r.get_state.remote() for r in self.remotes])
-        return self._connector_proto.merge_states(
-            [s.get("connectors", {}) for s in states])
+        states = ray_tpu.get([r.get_connector_state.remote()
+                              for r in self.remotes])
+        return self._connector_proto.merge_states(states)
 
     def aggregate_metrics(self) -> Dict[str, float]:
         if self.local is not None:

@@ -8,6 +8,8 @@ early-stop signals flow through the KV store.
 """
 
 from .search import choice, grid_search, loguniform, randint, uniform
+from .searchers import (BasicVariantSearcher, ConcurrencyLimiter, Repeater,
+                        Searcher, TPESearcher)
 from .tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
                     get_checkpoint, report, TuneStopException)
 from .schedulers import (ASHAScheduler, FIFOScheduler, HyperBandScheduler,
@@ -17,6 +19,8 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
     "get_checkpoint", "TuneStopException",
     "grid_search", "choice", "uniform", "loguniform", "randint",
+    "Searcher", "BasicVariantSearcher", "TPESearcher",
+    "ConcurrencyLimiter", "Repeater",
     "ASHAScheduler", "FIFOScheduler", "MedianStoppingRule",
     "HyperBandScheduler", "PopulationBasedTraining",
 ]

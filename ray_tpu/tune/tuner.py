@@ -87,6 +87,11 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    # Adaptive search algorithm (reference: tune_config.search_alg — e.g.
+    # TPESearcher / ConcurrencyLimiter).  None = grid/random variants from
+    # param_space.  With a search_alg, num_samples is the TOTAL number of
+    # trials and param_space is owned by the searcher.
+    search_alg: Any = None
     seed: int = 0
 
 
@@ -154,14 +159,14 @@ class Tuner:
             ray_tpu.init()
         run_id = uuid.uuid4().hex[:12]
         scheduler = self._cfg.scheduler or FIFOScheduler()
-        variants = generate_variants(self._param_space,
-                                     self._cfg.num_samples, self._cfg.seed)
+        search_alg = self._cfg.search_alg
         fn_blob = serialization.dumps_control(self._trainable)
         run_remote = ray_tpu.remote(_run_trial)
 
         trials: Dict[str, Dict[str, Any]] = {}
         queue = []
-        for cfg in variants:
+
+        def _new_trial(cfg: Dict[str, Any]) -> str:
             tid = uuid.uuid4().hex[:8]
             trials[tid] = {"config": cfg, "ref": None, "history": [],
                            "seen": set(), "ckpt_blob": None, "restarts": 0,
@@ -169,6 +174,14 @@ class Tuner:
             queue.append(tid)
             if hasattr(scheduler, "register_trial"):
                 scheduler.register_trial(tid, cfg)
+            return tid
+
+        if search_alg is None:
+            for cfg in generate_variants(self._param_space,
+                                         self._cfg.num_samples,
+                                         self._cfg.seed):
+                _new_trial(cfg)
+        suggested = 0
 
         in_flight: Dict[Any, str] = {}
         results: List[TrialResult] = []
@@ -196,7 +209,37 @@ class Tuner:
                         _control("kv_put",
                                  f"tune/{run_id}/stop/{kv_tid}", b"1")
 
-        while queue or in_flight:
+        def _searcher_refill():
+            """Ask the search algorithm for more trials (suggest-driven
+            mode; reference: SearchGenerator feeding TuneController)."""
+            nonlocal suggested
+            while suggested < self._cfg.num_samples and \
+                    len(in_flight) + len(queue) < \
+                    self._cfg.max_concurrent_trials:
+                tid = uuid.uuid4().hex[:8]
+                cfg = search_alg.suggest(tid)
+                if cfg is None:
+                    break  # limiter saturated or space exhausted
+                trials[tid] = {"config": cfg, "ref": None, "history": [],
+                               "seen": set(), "ckpt_blob": None,
+                               "restarts": 0, "kv_tid": tid}
+                # NOTE: tid is pre-chosen so the searcher sees the same id
+                # the tuner reports completion with.
+                queue.append(tid)
+                if hasattr(scheduler, "register_trial"):
+                    scheduler.register_trial(tid, cfg)
+                suggested += 1
+
+        if search_alg is not None:
+            _searcher_refill()
+        while queue or in_flight or (
+                search_alg is not None
+                and suggested < self._cfg.num_samples):
+            if search_alg is not None:
+                _searcher_refill()
+                if not queue and not in_flight:
+                    # Limiter blocked with nothing running: cannot progress.
+                    break
             while queue and len(in_flight) < self._cfg.max_concurrent_trials:
                 tid = queue.pop(0)
                 ref = run_remote.options(
@@ -246,6 +289,14 @@ class Tuner:
                     continue
                 last = t["history"][-1] if t["history"] else {}
                 metrics = {**last, **final}
+                if search_alg is not None:
+                    # Searchers minimize; flip for mode="max".
+                    val = metrics.get(self._cfg.metric)
+                    score = None
+                    if val is not None:
+                        score = float(val) if self._cfg.mode == "min" \
+                            else -float(val)
+                    search_alg.on_trial_complete(tid, score)
                 results.append(TrialResult(
                     tid, t["config"], metrics, error, stopped,
                     t["history"], restarts=t["restarts"]))

@@ -253,3 +253,137 @@ class TestTeardownSemantics:
             assert ref.get(timeout=10) == [3, 30]
         finally:
             compiled.teardown()
+
+
+class TestCollectiveNodes:
+    """Allreduce across actor outputs (reference: dag/collective_node.py:23
+    — NCCL allreduce in compiled graphs; here peer-to-peer shm channels
+    with local reduction)."""
+
+    def _workers(self, n=3):
+        import numpy as np
+
+        @ray_tpu.remote
+        class Shard:
+            def __init__(self, scale):
+                self.scale = scale
+
+            def grad(self, x):
+                return np.asarray(x, np.float32) * self.scale
+
+            def norm(self, g):
+                return float(np.sum(g))
+
+        return [Shard.remote(i + 1) for i in range(n)]
+
+    def test_interpreted_allreduce(self, ray_start):
+        import numpy as np
+        from ray_tpu.dag import InputNode, MultiOutputNode, allreduce_bind
+        w = self._workers()
+        with InputNode() as inp:
+            grads = [wi.grad.bind(inp) for wi in w]
+            red = allreduce_bind(grads, op="sum")
+            node = MultiOutputNode(
+                [wi.norm.bind(r) for wi, r in zip(w, red)])
+        vals = ray_tpu.get(node.execute(np.ones(4)))
+        assert vals == [24.0, 24.0, 24.0]
+
+    def test_compiled_allreduce_many_iterations(self, ray_start):
+        import numpy as np
+        from ray_tpu.dag import InputNode, MultiOutputNode, allreduce_bind
+        w = self._workers()
+        with InputNode() as inp:
+            grads = [wi.grad.bind(inp) for wi in w]
+            red = allreduce_bind(grads, op="sum")
+            node = MultiOutputNode(
+                [wi.norm.bind(r) for wi, r in zip(w, red)])
+        dag = node.experimental_compile()
+        try:
+            for trial in range(5):
+                got = dag.execute(np.full(4, trial + 1.0)).get(timeout=30)
+                assert got == [24.0 * (trial + 1)] * 3
+        finally:
+            dag.teardown()
+
+    def test_compiled_mean_over_pytree(self, ray_start):
+        import numpy as np
+        from ray_tpu.dag import InputNode, MultiOutputNode, allreduce_bind
+
+        @ray_tpu.remote
+        class P:
+            def __init__(self, k):
+                self.k = k
+
+            def make(self, x):
+                return {"a": np.full(2, self.k, np.float32),
+                        "b": float(self.k * 10)}
+
+            def read(self, t):
+                return (t["a"].tolist(), t["b"])
+
+        w = [P.remote(1), P.remote(3)]
+        with InputNode() as inp:
+            parts = [wi.make.bind(inp) for wi in w]
+            red = allreduce_bind(parts, op="mean")
+            node = MultiOutputNode(
+                [wi.read.bind(r) for wi, r in zip(w, red)])
+        dag = node.experimental_compile()
+        try:
+            got = dag.execute(0).get(timeout=30)
+            assert got == [([2.0, 2.0], 20.0), ([2.0, 2.0], 20.0)]
+        finally:
+            dag.teardown()
+
+    def test_validation(self, ray_start):
+        import numpy as np
+        from ray_tpu.dag import InputNode, allreduce_bind
+        w = self._workers(2)
+        with InputNode() as inp:
+            g0 = w[0].grad.bind(inp)
+            g1 = w[1].grad.bind(inp)
+            same = w[0].grad.bind(inp)
+        # distinct actors required
+        with pytest.raises(ValueError, match="distinct actors"):
+            allreduce_bind([g0, same])
+        with pytest.raises(ValueError, match="participants"):
+            allreduce_bind([g0])
+        with pytest.raises(ValueError, match="unsupported"):
+            allreduce_bind([g0, g1], op="xor")
+        # all outputs must be in the compiled DAG
+        red = allreduce_bind([g0, g1], op="sum")
+        only = w[0].norm.bind(red[0])
+        with pytest.raises(ValueError, match="outputs of a collective"):
+            only.experimental_compile()
+
+    def test_error_propagates_through_collective(self, ray_start):
+        import numpy as np
+        from ray_tpu.dag import InputNode, MultiOutputNode, allreduce_bind
+
+        @ray_tpu.remote
+        class Flaky:
+            def __init__(self, fail):
+                self.fail = fail
+
+            def grad(self, x):
+                if self.fail and x > 1:
+                    raise RuntimeError("shard exploded")
+                return np.ones(2, np.float32)
+
+            def norm(self, g):
+                return float(np.sum(g))
+
+        w = [Flaky.remote(False), Flaky.remote(True)]
+        with InputNode() as inp:
+            grads = [wi.grad.bind(inp) for wi in w]
+            red = allreduce_bind(grads, op="sum")
+            node = MultiOutputNode(
+                [wi.norm.bind(r) for wi, r in zip(w, red)])
+        dag = node.experimental_compile()
+        try:
+            assert dag.execute(0).get(timeout=30) == [4.0, 4.0]
+            with pytest.raises(ray_tpu.TaskError, match="shard exploded"):
+                dag.execute(5).get(timeout=30)
+            # Pipeline stays usable after the error iteration.
+            assert dag.execute(1).get(timeout=30) == [4.0, 4.0]
+        finally:
+            dag.teardown()

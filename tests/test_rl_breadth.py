@@ -166,9 +166,9 @@ class TestConnectors:
         assert abs(float(normed.mean())) < 0.1
         assert abs(float(normed.std()) - 1.0) < 0.1
         # transform() does not advance the stats.
-        n_before = f.get_state()["n"]
+        n_before = f.count
         f.transform(data)
-        assert f.get_state()["n"] == n_before
+        assert f.count == n_before == 200
 
     def test_framestack_shapes_and_transform(self):
         fs = FrameStack(3)
@@ -208,11 +208,29 @@ class TestConnectors:
         merged = a.merge_states([a.get_state(), b.get_state()])
         whole = MeanStdFilter()
         whole(all_data)
-        np.testing.assert_allclose(merged["mean"],
-                                   whole.get_state()["mean"], rtol=1e-6)
-        np.testing.assert_allclose(merged["m2"],
-                                   whole.get_state()["m2"], rtol=1e-6)
-        assert merged["n"] == 400
+        n, mean, m2 = merged["base"]
+        wn, wmean, wm2 = whole._combined()
+        assert n == wn == 400
+        np.testing.assert_allclose(mean, wmean, rtol=1e-6)
+        np.testing.assert_allclose(m2, wm2, rtol=1e-6)
+
+    def test_meanstd_sync_does_not_double_count(self):
+        """Sync round-trips must not re-count the shared base (the
+        n ~ runners^iterations blowup)."""
+        rng = np.random.default_rng(1)
+        r1, r2 = MeanStdFilter(), MeanStdFilter()
+        proto = MeanStdFilter()
+        total = 0
+        for _ in range(5):  # five sync rounds
+            d1 = rng.normal(size=(30, 2)).astype(np.float32)
+            d2 = rng.normal(size=(50, 2)).astype(np.float32)
+            r1(d1)
+            r2(d2)
+            total += 80
+            merged = proto.merge_states([r1.get_state(), r2.get_state()])
+            r1.set_state(merged)
+            r2.set_state(merged)
+            assert r1.count == r2.count == total
 
     def test_state_sync_roundtrip(self):
         p1 = ConnectorPipeline([MeanStdFilter()])
