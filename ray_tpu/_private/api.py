@@ -234,11 +234,19 @@ def _normalize_strategy(options: Dict[str, Any]):
     return strategy, pg, bundle
 
 
+def _fn_id_of(blob: bytes) -> bytes:
+    """Stable function id = content hash of the pickled function
+    (reference: function table keys are function hashes)."""
+    import hashlib
+    return hashlib.blake2b(blob, digest_size=16).digest()
+
+
 class RemoteFunction:
     def __init__(self, fn, **default_options):
         self._fn = fn
         self._options = default_options
         self._fn_blob: Optional[bytes] = None
+        self._fn_id: Optional[bytes] = None
         functools.update_wrapper(self, fn)
 
     def options(self, **options) -> "RemoteFunction":
@@ -246,6 +254,7 @@ class RemoteFunction:
         merged.update(options)
         rf = RemoteFunction(self._fn, **merged)
         rf._fn_blob = self._fn_blob
+        rf._fn_id = self._fn_id
         return rf
 
     def __call__(self, *args, **kwargs):
@@ -258,6 +267,7 @@ class RemoteFunction:
         opts = self._options
         if self._fn_blob is None:
             self._fn_blob = serialization.dumps_control(self._fn)
+            self._fn_id = _fn_id_of(self._fn_blob)
         num_returns = opts.get("num_returns", 1)
         streaming = num_returns == "streaming"
         task_id = _next_task_id()
@@ -279,7 +289,7 @@ class RemoteFunction:
             placement_group=pg, bundle_index=bundle,
             scheduling_strategy=strategy,
             runtime_env=_prepare_env(opts.get("runtime_env")),
-            streaming=streaming)
+            streaming=streaming, fn_id=self._fn_id)
         rt.submit_spec(spec)
         if streaming:
             return ObjectRefGenerator(task_id)

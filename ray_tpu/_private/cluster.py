@@ -251,6 +251,25 @@ def desc_object_id(desc) -> Optional[ObjectID]:
 # data plane: per-node object server + pull client
 # --------------------------------------------------------------------------
 
+def _drain_acceptor(listener, thread) -> None:
+    """Unblock a thread sitting in ``listener.accept()`` and join it BEFORE
+    closing the listener: closing the fd under a blocked accept lets the
+    OS hand the fd number to a newer listener, whose handshakes the stale
+    thread then steals and fails with its old authkey."""
+    if thread is None or not thread.is_alive():
+        return
+    try:
+        addr = listener.address
+        s = socket.socket(socket.AF_INET)
+        s.settimeout(1.0)
+        host = addr[0] if addr[0] not in ("0.0.0.0", "") else "127.0.0.1"
+        s.connect((host, addr[1]))
+        s.close()
+    except OSError:
+        pass
+    thread.join(timeout=3.0)
+
+
 class DataServer:
     """Serves raw object payloads out of the local store (push side of the
     reference's PushManager, reference: push_manager.h:28 — one message per
@@ -265,8 +284,9 @@ class DataServer:
         self.address: Tuple[str, int] = (advertise_host,
                                          self._listener.address[1])
         self._closed = False
-        threading.Thread(target=self._accept_loop, name="data-server",
-                         daemon=True).start()
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          name="data-server", daemon=True)
+        self._acceptor.start()
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -276,6 +296,12 @@ class DataServer:
                 if self._closed:
                     return
                 continue
+            if self._closed:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                return
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -295,6 +321,7 @@ class DataServer:
 
     def shutdown(self) -> None:
         self._closed = True
+        _drain_acceptor(self._listener, self._acceptor)
         try:
             self._listener.close()
         except Exception:
@@ -529,8 +556,9 @@ class HeadServer:
         self.proxies: Dict[NodeID, RemoteNodeProxy] = {}
         self._lock = threading.Lock()
         self._closed = False
-        threading.Thread(target=self._accept_loop, name="head-accept",
-                         daemon=True).start()
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          name="head-accept", daemon=True)
+        self._acceptor.start()
         threading.Thread(target=self._ping_loop, name="head-ping",
                          daemon=True).start()
 
@@ -544,6 +572,12 @@ class HeadServer:
                 if self._closed:
                     return
                 continue
+            if self._closed:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                return
             threading.Thread(target=self._register, args=(conn,),
                              daemon=True).start()
 
@@ -773,6 +807,7 @@ class HeadServer:
             self.proxies.clear()
         for p in proxies:
             p.shutdown()
+        _drain_acceptor(self._listener, self._acceptor)
         try:
             self._listener.close()
         except Exception:

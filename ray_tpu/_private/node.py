@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import random
+import socket
 import subprocess
 import sys
 import tempfile
@@ -58,6 +59,9 @@ class WorkerHandle:
     dedicated: bool = False
     env_key: str = ""
     death_reason: str = ""
+    # fn_ids whose blobs this worker has already received — later specs
+    # ship without the blob (reference: function-table export-once).
+    seen_fns: Set[bytes] = field(default_factory=set)
     running: Set[TaskID] = field(default_factory=set)
     # task_id -> (start_monotonic, retriable) for the OOM kill policy.
     task_meta: Dict[TaskID, Any] = field(default_factory=dict)
@@ -65,6 +69,9 @@ class WorkerHandle:
     ready: threading.Event = field(default_factory=threading.Event)
     send_lock: threading.Lock = field(default_factory=threading.Lock)
     assigned_chips: Dict[TaskID, List[int]] = field(default_factory=dict)
+    # Messages queued before the worker registered (async spawn): flushed
+    # in order by the acceptor as soon as the connection lands.
+    pending_msgs: List[Any] = field(default_factory=list)
     # Arena-store pin bookkeeping (native store only; see object_store.py):
     # args pinned for in-flight tasks, pins from outstanding GetReplies, pins
     # promoted to worker lifetime (actor-retained views), unsealed allocs.
@@ -96,6 +103,15 @@ class NodeManager:
         self._authkey = os.urandom(16)
         self._listener = Listener(self._sock_path, "AF_UNIX",
                                   authkey=self._authkey)
+        # One multiplexed poller over every worker connection instead of a
+        # reader thread per worker (reference: asio io_service event loops)
+        # — N reader threads ping-ponging the GIL with the dispatch thread
+        # measurably halved task throughput at 8+ workers.
+        self._poll_conns: Dict[Any, WorkerHandle] = {}
+        self._poll_wake_r, self._poll_wake_w = os.pipe()
+        self._poller = threading.Thread(target=self._poll_loop,
+                                        name="node-poller", daemon=True)
+        self._poller.start()
         self._acceptor = threading.Thread(target=self._accept_loop,
                                           name="node-acceptor", daemon=True)
         self._acceptor.start()
@@ -123,10 +139,21 @@ class NodeManager:
         while not self._closed:
             try:
                 conn = self._listener.accept()
-            except (OSError, EOFError):
+            except Exception:  # noqa: BLE001
+                # Covers OSError/EOFError AND AuthenticationError: a worker
+                # SIGKILLed mid-handshake (OOM kill, ray_tpu.kill, chaos)
+                # leaves a half-written challenge response — the accept
+                # loop must survive it or no worker can ever register
+                # again.
                 if self._closed:
                     return
                 continue
+            if self._closed:
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                return
             try:
                 hello: WorkerReady = conn.recv()
             except (EOFError, OSError):
@@ -137,13 +164,69 @@ class NodeManager:
             if handle is None:
                 conn.close()
                 continue
-            handle.conn = conn
-            reader = threading.Thread(
-                target=self._reader_loop, args=(handle,),
-                name=f"reader-{hello.worker_id.hex()[:8]}", daemon=True)
-            handle.reader = reader
+            # Install the connection and flush messages dispatched while
+            # the worker was still booting (async spawn), preserving order
+            # against concurrent _send calls via the send lock.
+            with handle.send_lock:
+                handle.conn = conn
+                for m in handle.pending_msgs:
+                    try:
+                        conn.send(m)
+                    except (BrokenPipeError, OSError):
+                        break
+                handle.pending_msgs.clear()
             handle.ready.set()
-            reader.start()
+            with self._lock:
+                self._poll_conns[conn] = handle
+            self._wake_poller()
+
+    def _wake_poller(self) -> None:
+        try:
+            os.write(self._poll_wake_w, b"x")
+        except OSError:
+            pass
+
+    def _poll_loop(self) -> None:
+        """Single event loop over all worker pipes (reference: the
+        raylet's asio loop servicing every worker connection).
+
+        Known tradeoff: recv() after readability is frame-blocking, so a
+        worker stopped mid-frame (SIGSTOP) would stall the loop — the
+        per-worker-thread model confined that to one worker but cost ~2x
+        task throughput in GIL ping-pong.  True non-blocking framing
+        belongs in the native transport when this pipe moves to C++.
+        """
+        from multiprocessing.connection import wait as _mpwait
+        while not self._closed:
+            with self._lock:
+                conns = list(self._poll_conns)
+            try:
+                ready = _mpwait(conns + [self._poll_wake_r], timeout=1.0)
+            except OSError:
+                ready = []
+            for c in ready:
+                if c is self._poll_wake_r:
+                    try:
+                        os.read(self._poll_wake_r, 4096)
+                    except OSError:
+                        pass
+                    continue
+                with self._lock:
+                    handle = self._poll_conns.get(c)
+                if handle is None:
+                    continue
+                try:
+                    msg = c.recv()
+                except (EOFError, OSError):
+                    with self._lock:
+                        self._poll_conns.pop(c, None)
+                    self._on_worker_death(handle)
+                    continue
+                try:
+                    self._handle_msg(handle, msg)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
 
     def _spawn_worker(self, env: Optional[Dict[str, str]] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
@@ -192,9 +275,44 @@ class NodeManager:
         handle = WorkerHandle(worker_id, proc, None)
         with self._lock:
             self._workers[worker_id] = handle
-        if not handle.ready.wait(Config.get("worker_register_timeout_s")):
-            raise RuntimeError("worker failed to register in time")
+        # Async spawn: dispatches queue in pending_msgs and the task starts
+        # the moment the worker registers — the dispatch thread never
+        # blocks on interpreter boot.  A watchdog converts a never-
+        # registering worker into the normal death path (queued tasks
+        # retry elsewhere).
+        def _watchdog(h=handle):
+            if not h.ready.is_set():
+                self._kill_and_reap(h)
+        t = threading.Timer(Config.get("worker_register_timeout_s"),
+                            _watchdog)
+        t.daemon = True
+        t.start()
         return handle
+
+    def _kill_and_reap(self, handle: WorkerHandle) -> None:
+        """SIGKILL a worker and guarantee its death handler runs.
+
+        A worker killed before (or during) registration produces no pipe
+        EOF for the poller, so reap explicitly: wait for the process, give
+        the EOF path a moment, then run the (idempotent) death handler if
+        it hasn't fired.  Shared by OOM kills, forced actor kills and the
+        registration watchdog so the three paths cannot drift.
+        """
+        try:
+            if handle.proc.poll() is None:
+                handle.proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
+
+        def _reap(h=handle):
+            try:
+                h.proc.wait(timeout=60)
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(1.0)
+            if h.state != DEAD:
+                self._on_worker_death(h)
+        threading.Thread(target=_reap, daemon=True).start()
 
     def _acquire_worker(self, env_key: str = "",
                         env: Optional[Dict[str, str]] = None) -> WorkerHandle:
@@ -328,6 +446,15 @@ class NodeManager:
             import copy as _copy
             spec = _copy.copy(spec)
             spec.runtime_env = dict(spec.runtime_env or {}, env_vars=env_vars)
+        if spec.fn_id is not None and spec.fn_blob is not None:
+            if spec.fn_id in handle.seen_fns:
+                # Worker already holds this function: ship the spec without
+                # the blob (workers fall back to a ctl fetch on a miss).
+                import copy as _copy
+                spec = _copy.copy(spec)
+                spec.fn_blob = None
+            else:
+                handle.seen_fns.add(spec.fn_id)
         if self._native_store:
             # Refresh + pin arena-resident args so their offsets stay valid
             # for the task's lifetime (plasma client-pin semantics).
@@ -429,6 +556,10 @@ class NodeManager:
             return  # chaos: message dropped
         try:
             with handle.send_lock:
+                if handle.conn is None:
+                    # Worker still booting (async spawn): queue in order.
+                    handle.pending_msgs.append(msg)
+                    return
                 handle.conn.send(msg)
         except (BrokenPipeError, OSError):
             pass  # reader loop will notice the death
@@ -440,20 +571,6 @@ class NodeManager:
             self._send(handle, msg)
 
     # -- receive ------------------------------------------------------------
-
-    def _reader_loop(self, handle: WorkerHandle) -> None:
-        conn = handle.conn
-        while True:
-            try:
-                msg = conn.recv()
-            except (EOFError, OSError):
-                break
-            try:
-                self._handle_msg(handle, msg)
-            except Exception:
-                import traceback
-                traceback.print_exc()
-        self._on_worker_death(handle)
 
     def _handle_msg(self, handle: WorkerHandle, msg) -> None:
         rt = self.runtime
@@ -600,11 +717,7 @@ class NodeManager:
             bucket = self._idle.get(handle.env_key)
             if bucket and handle.worker_id in bucket:
                 bucket.remove(handle.worker_id)
-        try:
-            if handle.proc.poll() is None:
-                handle.proc.kill()
-        except Exception:  # noqa: BLE001
-            pass
+        self._kill_and_reap(handle)
 
     # -- misc ---------------------------------------------------------------
 
@@ -618,7 +731,7 @@ class NodeManager:
             # preemption-notifier SIGTERM handler that swallows the signal,
             # which would leave the "killed" actor training forever and its
             # resources never released.
-            handle.proc.kill()
+            self._kill_and_reap(handle)
         else:
             self._send(handle, KillWorker("actor killed"))
 
@@ -661,10 +774,32 @@ class NodeManager:
     def shutdown(self) -> None:
         self._closed = True
         self.memory_monitor.stop()
-        self.cgroup.cleanup()
+        self._wake_poller()
+        # The acceptor must be OUT of accept() before the listener fd is
+        # closed: a thread blocked in accept() on a closed fd can adopt
+        # the fd number when the OS reuses it for a NEW runtime's listener
+        # — it then steals that runtime's worker handshakes and rejects
+        # them with this (stale) authkey.  Wake it with a dummy connect,
+        # join, then close.  The poller gets the same treatment for its
+        # wake-pipe fds (the wake write above kicks it; _closed ends it).
+        if self._acceptor.is_alive():
+            try:
+                s = socket.socket(socket.AF_UNIX)
+                s.settimeout(1.0)
+                s.connect(self._sock_path)
+                s.close()
+            except OSError:
+                pass
+            self._acceptor.join(timeout=3.0)
+        self._poller.join(timeout=3.0)
         try:
             self._listener.close()
         except Exception:
+            pass
+        try:
+            os.close(self._poll_wake_w)
+            os.close(self._poll_wake_r)
+        except OSError:
             pass
         with self._lock:
             handles = list(self._workers.values())
@@ -683,4 +818,7 @@ class NodeManager:
                 h.proc.wait(timeout=2)
             except subprocess.TimeoutExpired:
                 h.proc.kill()
+        # Cleanup only after the workers are dead: rmdir on a cgroup with
+        # live members fails EBUSY and strands the tree.
+        self.cgroup.cleanup()
         self.store.shutdown()

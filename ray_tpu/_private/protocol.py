@@ -53,6 +53,11 @@ class TaskSpec:
     # ObjectID.of(task_id, i); a ("end",) marker closes the stream
     # (reference: ObjectRefStream, src/ray/core_worker/task_manager.h:86).
     streaming: bool = False
+    # Stable identity of fn_blob (reference: the GCS function table —
+    # functions are exported once and referenced by id).  When set, the
+    # node strips fn_blob for workers that have already received it, and
+    # workers reuse the unpickled callable instead of re-loading per task.
+    fn_id: Optional[bytes] = None
 
 
 @dataclass

@@ -243,6 +243,8 @@ class Runtime:
 
         self._running: Dict[TaskID, _RunningTask] = {}
         self._running_lock = threading.Lock()
+        # fn_id -> pickled function (reference: GCS function table).
+        self._fn_table: Dict[bytes, bytes] = {}
         # Syncer receiver state: node -> (version, view, recv_time).
         self._node_views: Dict[NodeID, tuple] = {}
         self._node_views_lock = threading.Lock()
@@ -768,6 +770,11 @@ class Runtime:
     # ------------------------------------------------------------------ #
 
     def submit_spec(self, spec: TaskSpec) -> None:
+        if spec.fn_id is not None and spec.fn_blob is not None and \
+                spec.fn_id not in self._fn_table:
+            # Function table (reference: GCS function_manager): workers
+            # fetch by id when a stripped spec misses their local cache.
+            self._fn_table[spec.fn_id] = spec.fn_blob
         for oid in spec.return_ids:
             self._state(oid)
         self._retain_deps(spec)
@@ -1558,6 +1565,9 @@ class Runtime:
         return [{"job_id": j.job_id.hex(), "start_time": j.start_time,
                  "end_time": j.end_time, "entrypoint": j.entrypoint}
                 for j in self.controller.jobs.values()]
+
+    def ctl_get_fn_blob(self, fn_id: bytes):
+        return self._fn_table.get(fn_id)
 
     def ctl_log_files(self):
         """Session log files + sizes (reference: state API list_logs)."""

@@ -247,6 +247,10 @@ class WorkerRuntime:
 class WorkerLoop:
     def __init__(self, conn, worker_id: WorkerID, job_id):
         self.runtime = WorkerRuntime(conn, worker_id, job_id)
+        # fn_id -> unpickled callable (reference: worker-side function
+        # cache over the GCS function table) — repeated tasks on the same
+        # function skip both the blob bytes on the wire and the unpickle.
+        self._fn_cache: Dict[bytes, Any] = {}
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
         self._executor = ThreadPoolExecutor(
@@ -259,6 +263,25 @@ class WorkerLoop:
         # Shm segments backing zero-copy views that an actor may retain in
         # its state must outlive the task that mapped them.
         self._actor_keepalives: List = []
+
+    def _load_fn(self, spec) -> Any:
+        """Resolve the task's callable: cached by fn_id, blob from the
+        spec, or fetched from the driver's function table (stripped spec
+        raced a lost first delivery)."""
+        if spec.fn_id is None:
+            return serialization.loads_control(spec.fn_blob)
+        fn = self._fn_cache.get(spec.fn_id)
+        if fn is None:
+            blob = spec.fn_blob
+            if blob is None:
+                blob = self.runtime.control("get_fn_blob", spec.fn_id)
+                if blob is None:
+                    raise RuntimeError(
+                        f"function {spec.fn_id.hex()} not in the driver "
+                        "function table")
+            fn = serialization.loads_control(blob)
+            self._fn_cache[spec.fn_id] = fn
+        return fn
 
     # -- task execution -----------------------------------------------------
 
@@ -292,7 +315,7 @@ class WorkerLoop:
                       for k, d in msg.resolved_kwargs.items()}
             if spec.create_actor_id is not None:
                 try:
-                    cls = serialization.loads_control(spec.fn_blob)
+                    cls = self._load_fn(spec)
                     self.actor_instance = cls(*args, **kwargs)
                 except BaseException as init_exc:  # noqa: BLE001
                     self._actor_init_error = init_exc
@@ -329,7 +352,7 @@ class WorkerLoop:
                 # ("end",) marker closes the stream, and a mid-stream
                 # exception lands as an err descriptor at the failing
                 # index so the consumer raises at the right position.
-                fn = serialization.loads_control(spec.fn_blob)
+                fn = self._load_fn(spec)
                 count = 0
                 try:
                     for item in fn(*args, **kwargs):
@@ -348,7 +371,7 @@ class WorkerLoop:
                                     ("end",)))
                 value_list = []
             else:
-                fn = serialization.loads_control(spec.fn_blob)
+                fn = self._load_fn(spec)
                 out = fn(*args, **kwargs)
                 value_list = self._split_returns(out, spec)
             for oid, value in zip(spec.return_ids, value_list):

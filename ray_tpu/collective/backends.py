@@ -68,10 +68,10 @@ class _SocketP2P:
     ride ppermute inside jit — this path carries host-side numpy.
     """
 
-    def __init__(self, group_name: str, rank: int, token: bytes):
+    def __init__(self, group_name: str, rank: int):
         self.group = group_name
         self.rank = rank
-        self.token = token
+        self.token: bytes = b""
         self._listener = None
         self._out: dict = {}          # dst rank -> Connection
         self._in_queues: dict = {}    # src rank -> queue.Queue
@@ -83,12 +83,28 @@ class _SocketP2P:
     def _addr_key(self, rank: int) -> str:
         return f"collective/{self.group}/p2p_addr/{rank}"
 
+    def _ensure_token(self) -> None:
+        """Group transport secret, minted by rank 0 and distributed over
+        the cluster's authenticated control channel (the KV store) — the
+        listener unpickles peer frames, so a guessable key would be remote
+        code execution for anyone who can reach the port."""
+        if self.token:
+            return
+        key = f"collective/{self.group}/p2p_token"
+        if self.rank == 0:
+            import os as _os
+            self.token = _os.urandom(16)
+            _kv_put(key, self.token)
+        else:
+            self.token = bytes(_wait_for(key))
+
     def ensure_listener(self) -> None:
         if self._listener is not None:
             return
         import os
         import threading
         from multiprocessing.connection import Listener
+        self._ensure_token()
         self._qlock = threading.Lock()
         # Bind the wildcard but advertise a peer-reachable host so ranks
         # on different nodes can connect (same convention as the cluster
@@ -97,8 +113,10 @@ class _SocketP2P:
         advertise = os.environ.get("RAY_TPU_ADVERTISE_HOST", "127.0.0.1")
         _kv_put(self._addr_key(self.rank),
                 pickle.dumps((advertise, self._listener.address[1])))
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name=f"p2p-accept-{self.group}-{self.rank}").start()
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"p2p-accept-{self.group}-{self.rank}")
+        self._acceptor.start()
 
     def _accept_loop(self) -> None:
         import threading
@@ -106,6 +124,16 @@ class _SocketP2P:
             try:
                 conn = self._listener.accept()
             except Exception:
+                # A peer dying mid-handshake must not kill the accept
+                # loop; only exit when this endpoint is closing.
+                if self._closed:
+                    return
+                continue
+            if self._closed:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
                 return
             threading.Thread(target=self._reader, args=(conn,),
                              daemon=True).start()
@@ -125,6 +153,7 @@ class _SocketP2P:
         from multiprocessing.connection import Client
         conn = self._out.get(dst_rank)
         if conn is None:
+            self._ensure_token()
             addr = pickle.loads(_wait_for(self._addr_key(dst_rank)))
             conn = Client(tuple(addr), authkey=self.token)
             self._out[dst_rank] = conn
@@ -150,11 +179,18 @@ class _SocketP2P:
             except Exception:
                 pass
         if self._listener is not None:
+            # Unblock + join the acceptor before closing the fd (see
+            # cluster._drain_acceptor: a blocked accept on a closed fd can
+            # adopt a reused fd and steal a newer listener's handshakes).
+            from .._private.cluster import _drain_acceptor
+            _drain_acceptor(self._listener, self._acceptor)
             try:
                 self._listener.close()
             except Exception:
                 pass
             _kv_del(self._addr_key(self.rank))
+        if self.rank == 0 and self.token:
+            _kv_del(f"collective/{self.group}/p2p_token")
 
 
 class XlaBackend:
@@ -175,8 +211,7 @@ class XlaBackend:
         # (kind, op, shape, dtype) -> compiled fn.  jit caches by callable
         # identity, so fresh lambdas per call would re-trace every op.
         self._jit_cache: dict = {}
-        self._p2p = _SocketP2P(group_name, rank,
-                               b"rt-p2p-" + group_name.encode())
+        self._p2p = _SocketP2P(group_name, rank)
 
     def setup(self) -> None:
         # Open the p2p listener up-front so a peer's first send never has
@@ -318,8 +353,7 @@ class KVBackend:
         self.group_name = group_name
         self._seq = 0
         self._nonce = ""
-        self._p2p = _SocketP2P(group_name, rank,
-                               b"rt-p2p-" + group_name.encode())
+        self._p2p = _SocketP2P(group_name, rank)
 
     def setup(self) -> None:
         self._p2p.ensure_listener()
