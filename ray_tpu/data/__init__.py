@@ -2,12 +2,12 @@
 equivalent)."""
 
 from .block import Block, BlockAccessor
-from .dataset import (Dataset, from_items, from_numpy, from_pandas, range,
-                      read_csv, read_json, read_parquet)
+from .dataset import (Dataset, GroupedDataset, from_items, from_numpy,
+                      from_pandas, range, read_csv, read_json, read_parquet)
 from .iterator import device_put_iterator, iter_batches
 
 __all__ = [
-    "Dataset", "Block", "BlockAccessor", "range", "from_items",
-    "from_numpy", "from_pandas", "read_parquet", "read_csv", "read_json",
-    "iter_batches", "device_put_iterator",
+    "Dataset", "GroupedDataset", "Block", "BlockAccessor", "range",
+    "from_items", "from_numpy", "from_pandas", "read_parquet", "read_csv",
+    "read_json", "iter_batches", "device_put_iterator",
 ]

@@ -10,6 +10,7 @@ through worker tasks with bounded in-flight backpressure.
 
 from __future__ import annotations
 
+import builtins as _builtins
 import itertools
 from dataclasses import dataclass
 from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
@@ -150,6 +151,76 @@ class Dataset:
             return out
         return self._with_stage(Stage(f"add_column({name})", apply))
 
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed sort: sample -> range partition -> per-block sort;
+        global order is the block order (reference: Dataset.sort over
+        planner/exchange/sort_task_spec.py)."""
+        return self._with_stage(Stage(
+            f"sort[{key}]", lambda b: b,
+            kind=f"sort:{key}:{int(descending)}"))
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        """reference: Dataset.groupby -> GroupedData (grouped_data.py)."""
+        return GroupedDataset(self, key)
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows; consumes the stream only as far as needed
+        (reference: Dataset.limit)."""
+        from . import executor
+        out: List[Any] = []
+        count = 0
+        for b in executor.execute_streaming(self):
+            blk = executor.fetch(b)
+            r = BlockAccessor(blk).num_rows()
+            if count + r >= n:
+                out.append(BlockAccessor(blk).slice(0, n - count))
+                count = n
+                break
+            if r:
+                out.append(blk)
+                count += r
+        return Dataset(out, [], self._parallelism)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (reference: Dataset.union).  Operand plans
+        execute independently; the union is over their output blocks."""
+        sources = list(self.materialize()._source)
+        for o in others:
+            sources.extend(o.materialize()._source)
+        return Dataset(sources, [], self._parallelism)
+
+    # -- writes (reference: data write_api / datasource writers) ---------- #
+
+    def _write(self, path: str, writer: Callable[[Block, str], None],
+               ext: str) -> List[str]:
+        import os
+
+        from . import executor
+        os.makedirs(path, exist_ok=True)
+        import ray_tpu
+        write_remote = ray_tpu.remote(_write_block) \
+            if ray_tpu.is_initialized() else None
+        outs = []
+        for i, b in enumerate(executor.execute_streaming(self)):
+            fname = os.path.join(path, f"part-{i:05d}.{ext}")
+            if write_remote is not None:
+                outs.append(write_remote.remote(writer, b, fname))
+            else:
+                _write_block(writer, executor.fetch(b), fname)
+                outs.append(fname)
+        if write_remote is not None:
+            outs = ray_tpu.get(outs, timeout=600)
+        return outs
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, _parquet_writer, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, _csv_writer, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, _json_writer, "json")
+
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         return self._with_stage(Stage("random_shuffle", None,  # type: ignore
                                       kind=f"shuffle:{seed}"))
@@ -265,3 +336,136 @@ def read_csv(paths, parallelism: int = 8) -> Dataset:
 
 def read_json(paths, parallelism: int = 8) -> Dataset:
     return Dataset.read_json(paths, parallelism)
+
+
+# --------------------------------------------------------------------- #
+# grouped datasets (reference: python/ray/data/grouped_data.py)
+# --------------------------------------------------------------------- #
+
+_AGG_OPS = ("count", "sum", "mean", "min", "max", "std")
+
+
+def _agg_block(key: str, aggs: Dict[str, tuple], block: Block) -> Block:
+    """Per-reduce-block aggregation: after the hash exchange every key
+    lives wholly in one block, so local aggregates are global."""
+    if not block or BlockAccessor(block).num_rows() == 0:
+        return {}
+    uniq, inv = np.unique(block[key], return_inverse=True)
+    out: Block = {key: uniq}
+    for name, (col, op) in aggs.items():
+        if op == "count":
+            out[name] = np.bincount(inv, minlength=len(uniq))
+            continue
+        vals = np.asarray(block[col], np.float64)
+        sums = np.bincount(inv, weights=vals, minlength=len(uniq))
+        counts = np.bincount(inv, minlength=len(uniq))
+        if op == "sum":
+            out[name] = sums
+        elif op == "mean":
+            out[name] = sums / np.maximum(counts, 1)
+        elif op == "std":
+            sq = np.bincount(inv, weights=vals * vals, minlength=len(uniq))
+            mean = sums / np.maximum(counts, 1)
+            var = sq / np.maximum(counts, 1) - mean * mean
+            out[name] = np.sqrt(np.maximum(var, 0.0))
+        elif op == "min":
+            acc = np.full(len(uniq), np.inf)
+            np.minimum.at(acc, inv, vals)
+            out[name] = acc
+        elif op == "max":
+            acc = np.full(len(uniq), -np.inf)
+            np.maximum.at(acc, inv, vals)
+            out[name] = acc
+        else:
+            raise ValueError(f"unknown aggregate op {op!r}")
+    return out
+
+
+def _map_groups_block(key: str, fn: Callable[[Block], Block],
+                      block: Block) -> Block:
+    if not block or BlockAccessor(block).num_rows() == 0:
+        return {}
+    uniq, inv = np.unique(block[key], return_inverse=True)
+    acc = BlockAccessor(block)
+    pieces = []
+    for g in _builtins.range(len(uniq)):
+        sub = acc.take(np.nonzero(inv == g)[0])
+        res = fn(sub)
+        if res and BlockAccessor(res).num_rows():
+            pieces.append(res)
+    return BlockAccessor.concat(pieces) if pieces else {}
+
+
+class GroupedDataset:
+    """reference: GroupedData — aggregate/map_groups over a key."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _exchanged(self) -> Dataset:
+        return self._ds._with_stage(Stage(
+            f"groupby[{self._key}]", lambda b: b,
+            kind=f"groupshuffle:{self._key}"))
+
+    def aggregate(self, **aggs: tuple) -> Dataset:
+        """``aggregate(total=("value", "sum"), n=("value", "count"))`` —
+        one output row per key, sorted by key within each block."""
+        for name, (col, op) in aggs.items():
+            if op not in _AGG_OPS:
+                raise ValueError(
+                    f"{name}: unknown op {op!r}; one of {_AGG_OPS}")
+        key = self._key
+        frozen = dict(aggs)
+        return self._exchanged()._with_stage(Stage(
+            "aggregate", lambda b: _agg_block(key, frozen, b)))
+
+    def count(self) -> Dataset:
+        return self.aggregate(count=(self._key, "count"))
+
+    def sum(self, col: str) -> Dataset:
+        return self.aggregate(**{f"sum({col})": (col, "sum")})
+
+    def mean(self, col: str) -> Dataset:
+        return self.aggregate(**{f"mean({col})": (col, "mean")})
+
+    def min(self, col: str) -> Dataset:
+        return self.aggregate(**{f"min({col})": (col, "min")})
+
+    def max(self, col: str) -> Dataset:
+        return self.aggregate(**{f"max({col})": (col, "max")})
+
+    def std(self, col: str) -> Dataset:
+        return self.aggregate(**{f"std({col})": (col, "std")})
+
+    def map_groups(self, fn: Callable[[Block], Block]) -> Dataset:
+        """Apply ``fn`` to each key's sub-block (reference:
+        GroupedData.map_groups)."""
+        key = self._key
+        return self._exchanged()._with_stage(Stage(
+            "map_groups", lambda b: _map_groups_block(key, fn, b)))
+
+
+# --------------------------------------------------------------------- #
+# block writers (used by Dataset.write_*)
+# --------------------------------------------------------------------- #
+
+def _write_block(writer, block_or_ref, path: str) -> str:
+    from . import executor
+    writer(executor.fetch(block_or_ref), path)
+    return path
+
+
+def _parquet_writer(block: Block, path: str) -> None:
+    BlockAccessor(block).to_arrow()
+    import pyarrow.parquet as pq
+    pq.write_table(BlockAccessor(block).to_arrow(), path)
+
+
+def _csv_writer(block: Block, path: str) -> None:
+    BlockAccessor(block).to_pandas().to_csv(path, index=False)
+
+
+def _json_writer(block: Block, path: str) -> None:
+    BlockAccessor(block).to_pandas().to_json(path, orient="records",
+                                             lines=True)

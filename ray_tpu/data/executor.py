@@ -142,6 +142,8 @@ def _run_shuffle(blocks: List[Any], fused: List[Callable], stage
     import ray_tpu
 
     kind = stage.kind
+    if kind.startswith("sort:") or kind.startswith("groupshuffle:"):
+        return _run_key_exchange(blocks, fused, stage)
     if kind.startswith("shuffle"):
         seed_s = kind.split(":", 1)[1]
         seed = None if seed_s == "None" else int(seed_s)
@@ -191,6 +193,114 @@ def _run_shuffle(blocks: List[Any], fused: List[Callable], stage
         s = None if seed is None else seed + 100003 + j
         out.append(merge_remote.remote(
             s, randomize, *[parts[i][j] for i in range(len(parts))]))
+    return out
+
+
+# -- key exchanges: sort (range partition) + groupby (hash partition) ------
+
+def _stable_hash_mod(values: np.ndarray, n: int) -> np.ndarray:
+    """Deterministic cross-process bucket assignment.  NEVER builtins
+    hash(): PYTHONHASHSEED differs per worker, which would scatter one
+    key across reducers."""
+    import hashlib
+    uniq, inv = np.unique(values, return_inverse=True)
+    buckets = np.array([
+        int.from_bytes(hashlib.blake2b(repr(u).encode(),
+                                       digest_size=8).digest(), "little") % n
+        for u in uniq.tolist()], dtype=np.int64)
+    return buckets[inv]
+
+
+def _sample_keys(key: str, k: int, fns, block_or_read) -> np.ndarray:
+    block = _apply_chain(fns, block_or_read)
+    keys = block.get(key)
+    if keys is None or len(keys) == 0:
+        return np.array([])
+    idx = np.linspace(0, len(keys) - 1, min(k, len(keys)), dtype=np.int64)
+    return keys[idx]
+
+
+def _key_split(key: str, boundaries, n_out: int, fns, block_or_read):
+    """Exchange map side: partition rows by sort-range or key-hash."""
+    block = _apply_chain(fns, block_or_read)
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        parts = [block] * n_out
+    else:
+        keys = block[key]
+        if boundaries is None:
+            assignment = _stable_hash_mod(keys, n_out)
+        else:
+            assignment = np.searchsorted(np.asarray(boundaries), keys,
+                                         side="right")
+        parts = [acc.take(np.nonzero(assignment == j)[0])
+                 for j in range(n_out)]
+    return tuple(parts) if n_out > 1 else parts[0]
+
+
+def _merge_key_parts(key: str, descending: bool, do_sort: bool, *parts):
+    merged = BlockAccessor.concat(list(parts))
+    if not merged and parts:
+        merged = parts[0]
+    if do_sort and merged and BlockAccessor(merged).num_rows():
+        order = np.argsort(merged[key], kind="stable")
+        if descending:
+            order = order[::-1]
+        merged = BlockAccessor(merged).take(order)
+    return merged
+
+
+def _run_key_exchange(blocks: List[Any], fused: List[Callable], stage
+                      ) -> List[Any]:
+    """Sort: sample -> range boundaries -> partition -> sorted merge
+    (global order = block order; reference: planner/exchange sort).
+    Groupby: hash partition so each key lands wholly in one block."""
+    import ray_tpu
+
+    kind, key, *rest = stage.kind.split(":")
+    descending = bool(rest) and rest[0] == "1"
+    n_out = max(1, len(blocks))
+
+    if not ray_tpu.is_initialized():
+        materialized = [_apply_chain(fused, fetch(b)) for b in blocks]
+        full = BlockAccessor.concat(materialized)
+        if kind == "sort" and full and BlockAccessor(full).num_rows():
+            order = np.argsort(full[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            full = BlockAccessor(full).take(order)
+        return [full]
+
+    boundaries = None
+    if kind == "sort":
+        sample_remote = ray_tpu.remote(_sample_keys)
+        samples = ray_tpu.get(
+            [sample_remote.remote(key, 64, fused, b) for b in blocks],
+            timeout=600)
+        all_keys = np.sort(np.concatenate(
+            [s for s in samples if len(s)] or [np.array([0])]))
+        qs = np.linspace(0, len(all_keys) - 1, n_out + 1)[1:-1]
+        boundaries = all_keys[qs.astype(np.int64)]
+        if descending:
+            # Partition ascending; reducers sort desc; reverse block order
+            # at the end so global order is descending.
+            pass
+
+    split_remote = ray_tpu.remote(_key_split).options(num_returns=n_out)
+    parts: List[List[Any]] = []
+    for i, b in enumerate(blocks):
+        if i >= MAX_IN_FLIGHT:
+            ray_tpu.wait([parts[i - MAX_IN_FLIGHT][0]], num_returns=1,
+                         timeout=600)
+        refs = split_remote.remote(key, boundaries, n_out, fused, b)
+        parts.append(refs if isinstance(refs, list) else [refs])
+
+    merge_remote = ray_tpu.remote(_merge_key_parts)
+    out = [merge_remote.remote(key, descending, kind == "sort",
+                               *[parts[i][j] for i in range(len(parts))])
+           for j in range(n_out)]
+    if kind == "sort" and descending:
+        out.reverse()
     return out
 
 

@@ -25,7 +25,8 @@ from .dqn import DQN, DQNConfig
 from .env import (CartPole, Env, Pendulum, StatelessGuess, TargetReach,
                   VectorEnv, make_env, register_env)
 from .env_runner import EnvRunner, EnvRunnerGroup
-from .impala import IMPALA, IMPALAConfig, vtrace
+from .impala import (APPO, APPOConfig, IMPALA, IMPALAConfig,
+                     vtrace)
 from .learner import JaxLearner, LearnerGroup
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
                           MultiAgentPPOConfig, MultiGuess)
@@ -41,6 +42,7 @@ from .sac import SAC, SACConfig
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "vtrace",
+    "APPO", "APPOConfig",
     "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
     "OfflineData", "collect_from_env", "save_shard",
     "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",

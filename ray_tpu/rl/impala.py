@@ -82,6 +82,30 @@ def impala_loss(module: DiscretePolicyModule, params, batch):
                    "entropy": entropy}
 
 
+def appo_loss(module: DiscretePolicyModule, params, batch):
+    """Clipped-surrogate variant over V-trace advantages (reference:
+    rllib/algorithms/appo — PPO's ratio clip applied to IMPALA's
+    asynchronous pipeline)."""
+    import jax
+    import jax.numpy as jnp
+    out = module.forward_train(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(out["action_logits"])
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+    ratio = jnp.exp(logp - batch["behavior_logp"])
+    adv = batch["pg_advantages"]
+    clip = batch["clip_param"][0]
+    surrogate = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+    pg_loss = -jnp.mean(surrogate)
+    vf_loss = jnp.mean((out["value"] - batch["vs_targets"]) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = pg_loss + batch["vf_coeff"][0] * vf_loss \
+        - batch["ent_coeff"][0] * entropy
+    return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
 class IMPALAConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__(IMPALA)
@@ -113,11 +137,13 @@ class IMPALA(Algorithm):
     overlaps learning and stale rollouts are V-trace-corrected.
     """
 
+    _loss_fn = staticmethod(impala_loss)
+
     def setup(self, config: IMPALAConfig) -> None:
         import jax
         spec = config.module_spec()
         self.module = DiscretePolicyModule(spec)
-        self.learner = JaxLearner(self.module, impala_loss,
+        self.learner = JaxLearner(self.module, type(self)._loss_fn,
                                   learning_rate=config.lr, seed=config.seed)
         self._fwd = jax.jit(self.module.forward_train)
         self.env_runner_group.sync_weights(self.learner.params)
@@ -147,8 +173,11 @@ class IMPALA(Algorithm):
             "actions": actions_flat.astype(np.int32),
             "pg_advantages": pg_adv.reshape(-1),
             "vs_targets": vs.reshape(-1),
+            "behavior_logp": rollout["logp"].reshape(-1),
             "vf_coeff": np.array([cfg.vf_loss_coeff], np.float32),
             "ent_coeff": np.array([cfg.entropy_coeff], np.float32),
+            "clip_param": np.array(
+                [getattr(cfg, "clip_param", 0.0)], np.float32),
         }
         self._steps_sampled += T * N
         return self.learner.update(batch)
@@ -199,3 +228,24 @@ class IMPALA(Algorithm):
     def set_weights(self, params) -> None:
         self.learner.set_weights(params)
         self.env_runner_group.sync_weights(params)
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param = 0.2
+
+    def training(self, *, clip_param=None, **kw) -> "APPOConfig":
+        super().training(**kw)
+        if clip_param is not None:
+            self.clip_param = clip_param
+        return self
+
+
+class APPO(IMPALA):
+    """Asynchronous PPO (reference: rllib/algorithms/appo): IMPALA's
+    decoupled sampling + V-trace correction with PPO's clipped-surrogate
+    policy loss."""
+
+    _loss_fn = staticmethod(appo_loss)

@@ -169,3 +169,107 @@ class TestDistributedShuffle:
               .random_shuffle(seed=1))
         rows = sorted(r["id"] for r in ds.take_all())
         assert rows == [2 * i for i in range(500)]
+
+
+class TestSortGroupby:
+    """Distributed sort + groupby/aggregate (reference test analog:
+    python/ray/data/tests/test_sort.py, test_all_to_all.py groupby)."""
+
+    def test_sort_ascending_descending(self, ray_start):
+        import numpy as np
+        rng = np.random.default_rng(0)
+        vals = rng.permutation(500).astype(np.int64)
+        ds = from_numpy({"x": vals}, parallelism=6).sort("x")
+        out = np.concatenate(
+            [b["x"] for b in ds._blocks()
+             if b and len(b.get("x", [])) > 0])
+        np.testing.assert_array_equal(out, np.arange(500))
+        ds2 = from_numpy({"x": vals}, parallelism=6).sort(
+            "x", descending=True)
+        out2 = np.concatenate(
+            [b["x"] for b in ds2._blocks() if b and len(b.get("x", []))])
+        np.testing.assert_array_equal(out2, np.arange(499, -1, -1))
+
+    def test_sort_after_map_fuses_into_exchange(self, ray_start):
+        import numpy as np
+        ds = (ds_range(100, parallelism=4)
+              .map_batches(lambda b: {"x": 99 - b["id"]})
+              .sort("x"))
+        out = np.concatenate([b["x"] for b in ds._blocks()
+                              if b and len(b.get("x", []))])
+        np.testing.assert_array_equal(out, np.arange(100))
+
+    def test_groupby_aggregates(self, ray_start):
+        import numpy as np
+        n = 300
+        ds = from_numpy({
+            "k": np.arange(n) % 7,
+            "v": np.arange(n, dtype=np.float64),
+        }, parallelism=5)
+        res = ds.groupby("k").aggregate(
+            total=("v", "sum"), n=("v", "count"), avg=("v", "mean"),
+            lo=("v", "min"), hi=("v", "max")).take_all()
+        assert len(res) == 7
+        by_key = {int(r["k"]): r for r in res}
+        for k in _builtins_range(7):
+            vals = np.arange(n)[np.arange(n) % 7 == k].astype(float)
+            assert by_key[k]["total"] == pytest.approx(vals.sum())
+            assert by_key[k]["n"] == len(vals)
+            assert by_key[k]["avg"] == pytest.approx(vals.mean())
+            assert by_key[k]["lo"] == vals.min()
+            assert by_key[k]["hi"] == vals.max()
+
+    def test_groupby_convenience_and_map_groups(self, ray_start):
+        import numpy as np
+        ds = from_items(
+            [{"k": "a", "v": 1.0}, {"k": "b", "v": 2.0},
+             {"k": "a", "v": 3.0}, {"k": "b", "v": 4.0},
+             {"k": "c", "v": 5.0}], parallelism=3)
+        counts = {r["k"]: r["count"] for r in ds.groupby("k").count()
+                  .take_all()}
+        assert counts == {"a": 2, "b": 2, "c": 1}
+        means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v")
+                 .take_all()}
+        assert means["a"] == pytest.approx(2.0)
+        # map_groups: normalize within each group.
+        normed = ds.groupby("k").map_groups(
+            lambda b: {"k": b["k"], "v": b["v"] - b["v"].mean()}).take_all()
+        got = sorted((r["k"], round(float(r["v"]), 3)) for r in normed)
+        assert got == [("a", -1.0), ("a", 1.0), ("b", -1.0), ("b", 1.0),
+                       ("c", 0.0)]
+
+    def test_limit_and_union(self, ray_start):
+        a = ds_range(50, parallelism=4)
+        b = ds_range(10, parallelism=2)
+        lim = a.limit(7)
+        assert [r["id"] for r in lim.take_all()] == list(_builtins_range(7))
+        u = a.union(b)
+        assert u.count() == 60
+
+    def test_writes_roundtrip(self, ray_start, tmp_path):
+        import numpy as np
+        ds = from_numpy({"x": np.arange(40),
+                            "y": np.arange(40) * 2.0}, parallelism=3)
+        pq_dir = str(tmp_path / "pq")
+        files = ds.write_parquet(pq_dir)
+        assert len(files) == 3
+        back = Dataset.read_parquet(pq_dir + "/*.parquet")
+        assert sorted(r["x"] for r in back.take_all()) == list(
+            _builtins_range(40))
+        csv_dir = str(tmp_path / "csv")
+        ds.write_csv(csv_dir)
+        back_csv = Dataset.read_csv(csv_dir + "/*.csv")
+        assert back_csv.count() == 40
+        json_dir = str(tmp_path / "js")
+        ds.write_json(json_dir)
+        import json as _json
+        rows = []
+        import glob as _glob
+        for f in _glob.glob(json_dir + "/*.json"):
+            with open(f) as fh:
+                rows += [_json.loads(line) for line in fh if line.strip()]
+        assert len(rows) == 40
+
+
+import builtins as _bi
+_builtins_range = _bi.range

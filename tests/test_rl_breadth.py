@@ -300,6 +300,17 @@ class TestIMPALA:
             r = algo.train()
         assert r["env_runners"]["episode_return_mean"] > 0.9
 
+    def test_appo_learns(self):
+        from ray_tpu.rl import APPOConfig
+        cfg = (APPOConfig().environment("StatelessGuess")
+               .env_runners(num_env_runners=0, rollout_fragment_length=64)
+               .training(lr=5e-3, batches_per_iteration=4, clip_param=0.2)
+               .debugging(seed=0))
+        algo = cfg.build_algo()
+        for _ in range(10):
+            r = algo.train()
+        assert r["env_runners"]["episode_return_mean"] > 0.9
+
     def test_async_impala_learns(self, ray_start):
         cfg = (IMPALAConfig().environment("StatelessGuess")
                .env_runners(num_env_runners=2, rollout_fragment_length=64)
