@@ -161,10 +161,12 @@ _env_cache: Dict[tuple, Any] = {}
 
 def _env_cache_key(runtime_env) -> Optional[tuple]:
     try:
+        pip = runtime_env.get("pip") or runtime_env.get("uv") or ()
         return (
             runtime_env.get("working_dir"),
             tuple(runtime_env.get("py_modules") or ()),
             tuple(sorted((runtime_env.get("env_vars") or {}).items())),
+            tuple([pip] if isinstance(pip, str) else pip),
         )
     except Exception:
         return None

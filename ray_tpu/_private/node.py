@@ -264,9 +264,12 @@ class NodeManager:
                     out.close()
                 popen_kw = {}
         child_env.update(self.cgroup.spawn_env())
+        # pip runtime envs run the worker under their venv interpreter
+        # (reference: pip plugin's python_interpreter override).
+        python = child_env.pop("RAY_TPU_PYTHON", sys.executable)
         try:
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                [python, "-m", "ray_tpu._private.worker_main"],
                 env=child_env, cwd=os.getcwd(), **popen_kw)
         finally:
             for f in popen_kw.values():
@@ -353,7 +356,8 @@ class NodeManager:
         env_vars: Dict[str, str] = dict(
             spec.runtime_env.get("env_vars", {})) if spec.runtime_env else {}
         if spec.runtime_env and (spec.runtime_env.get("working_dir")
-                                 or spec.runtime_env.get("py_modules")):
+                                 or spec.runtime_env.get("py_modules")
+                                 or spec.runtime_env.get("pip")):
             # Extract content-addressed packages into the node session dir;
             # workers apply them at boot (reference: runtime-env agent
             # GetOrCreateRuntimeEnv before the lease grant).

@@ -9,10 +9,15 @@ per hash in the node's session dir), and application happens at worker
 boot via env vars (the worker chdirs into working_dir and prepends
 py_modules to sys.path).
 
-``pip``/``conda`` isolation is intentionally not implemented: this
-framework targets hermetic TPU pod images where interpreter-level env
-mutation is an anti-pattern (and the build env has no package index);
-requesting them raises a clear error rather than silently ignoring.
+``pip`` environments (reference: runtime_env/pip.py) are venvs created
+per requirement-list signature with ``--system-site-packages`` (the base
+image's jax/numpy stay visible; pip only layers the extras) — workers of
+that env run under the venv's interpreter.  Entries are passed to ``pip
+install`` verbatim, so offline clusters can use ``--no-index`` +local
+paths.  ``conda``/``container`` stay unimplemented: this framework
+targets hermetic TPU pod images, and those two mutate the interpreter
+underneath jax; requesting them raises a clear error rather than
+silently ignoring.
 """
 
 from __future__ import annotations
@@ -58,13 +63,19 @@ def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]]
     """Driver-side: resolve local paths into content-addressed blobs."""
     if not runtime_env:
         return runtime_env
-    for key in ("pip", "conda", "uv", "container"):
+    for key in ("conda", "container"):
         if runtime_env.get(key):
             raise NotImplementedError(
                 f"runtime_env[{key!r}] is not supported: ray_tpu targets "
                 "hermetic pod images (bake dependencies into the image); "
-                "working_dir/py_modules/env_vars are supported")
+                "pip/working_dir/py_modules/env_vars are supported")
     out = dict(runtime_env)
+    pip = out.get("pip") or out.get("uv")
+    if pip is not None:
+        if isinstance(pip, str):
+            pip = [pip]
+        out["pip"] = [str(p) for p in pip]
+        out.pop("uv", None)
     wd = out.get("working_dir")
     if wd and not str(wd).startswith("pkg:"):
         blob, h = package_dir(wd)
@@ -126,7 +137,77 @@ def node_setup_env_vars(runtime_env: Optional[Dict[str, Any]],
             mods.append(_extract(h, pkgs[h], session_dir))
     if mods:
         env["RAY_TPU_PY_MODULES"] = os.pathsep.join(mods)
+    pip = runtime_env.get("pip")
+    if pip:
+        venv = _ensure_pip_env(list(pip), session_dir)
+        # The spawner execs this interpreter for the worker (node.py reads
+        # RAY_TPU_PYTHON out of the spawn env).
+        env["RAY_TPU_PYTHON"] = os.path.join(venv, "bin", "python")
     return env
+
+
+_PIP_LOCKS: Dict[str, threading.Lock] = {}
+_PIP_LOCKS_GUARD = threading.Lock()
+
+
+def _ensure_pip_env(requirements: List[str], session_dir: str) -> str:
+    """Create (once per signature) a venv layering ``requirements`` over
+    the system site-packages (reference: runtime_env/pip.py — per-env
+    virtualenv keyed by the requirement hash, concurrent setups
+    deduplicated)."""
+    import subprocess
+    import sys
+
+    sig = hashlib.sha256(
+        ("\n".join(requirements) + sys.executable).encode()).hexdigest()[:16]
+    dest = os.path.join(session_dir, "runtime_env", f"venv_{sig}")
+    with _PIP_LOCKS_GUARD:
+        lock = _PIP_LOCKS.setdefault(sig, threading.Lock())
+    with lock:
+        if os.path.isdir(dest):
+            return dest
+        tmp = dest + ".tmp"
+        try:
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 tmp],
+                check=True, capture_output=True, timeout=300)
+            # --system-site-packages only exposes the BASE interpreter's
+            # site dir; when this process itself runs in a venv (common:
+            # /opt/venv images), the parent's packages (jax, numpy,
+            # setuptools) would vanish.  A .pth appends the parent's site
+            # dirs after the new venv's own, so pip-installed extras still
+            # shadow the base.
+            import sysconfig
+            parent_sites = [sysconfig.get_paths()["purelib"]]
+            try:
+                import site as _site
+                parent_sites += _site.getsitepackages()
+            except Exception:  # noqa: BLE001
+                pass
+            vpure = subprocess.run(
+                [os.path.join(tmp, "bin", "python"), "-c",
+                 "import sysconfig; print(sysconfig.get_paths()['purelib'])"],
+                check=True, capture_output=True, text=True,
+                timeout=60).stdout.strip()
+            with open(os.path.join(vpure, "_ray_tpu_parent_env.pth"),
+                      "w") as f:
+                f.write("\n".join(dict.fromkeys(
+                    p for p in parent_sites if p != vpure)) + "\n")
+            subprocess.run(
+                [os.path.join(tmp, "bin", "python"), "-m", "pip",
+                 "install", "--quiet", *requirements],
+                check=True, capture_output=True, timeout=600)
+        except subprocess.CalledProcessError as e:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+            from .exceptions import RuntimeEnvSetupError
+            raise RuntimeEnvSetupError(
+                f"pip runtime_env setup failed: "
+                f"{(e.stderr or b'').decode(errors='replace')[-2000:]}"
+            ) from e
+        os.replace(tmp, dest)
+    return dest
 
 
 def apply_worker_env() -> None:

@@ -67,12 +67,58 @@ class TestRuntimeEnv:
         assert ray_tpu.get(a.x.remote(), timeout=60) == 7
         ray_tpu.kill(a)
 
-    def test_pip_rejected_clearly(self, rt):
-        with pytest.raises(NotImplementedError, match="pip"):
-            @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def test_conda_rejected_clearly(self, rt):
+        with pytest.raises(NotImplementedError, match="conda"):
+            @ray_tpu.remote(runtime_env={"conda": "myenv"})
             def f():
                 return 1
             f.remote()
+
+    def test_pip_env_installs_local_package(self, rt, tmp_path):
+        """pip runtime env: worker runs under a venv layering a local
+        package over the system site-packages (reference:
+        runtime_env/pip.py; --no-index keeps it offline-safe)."""
+        pkg = tmp_path / "tinypkg"
+        (pkg / "tinypkg_rtenv").mkdir(parents=True)
+        (pkg / "tinypkg_rtenv" / "__init__.py").write_text(
+            "MAGIC = 'pip-env-works'\n")
+        (pkg / "pyproject.toml").write_text(
+            '[project]\nname = "tinypkg-rtenv"\nversion = "0.1"\n'
+            '[build-system]\nrequires = ["setuptools"]\n'
+            'build-backend = "setuptools.build_meta"\n'
+            '[tool.setuptools]\npackages = ["tinypkg_rtenv"]\n')
+
+        @ray_tpu.remote(runtime_env={"pip": [
+            "--no-index", "--no-build-isolation", str(pkg)]})
+        def probe():
+            import sys
+
+            import tinypkg_rtenv
+            return tinypkg_rtenv.MAGIC, sys.executable
+
+        magic, exe = ray_tpu.get(probe.remote(), timeout=600)
+        assert magic == "pip-env-works"
+        assert "venv_" in exe  # ran under the env's interpreter
+
+        # The package must NOT leak into plain workers.
+        @ray_tpu.remote
+        def plain():
+            try:
+                import tinypkg_rtenv  # noqa: F401
+                return "leaked"
+            except ImportError:
+                return "clean"
+
+        assert ray_tpu.get(plain.remote(), timeout=60) == "clean"
+
+    def test_pip_env_failure_surfaces(self, rt):
+        @ray_tpu.remote(runtime_env={"pip": [
+            "--no-index", "definitely-not-a-real-package-xyz"]})
+        def f():
+            return 1
+
+        with pytest.raises(Exception, match="pip runtime_env setup failed"):
+            ray_tpu.get(f.remote(), timeout=600)
 
     def test_missing_dir_raises(self, rt):
         with pytest.raises(ValueError, match="not found"):
