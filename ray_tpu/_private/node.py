@@ -351,13 +351,35 @@ class NodeManager:
     def dispatch_task(self, spec: TaskSpec,
                       resolved_args, resolved_kwargs,
                       target_worker: Optional[WorkerID] = None,
-                      _retry_deadline: Optional[float] = None) -> None:
+                      _retry_deadline: Optional[float] = None,
+                      _env_bg: bool = False) -> None:
         """Send a fully-resolved task to a worker (lease grant + push)."""
         env_vars: Dict[str, str] = dict(
             spec.runtime_env.get("env_vars", {})) if spec.runtime_env else {}
         if spec.runtime_env and (spec.runtime_env.get("working_dir")
                                  or spec.runtime_env.get("py_modules")
                                  or spec.runtime_env.get("pip")):
+            from .runtime_env import pip_env_ready
+            if not _env_bg and not pip_env_ready(spec.runtime_env):
+                # Cold pip env: venv creation + pip install can take
+                # minutes — building it inline would stall the single
+                # dispatch thread (and with it every other task in the
+                # cluster).  Re-enter on a builder thread instead
+                # (reference: runtime-env agent builds envs off the
+                # raylet's dispatch path).
+                def _bg():
+                    try:
+                        self.dispatch_task(spec, resolved_args,
+                                           resolved_kwargs, target_worker,
+                                           _retry_deadline, _env_bg=True)
+                    except Exception as e:  # noqa: BLE001
+                        self.runtime.scheduler.release(
+                            self.info.node_id, spec.resources,
+                            spec.placement_group, spec.bundle_index)
+                        self.runtime.on_dispatch_failed(spec, repr(e))
+                threading.Thread(target=_bg, name="runtime-env-build",
+                                 daemon=True).start()
+                return
             # Extract content-addressed packages into the node session dir;
             # workers apply them at boot (reference: runtime-env agent
             # GetOrCreateRuntimeEnv before the lease grant).

@@ -72,6 +72,14 @@ def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]]
     out = dict(runtime_env)
     pip = out.get("pip") or out.get("uv")
     if pip is not None:
+        if isinstance(pip, dict):
+            # Ray's dict form: {"packages": [...], "pip_check": ...}.
+            unknown = set(pip) - {"packages", "pip_check", "pip_version"}
+            if unknown:
+                raise NotImplementedError(
+                    f"runtime_env pip dict keys {sorted(unknown)} are not "
+                    "supported (packages/pip_check/pip_version only)")
+            pip = pip.get("packages", [])
         if isinstance(pip, str):
             pip = [pip]
         out["pip"] = [str(p) for p in pip]
@@ -150,23 +158,68 @@ _PIP_LOCKS: Dict[str, threading.Lock] = {}
 _PIP_LOCKS_GUARD = threading.Lock()
 
 
+def _local_fingerprint(path: str) -> str:
+    """Content fingerprint for a local-path requirement so an edited
+    package invalidates its cached venv (working_dir is content-addressed;
+    pip local paths must be too or workers silently run stale code)."""
+    h = hashlib.sha256()
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            h.update(f"{os.path.relpath(full, path)}:{st.st_size}:"
+                     f"{st.st_mtime_ns}\n".encode())
+    return h.hexdigest()[:16]
+
+
+def pip_env_signature(requirements: List[str]) -> str:
+    import sys
+    parts = []
+    for r in requirements:
+        p = os.path.expanduser(r)
+        if os.path.isdir(p):
+            parts.append(f"{r}@{_local_fingerprint(p)}")
+        else:
+            parts.append(r)
+    return hashlib.sha256(
+        ("\n".join(parts) + sys.executable).encode()).hexdigest()[:16]
+
+
+def pip_env_ready(runtime_env: Optional[Dict[str, Any]],
+                  session_dir: Optional[str] = None) -> bool:
+    """True when the env's venv already exists (fast-path probe so the
+    dispatch thread can decide to offload a cold build)."""
+    pip = (runtime_env or {}).get("pip")
+    if not pip:
+        return True
+    session_dir = session_dir or os.path.join(
+        tempfile.gettempdir(), "ray_tpu_session")
+    return os.path.isdir(os.path.join(
+        session_dir, "runtime_env", f"venv_{pip_env_signature(list(pip))}"))
+
+
 def _ensure_pip_env(requirements: List[str], session_dir: str) -> str:
     """Create (once per signature) a venv layering ``requirements`` over
     the system site-packages (reference: runtime_env/pip.py — per-env
-    virtualenv keyed by the requirement hash, concurrent setups
-    deduplicated)."""
+    virtualenv keyed by the requirement hash; concurrent setups are
+    deduplicated in-process by a lock and cross-process by flock)."""
     import subprocess
     import sys
 
-    sig = hashlib.sha256(
-        ("\n".join(requirements) + sys.executable).encode()).hexdigest()[:16]
+    sig = pip_env_signature(requirements)
     dest = os.path.join(session_dir, "runtime_env", f"venv_{sig}")
     with _PIP_LOCKS_GUARD:
         lock = _PIP_LOCKS.setdefault(sig, threading.Lock())
-    with lock:
+    with lock, _file_lock(dest + ".lock"):
         if os.path.isdir(dest):
             return dest
         tmp = dest + ".tmp"
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)  # stale partial build
         try:
             subprocess.run(
                 [sys.executable, "-m", "venv", "--system-site-packages",
@@ -198,16 +251,43 @@ def _ensure_pip_env(requirements: List[str], session_dir: str) -> str:
                 [os.path.join(tmp, "bin", "python"), "-m", "pip",
                  "install", "--quiet", *requirements],
                 check=True, capture_output=True, timeout=600)
-        except subprocess.CalledProcessError as e:
-            import shutil
+        except (subprocess.CalledProcessError,
+                subprocess.TimeoutExpired) as e:
             shutil.rmtree(tmp, ignore_errors=True)
             from .exceptions import RuntimeEnvSetupError
+            stderr = getattr(e, "stderr", b"") or b""
+            if isinstance(stderr, str):
+                stderr = stderr.encode()
             raise RuntimeEnvSetupError(
                 f"pip runtime_env setup failed: "
-                f"{(e.stderr or b'').decode(errors='replace')[-2000:]}"
-            ) from e
+                f"{type(e).__name__}: "
+                f"{stderr.decode(errors='replace')[-2000:]}") from e
         os.replace(tmp, dest)
     return dest
+
+
+class _file_lock:
+    """flock-based cross-process mutex (two node processes on one host
+    share the venv cache dir)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._f = None
+
+    def __enter__(self):
+        import fcntl
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        self._f = open(self._path, "w")
+        fcntl.flock(self._f, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        import fcntl
+        try:
+            fcntl.flock(self._f, fcntl.LOCK_UN)
+        finally:
+            self._f.close()
+        return False
 
 
 def apply_worker_env() -> None:

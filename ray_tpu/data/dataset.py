@@ -151,6 +151,50 @@ class Dataset:
             return out
         return self._with_stage(Stage(f"add_column({name})", apply))
 
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        """reference: Dataset.select_columns."""
+        cols = list(cols)
+
+        def apply(block: Block) -> Block:
+            missing = [c for c in cols if c not in block]
+            if block and missing:
+                raise KeyError(f"select_columns: missing {missing}")
+            return {c: block[c] for c in cols if c in block}
+        return self._with_stage(Stage(f"select_columns({cols})", apply))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        """reference: Dataset.drop_columns."""
+        drop = set(cols)
+
+        def apply(block: Block) -> Block:
+            return {k: v for k, v in block.items() if k not in drop}
+        return self._with_stage(Stage(f"drop_columns({cols})", apply))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        """reference: Dataset.rename_columns (rejects renames that would
+        collide with a surviving column — a silent overwrite loses data)."""
+        frozen = dict(mapping)
+
+        def apply(block: Block) -> Block:
+            names = [frozen.get(k, k) for k in block]
+            if len(set(names)) != len(names):
+                dup = {n for n in names if names.count(n) > 1}
+                raise ValueError(
+                    f"rename_columns: duplicate target columns {sorted(dup)}")
+            return {frozen.get(k, k): v for k, v in block.items()}
+        return self._with_stage(Stage("rename_columns", apply))
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column (reference: Dataset.unique)."""
+        from . import executor
+        seen: set = set()
+        for b in executor.execute_streaming(
+                self.select_columns([column])):
+            blk = executor.fetch(b)
+            if blk and len(blk.get(column, ())):
+                seen.update(np.unique(blk[column]).tolist())
+        return sorted(seen)
+
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         """Distributed sort: sample -> range partition -> per-block sort;
         global order is the block order (reference: Dataset.sort over

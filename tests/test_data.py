@@ -238,6 +238,21 @@ class TestSortGroupby:
         assert got == [("a", -1.0), ("a", 1.0), ("b", -1.0), ("b", 1.0),
                        ("c", 0.0)]
 
+    def test_column_ops_and_unique(self, ray_start):
+        import numpy as np
+        ds = from_numpy({"a": np.arange(20), "b": np.arange(20) % 4,
+                         "c": np.ones(20)}, parallelism=3)
+        sel = ds.select_columns(["a", "b"]).take(1)[0]
+        assert set(sel) == {"a", "b"}
+        dropped = ds.drop_columns(["c"]).take(1)[0]
+        assert set(dropped) == {"a", "b"}
+        renamed = ds.rename_columns({"a": "x"}).take(1)[0]
+        assert set(renamed) == {"x", "b", "c"}
+        assert ds.unique("b") == [0, 1, 2, 3]
+        # Renaming onto an existing column is data loss: reject it.
+        with pytest.raises(Exception, match="duplicate target"):
+            ds.rename_columns({"a": "b"}).take(1)
+
     def test_limit_and_union(self, ray_start):
         a = ds_range(50, parallelism=4)
         b = ds_range(10, parallelism=2)

@@ -111,6 +111,27 @@ class TestRuntimeEnv:
 
         assert ray_tpu.get(plain.remote(), timeout=60) == "clean"
 
+    def test_pip_dict_form_and_unknown_keys(self, rt):
+        from ray_tpu._private.runtime_env import prepare_runtime_env
+        out = prepare_runtime_env({"pip": {"packages": ["a", "b"]}})
+        assert out["pip"] == ["a", "b"]
+        with pytest.raises(NotImplementedError, match="env_overrides"):
+            prepare_runtime_env({"pip": {"env_overrides": {}}})
+
+    def test_pip_local_path_edit_invalidates_cache(self, rt, tmp_path):
+        """Editing a local-path requirement must change the venv signature
+        (stale cached envs would silently run old code)."""
+        from ray_tpu._private.runtime_env import pip_env_signature
+        pkg = tmp_path / "p"
+        pkg.mkdir()
+        (pkg / "f.py").write_text("x = 1\n")
+        s1 = pip_env_signature(["--no-index", str(pkg)])
+        import time as _t
+        _t.sleep(0.01)
+        (pkg / "f.py").write_text("x = 2\n")
+        s2 = pip_env_signature(["--no-index", str(pkg)])
+        assert s1 != s2
+
     def test_pip_env_failure_surfaces(self, rt):
         @ray_tpu.remote(runtime_env={"pip": [
             "--no-index", "definitely-not-a-real-package-xyz"]})
