@@ -1,0 +1,45 @@
+// Example C++ consumer: sum a float32 tensor produced by Python workers.
+//
+//   ./sum_floats <segment> <offset> <nbytes> [buffer_index]
+//
+// Prints "count sum" of the float32 buffer — zero copies, no Python.
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+
+#include "ray_tpu/object_reader.hpp"
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <segment> <offset> <nbytes> [buffer_index]\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string segment = argv[1];
+  const uint64_t offset = std::strtoull(argv[2], nullptr, 10);
+  const uint64_t nbytes = std::strtoull(argv[3], nullptr, 10);
+  const size_t buf_idx = argc > 4 ? std::strtoul(argv[4], nullptr, 10) : 0;
+
+  try {
+    ray_tpu::ObjectView v = ray_tpu::open_object(segment, offset, nbytes);
+    if (buf_idx >= v.buffers.size()) {
+      std::fprintf(stderr, "object has %zu buffers, wanted %zu\n",
+                   v.buffers.size(), buf_idx);
+      return 1;
+    }
+    const auto &b = v.buffers[buf_idx];
+    const auto *xs = reinterpret_cast<const float *>(b.data);
+    const uint64_t n = b.size / sizeof(float);
+    double sum = 0.0;
+    for (uint64_t i = 0; i < n; ++i) {
+      sum += xs[i];
+    }
+    std::printf("%" PRIu64 " %.6f\n", n, sum);
+    return 0;
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

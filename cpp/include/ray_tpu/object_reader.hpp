@@ -1,0 +1,150 @@
+// ray_tpu C++ user API: zero-copy reads of arena-store objects.
+//
+// Reference analog: cpp/ (the C++ user API, ray::Get over the plasma
+// client).  Scope here is the data plane: a C++ program maps the node's
+// shared-memory arena (or a dedicated per-object segment) and reads a
+// sealed object's payload in place — the same zero-copy view Python
+// workers get.  Payload layout (ray_tpu/_private/serialization.py):
+//
+//   u32  n_buffers          (little endian)
+//   u64  len_meta
+//   meta bytes              (cloudpickle; opaque to C++)
+//   n_buffers x { u64 len; raw bytes }
+//
+// The out-of-band buffers are raw array bytes (numpy buffers land here
+// unpickled), so a C++ consumer that knows its schema by contract (e.g.
+// "one float32 buffer") reads tensors with zero copies and no Python.
+// Task/actor submission from C++ is future work; descriptors travel to
+// the C++ side through any channel (CLI args, files, sockets).
+//
+// Usage:
+//   ray_tpu::ObjectView v = ray_tpu::open_object(segment, offset, nbytes);
+//   const float* xs = reinterpret_cast<const float*>(v.buffers[0].data);
+//
+// Compile: C++17, -lrt on Linux.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <stdexcept>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace ray_tpu {
+
+struct BufferView {
+  const uint8_t *data;
+  uint64_t size;
+};
+
+struct ObjectView {
+  // Keeps the mapping alive; unmapped on destruction.
+  void *map_base = nullptr;
+  size_t map_len = 0;
+  const uint8_t *meta = nullptr;
+  uint64_t meta_len = 0;
+  std::vector<BufferView> buffers;
+
+  ObjectView() = default;
+  ObjectView(ObjectView &&o) noexcept { *this = std::move(o); }
+  ObjectView &operator=(ObjectView &&o) noexcept {
+    if (this != &o) {
+      release();
+      map_base = o.map_base;
+      map_len = o.map_len;
+      meta = o.meta;
+      meta_len = o.meta_len;
+      buffers = std::move(o.buffers);
+      o.map_base = nullptr;
+      o.map_len = 0;
+    }
+    return *this;
+  }
+  ObjectView(const ObjectView &) = delete;
+  ObjectView &operator=(const ObjectView &) = delete;
+  ~ObjectView() { release(); }
+
+  void release() {
+    if (map_base != nullptr) {
+      ::munmap(map_base, map_len);
+      map_base = nullptr;
+    }
+  }
+};
+
+namespace detail {
+inline uint64_t read_u64(const uint8_t *p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86-64/arm64)
+}
+inline uint32_t read_u32(const uint8_t *p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+}  // namespace detail
+
+// Map `segment` (a POSIX shm name as Python reports it, no leading '/')
+// and parse the payload at [offset, offset+nbytes).  Matches descriptors
+// ("shma", segment, offset, nbytes, id) from the arena store and
+// ("shm", name, nbytes) dedicated segments (use offset 0).
+inline ObjectView open_object(const std::string &segment, uint64_t offset,
+                              uint64_t nbytes) {
+  std::string name = segment.empty() || segment[0] == '/'
+                         ? segment
+                         : "/" + segment;
+  int fd = ::shm_open(name.c_str(), O_RDONLY, 0);
+  if (fd < 0) {
+    throw std::runtime_error("shm_open failed for " + name);
+  }
+  struct stat st;
+  if (::fstat(fd, &st) != 0 ||
+      static_cast<uint64_t>(st.st_size) < offset + nbytes) {
+    ::close(fd);
+    throw std::runtime_error("segment smaller than descriptor range");
+  }
+  void *base = ::mmap(nullptr, offset + nbytes, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) {
+    throw std::runtime_error("mmap failed for " + name);
+  }
+
+  ObjectView v;
+  v.map_base = base;
+  v.map_len = offset + nbytes;
+  const uint8_t *p = static_cast<const uint8_t *>(base) + offset;
+  const uint8_t *end = p + nbytes;
+  if (nbytes < 12) {
+    throw std::runtime_error("payload shorter than header");
+  }
+  uint32_t n_buffers = detail::read_u32(p);
+  uint64_t len_meta = detail::read_u64(p + 4);
+  p += 12;
+  if (p + len_meta > end) {
+    throw std::runtime_error("corrupt payload: meta overruns");
+  }
+  v.meta = p;
+  v.meta_len = len_meta;
+  p += len_meta;
+  for (uint32_t i = 0; i < n_buffers; ++i) {
+    if (p + 8 > end) {
+      throw std::runtime_error("corrupt payload: buffer length overruns");
+    }
+    uint64_t blen = detail::read_u64(p);
+    p += 8;
+    if (p + blen > end) {
+      throw std::runtime_error("corrupt payload: buffer overruns");
+    }
+    v.buffers.push_back(BufferView{p, blen});
+    p += blen;
+  }
+  return v;
+}
+
+}  // namespace ray_tpu

@@ -1,0 +1,64 @@
+"""C++ user API tests: zero-copy arena reads from a compiled C++ program
+(reference analog: cpp/ user API tests — here scoped to the data plane,
+see cpp/README.md)."""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def sum_floats_bin(tmp_path_factory):
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("g++ not available")
+    out = str(tmp_path_factory.mktemp("cppbin") / "sum_floats")
+    subprocess.run(
+        [gxx, "-std=c++17", "-O2", "-I", os.path.join(REPO, "cpp", "include"),
+         os.path.join(REPO, "cpp", "examples", "sum_floats.cc"),
+         "-o", out, "-lrt"],
+        check=True, capture_output=True, timeout=300)
+    return out
+
+
+class TestCppObjectReader:
+    def test_cpp_reads_python_tensor_zero_copy(self, sum_floats_bin,
+                                               ray_start):
+        rt = ray_start
+        arr = np.arange(100_000, dtype=np.float32)
+        ref = ray_tpu.put(arr)
+        # The arena descriptor: ("shma", segment, offset, nbytes, id) for
+        # the native store, ("shm", name, nbytes) for the fallback.
+        desc = rt.node.store.descriptor(ref.id())
+        assert desc is not None
+        if desc[0] == "shma":
+            _, seg, off, nbytes, _ = desc
+        else:
+            _, seg, nbytes = desc
+            off = 0
+        out = subprocess.run(
+            [sum_floats_bin, seg, str(off), str(nbytes)],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        count, total = out.stdout.split()
+        assert int(count) == 100_000
+        assert float(total) == pytest.approx(float(arr.sum()), rel=1e-6)
+
+    def test_cpp_rejects_corrupt_range(self, sum_floats_bin, ray_start):
+        rt = ray_start
+        ref = ray_tpu.put(np.ones(50_000, np.float32))
+        desc = rt.node.store.descriptor(ref.id())
+        seg = desc[1]
+        # Lie about the length: the reader must fail cleanly, not crash.
+        out = subprocess.run(
+            [sum_floats_bin, seg, "0", str(1 << 40)],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode != 0
+        assert "error" in out.stderr or "segment" in out.stderr
