@@ -98,6 +98,12 @@ class Controller:
         # reference's pubsub long-poll analog, reference: pubsub/publisher.h).
         self._kv_cond = threading.Condition(self._lock)
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = {}
+        # Long-poll pubsub rings (reference: pubsub/publisher.h buffered
+        # per-channel delivery to remote subscribers).
+        self._pubsub_cond = threading.Condition()
+        self._pubsub_rings: Dict[str, List] = {}
+        self._pubsub_seq = 0
+        self._pubsub_ring_cap = 1000
 
     # -- nodes --------------------------------------------------------------
 
@@ -269,8 +275,44 @@ class Controller:
     def publish(self, channel: str, message: Any) -> None:
         with self._lock:
             subs = list(self._subscribers.get(channel, []))
+        # Long-poll ring (reference: pubsub/publisher.h:356 — per-entity
+        # buffered long-poll delivery): remote subscribers (workers,
+        # clients, nodes) poll with their last-seen sequence number.
+        with self._pubsub_cond:
+            self._pubsub_seq += 1
+            ring = self._pubsub_rings.setdefault(channel, [])
+            ring.append((self._pubsub_seq, message))
+            if len(ring) > self._pubsub_ring_cap:
+                del ring[: len(ring) - self._pubsub_ring_cap]
+            self._pubsub_cond.notify_all()
         for cb in subs:
             try:
                 cb(message)
             except Exception:
                 pass
+
+    def pubsub_poll(self, channel: str, after_seq: int = 0,
+                    timeout: Optional[float] = None):
+        """Blocking long-poll: messages on ``channel`` with seq >
+        after_seq, waking on publish (no client poll loop).  Returns
+        (last_seq, [messages]); ([], after_seq) on timeout.  A subscriber
+        that falls more than the ring size behind silently misses the
+        overwritten messages (the reference's long-poll has the same
+        bounded-buffer semantics)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._pubsub_cond:
+            while True:
+                ring = self._pubsub_rings.get(channel, [])
+                fresh = [(s, m) for s, m in ring if s > after_seq]
+                if fresh:
+                    return fresh[-1][0], [m for _, m in fresh]
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    # Return the GLOBAL sequence head: no message on this
+                    # channel can have a seq <= it that wasn't already in
+                    # the ring (checked under this lock), so resuming from
+                    # here never skips — and lets "subscribe from now"
+                    # learn the head with a zero-timeout poll.
+                    return self._pubsub_seq, []
+                self._pubsub_cond.wait(remaining)

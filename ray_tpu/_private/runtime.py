@@ -1391,7 +1391,7 @@ class Runtime:
 
     # ctl_* methods that may block (long-poll style): handled off the
     # reader thread so one waiting worker can't stall its node connection.
-    _BLOCKING_CTL = frozenset({"kv_wait"})
+    _BLOCKING_CTL = frozenset({"kv_wait", "pubsub_poll"})
 
     def on_rpc_call(self, node, msg: RpcCall) -> None:
         def run():
@@ -1568,6 +1568,15 @@ class Runtime:
 
     def ctl_get_fn_blob(self, fn_id: bytes):
         return self._fn_table.get(fn_id)
+
+    # -- pubsub (reference: src/ray/pubsub/ long-poll publisher) ----------
+
+    def ctl_publish(self, channel: str, message) -> None:
+        self.controller.publish(channel, message)
+
+    def ctl_pubsub_poll(self, channel: str, after_seq: int = 0,
+                        timeout=None):
+        return self.controller.pubsub_poll(channel, after_seq, timeout)
 
     def ctl_log_files(self):
         """Session log files + sizes (reference: state API list_logs)."""

@@ -1,0 +1,52 @@
+"""Cluster pubsub: publish/long-poll channels reachable from any process.
+
+Reference analog: src/ray/pubsub/ (Publisher publisher.h:356 — buffered
+long-poll delivery per channel; Subscriber subscriber.h:215).  Messages
+travel through the head controller's per-channel rings: publishers from
+any worker/node/client call up over the existing control plane, and
+subscribers long-poll with their last-seen sequence (the server condvar
+wakes them — no client-side poll loop).  Rings are bounded (1000): a
+subscriber that falls further behind misses the overwritten messages,
+mirroring the reference's bounded buffers.
+
+    from ray_tpu.util import pubsub
+    pubsub.publish("jobs", {"event": "started"})
+    seq, msgs = pubsub.poll("jobs", after_seq=0, timeout=5)
+    for m in pubsub.listen("jobs"):   # blocking generator
+        ...
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Tuple
+
+from .._private.api import _control
+
+
+def publish(channel: str, message: Any) -> None:
+    """Broadcast a (picklable) message to a channel's subscribers."""
+    _control("publish", channel, message)
+
+
+def poll(channel: str, after_seq: int = 0,
+         timeout: Optional[float] = None) -> Tuple[int, List[Any]]:
+    """Messages newer than ``after_seq``; blocks until one arrives or the
+    timeout passes.  Returns (last_seq, messages)."""
+    return _control("pubsub_poll", channel, after_seq, timeout)
+
+
+def listen(channel: str, *, from_now: bool = True,
+           poll_timeout: float = 10.0) -> Iterator[Any]:
+    """Blocking generator over a channel (reference: Subscriber's
+    long-poll loop).  ``from_now=False`` replays whatever the bounded
+    ring still holds."""
+    seq = 0
+    if from_now:
+        # Learn the current head without consuming messages.
+        seq, _ = _control("pubsub_poll", channel, 1 << 62, 0)
+        if not seq:
+            seq = 0
+    while True:
+        seq, msgs = _control("pubsub_poll", channel, seq, poll_timeout)
+        for m in msgs:
+            yield m
