@@ -209,15 +209,20 @@ def _put_value(value: Any) -> ObjectID:
     return rt.put(value)
 
 
+_nil_actor_cache: Dict[bytes, Any] = {}
+
+
 def _next_task_id() -> TaskID:
     rt = _require_runtime()
     if hasattr(rt, "current_task_id") and rt.current_task_id is not None:
         return TaskID.of(rt.current_task_id.actor_id())
     if hasattr(rt, "current_actor_id") and rt.current_actor_id is not None:
         return TaskID.of(rt.current_actor_id)
-    job_id = rt.job_id
-    from .ids import ActorID as _A
-    nil_actor = _A(job_id.binary() + b"\x00" * 8)
+    job = rt.job_id.binary()
+    nil_actor = _nil_actor_cache.get(job)
+    if nil_actor is None:
+        from .ids import ActorID as _A
+        nil_actor = _nil_actor_cache[job] = _A(job + b"\x00" * 8)
     return TaskID.of(nil_actor)
 
 

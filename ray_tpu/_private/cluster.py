@@ -182,6 +182,15 @@ class UpDispatchFailed:
 
 
 @dataclass
+class UpFailTask:
+    """Task failed before leaving the node (e.g. its wire frame could not
+    serialize); only the raw ids are known."""
+    task_id_bytes: bytes
+    return_id_bytes: List[bytes]
+    reason: str
+
+
+@dataclass
 class UpReleaseResources:
     resources: Dict[str, float]
     pg_bytes: Optional[bytes]
@@ -753,6 +762,9 @@ class HeadServer:
         elif isinstance(msg, UpDispatchFailed):
             rt.on_dispatch_failed(msg.spec, msg.reason,
                                   lost_object_bytes=msg.lost_object_bytes)
+        elif isinstance(msg, UpFailTask):
+            rt.fail_task_bytes(msg.task_id_bytes, msg.return_id_bytes,
+                               msg.reason)
         elif isinstance(msg, UpReleaseResources):
             from .ids import PlacementGroupID
             pg = PlacementGroupID(msg.pg_bytes) if msg.pg_bytes else None
@@ -854,6 +866,11 @@ class _NodeServerRuntime:
                            lost_object_bytes=None) -> None:
         self._server.send_up(UpDispatchFailed(spec, reason,
                                               lost_object_bytes))
+
+    def fail_task_bytes(self, task_id_bytes, return_id_bytes,
+                        reason: str) -> None:
+        self._server.send_up(UpFailTask(task_id_bytes,
+                                        list(return_id_bytes), reason))
 
     def on_worker_died(self, worker_id, node_id, running, actor_id,
                        reason: str = "") -> None:

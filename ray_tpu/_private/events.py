@@ -64,7 +64,13 @@ class ProfileSpan:
 
 
 class TaskEventBuffer:
-    """Bounded, insertion-ordered task event history (oldest evicted)."""
+    """Bounded, insertion-ordered task event history (oldest evicted).
+
+    ``record`` is on the per-task dispatch path (4 transitions per task),
+    so it only appends a tuple to a deque — folding transitions into
+    per-task TaskEvent state happens lazily at read time (reference:
+    task_event_buffer.h batches transitions and ships them OFF the task
+    path for the same reason)."""
 
     def __init__(self, max_events: int = 10000):
         self._max = max_events
@@ -72,34 +78,50 @@ class TaskEventBuffer:
         self._spans: List[ProfileSpan] = []
         self._lock = threading.Lock()
         self.num_dropped = 0
+        from collections import deque
+        self._pending: "deque" = deque()
+        self._fold_at = max(1000, min(max_events * 2, 100_000))
 
     def record(self, task_id: str, state: str, *, name: Optional[str] = None,
                task_type: Optional[str] = None, actor_id: Optional[str] = None,
                node_id: Optional[str] = None, worker_id: Optional[str] = None,
                error_message: Optional[str] = None) -> None:
-        now = time.time()
+        # deque.append is thread-safe; no lock on the hot path.
+        self._pending.append((task_id, state, time.time(), name, task_type,
+                              actor_id, node_id, worker_id, error_message))
+        if len(self._pending) >= self._fold_at:
+            self._fold()
+
+    def _fold(self) -> None:
         with self._lock:
-            ev = self._events.get(task_id)
-            if ev is None:
-                ev = TaskEvent(task_id=task_id, name=name or "")
-                self._events[task_id] = ev
-                if len(self._events) > self._max:
-                    self._events.popitem(last=False)
-                    self.num_dropped += 1
-            if name:
-                ev.name = name
-            if task_type:
-                ev.type = task_type
-            if actor_id:
-                ev.actor_id = actor_id
-            if node_id:
-                ev.node_id = node_id
-            if worker_id:
-                ev.worker_id = worker_id
-            if error_message is not None:
-                ev.error_message = error_message
-            ev.state = state
-            ev.state_times.setdefault(state, now)
+            while True:
+                try:
+                    (task_id, state, now, name, task_type, actor_id,
+                     node_id, worker_id, error_message) = \
+                        self._pending.popleft()
+                except IndexError:
+                    break
+                ev = self._events.get(task_id)
+                if ev is None:
+                    ev = TaskEvent(task_id=task_id, name=name or "")
+                    self._events[task_id] = ev
+                    if len(self._events) > self._max:
+                        self._events.popitem(last=False)
+                        self.num_dropped += 1
+                if name:
+                    ev.name = name
+                if task_type:
+                    ev.type = task_type
+                if actor_id:
+                    ev.actor_id = actor_id
+                if node_id:
+                    ev.node_id = node_id
+                if worker_id:
+                    ev.worker_id = worker_id
+                if error_message is not None:
+                    ev.error_message = error_message
+                ev.state = state
+                ev.state_times.setdefault(state, now)
 
     def add_span(self, span: ProfileSpan) -> None:
         with self._lock:
@@ -111,6 +133,7 @@ class TaskEventBuffer:
                  limit: int = 10000) -> List[Dict[str, Any]]:
         if limit <= 0:
             return []
+        self._fold()
         with self._lock:
             events = [e.to_dict() for e in self._events.values()]
         if filters:
@@ -120,6 +143,7 @@ class TaskEventBuffer:
 
     def summary(self) -> Dict[str, Dict[str, int]]:
         """name -> state -> count (reference: util/state summarize_tasks)."""
+        self._fold()
         out: Dict[str, Dict[str, int]] = {}
         with self._lock:
             for ev in self._events.values():
@@ -132,6 +156,7 @@ class TaskEventBuffer:
         worker, one group per node — loadable in chrome://tracing and
         Perfetto (reference: _private/state.py:471 chrome_tracing_dump)."""
         trace: List[Dict[str, Any]] = []
+        self._fold()
         with self._lock:
             events = list(self._events.values())
             spans = list(self._spans)

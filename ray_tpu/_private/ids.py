@@ -29,13 +29,14 @@ _NIL_FILL = b"\xff"
 
 class BaseID:
     SIZE = 0
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
             raise ValueError(
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}")
         self._bytes = bytes(binary)
+        self._hash = None
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -62,7 +63,12 @@ class BaseID:
         return type(other) is type(self) and other._bytes == self._bytes
 
     def __hash__(self) -> int:
-        return hash((type(self).__name__, self._bytes))
+        # Cached: IDs key every hot dict (object directory, running tasks)
+        # and re-hashing 20+ bytes per lookup showed up in dispatch profiles.
+        h = self._hash
+        if h is None:
+            h = self._hash = hash((type(self).__name__, self._bytes))
+        return h
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self._bytes.hex()})"
@@ -106,12 +112,36 @@ class ActorID(BaseID):
         return JobID(self._bytes[: JobID.SIZE])
 
 
+class _RandPool:
+    """Buffered os.urandom: one syscall per ~680 ids instead of one per id
+    (TaskID.of is on the per-call submit path)."""
+
+    __slots__ = ("_buf", "_pos", "_lock")
+
+    def __init__(self):
+        self._buf = b""
+        self._pos = 1 << 30
+        self._lock = threading.Lock()
+
+    def take(self, n: int) -> bytes:
+        with self._lock:
+            pos = self._pos
+            if pos + n > len(self._buf):
+                self._buf = os.urandom(4096)
+                pos = 0
+            self._pos = pos + n
+            return self._buf[pos:pos + n]
+
+
+_rand_pool = _RandPool()
+
+
 class TaskID(BaseID):
     SIZE = ActorID.SIZE + 6
 
     @classmethod
     def of(cls, actor_id: ActorID) -> "TaskID":
-        return cls(actor_id.binary() + os.urandom(6))
+        return cls(actor_id.binary() + _rand_pool.take(6))
 
     @classmethod
     def for_driver(cls, job_id: JobID) -> "TaskID":

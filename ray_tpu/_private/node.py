@@ -26,10 +26,12 @@ import sys
 import tempfile
 import threading
 import time
+from select import select as _select
 from dataclasses import dataclass, field
 from multiprocessing.connection import Listener
 from typing import Any, Callable, Dict, List, Optional, Set
 
+from . import wire
 from .config import Config
 from .controller import NodeInfo
 from .ids import ActorID, NodeID, TaskID, WorkerID
@@ -43,6 +45,16 @@ from .resources import ResourceSet, TPU
 IDLE = "idle"
 BUSY = "busy"
 DEAD = "dead"
+
+_WIRE_NAMES = {wire.RUN_TASK: "RunTask", wire.TASK_DONE: "TaskDone"}
+
+
+def _wire_msg_name(msg) -> str:
+    """Message-class name for chaos config matching; wire tuples map back
+    to the dataclass names so existing testing_rpc_failure specs apply."""
+    if type(msg) is tuple:
+        return _WIRE_NAMES.get(msg[0], str(msg[0]))
+    return type(msg).__name__
 
 
 @dataclass
@@ -108,10 +120,23 @@ class NodeManager:
         # — N reader threads ping-ponging the GIL with the dispatch thread
         # measurably halved task throughput at 8+ workers.
         self._poll_conns: Dict[Any, WorkerHandle] = {}
+        self._conns_version = 0
         self._poll_wake_r, self._poll_wake_w = os.pipe()
         self._poller = threading.Thread(target=self._poll_loop,
                                         name="node-poller", daemon=True)
         self._poller.start()
+        # Outgoing messages ride one sender thread: callers enqueue (cheap)
+        # and move on; the sender coalesces everything queued per worker
+        # into a single list frame — one pickle, one write — so a burst of
+        # dispatches costs O(batches) syscalls instead of O(tasks)
+        # (reference: the C++ core worker's pooled gRPC streams amortize
+        # the same way).
+        import collections
+        self._outbox: Any = collections.deque()
+        self._out_ev = threading.Event()
+        self._sender = threading.Thread(target=self._send_loop,
+                                        name="node-sender", daemon=True)
+        self._sender.start()
         self._acceptor = threading.Thread(target=self._accept_loop,
                                           name="node-acceptor", daemon=True)
         self._acceptor.start()
@@ -178,6 +203,7 @@ class NodeManager:
             handle.ready.set()
             with self._lock:
                 self._poll_conns[conn] = handle
+                self._conns_version += 1
             self._wake_poller()
 
     def _wake_poller(self) -> None:
@@ -190,43 +216,198 @@ class NodeManager:
         """Single event loop over all worker pipes (reference: the
         raylet's asio loop servicing every worker connection).
 
+        The selector is persistent — connections register once when they
+        land and unregister at death — because rebuilding a selector per
+        poll (multiprocessing.connection.wait's behavior) re-registered
+        every fd every iteration and showed up directly in dispatch
+        profiles.  After a conn turns readable, every already-buffered
+        frame is drained before re-polling.
+
         Known tradeoff: recv() after readability is frame-blocking, so a
         worker stopped mid-frame (SIGSTOP) would stall the loop — the
         per-worker-thread model confined that to one worker but cost ~2x
         task throughput in GIL ping-pong.  True non-blocking framing
         belongs in the native transport when this pipe moves to C++.
         """
-        from multiprocessing.connection import wait as _mpwait
+        import selectors
+        sel = selectors.DefaultSelector()
+        sel.register(self._poll_wake_r, selectors.EVENT_READ, None)
+        registered: Dict[Any, Any] = {}  # conn -> handle
+        seen_version = -1
         while not self._closed:
             with self._lock:
-                conns = list(self._poll_conns)
+                version = self._conns_version
+                current = dict(self._poll_conns) if version != seen_version \
+                    else None
+            if current is not None:
+                seen_version = version
+                for c in list(registered):
+                    if c not in current:
+                        registered.pop(c)
+                        try:
+                            sel.unregister(c)
+                        except (KeyError, ValueError, OSError):
+                            pass
+                for c, h in current.items():
+                    if c not in registered:
+                        try:
+                            sel.register(c, selectors.EVENT_READ, h)
+                        except (KeyError, ValueError, OSError):
+                            # fd already dead (worker crashed between accept
+                            # and registration): run the death path now —
+                            # no EOF event will ever arrive for this conn.
+                            with self._lock:
+                                self._poll_conns.pop(c, None)
+                                self._conns_version += 1
+                            seen_version = -1
+                            self._on_worker_death(h)
+                            continue
+                        registered[c] = h
             try:
-                ready = _mpwait(conns + [self._poll_wake_r], timeout=1.0)
+                events = sel.select(timeout=1.0)
             except OSError:
-                ready = []
-            for c in ready:
+                events = []
+            for key, _mask in events:
+                c = key.fileobj
                 if c is self._poll_wake_r:
                     try:
                         os.read(self._poll_wake_r, 4096)
                     except OSError:
                         pass
                     continue
-                with self._lock:
-                    handle = self._poll_conns.get(c)
-                if handle is None:
-                    continue
+                handle = key.data
+                # Drain every buffered frame before re-polling (cap keeps
+                # one chatty worker from starving the rest).
+                for _ in range(64):
+                    try:
+                        frame = c.recv()
+                    except (EOFError, OSError):
+                        with self._lock:
+                            self._poll_conns.pop(c, None)
+                            self._conns_version += 1
+                        registered.pop(c, None)
+                        try:
+                            sel.unregister(c)
+                        except (KeyError, ValueError, OSError):
+                            pass
+                        self._on_worker_death(handle)
+                        break
+                    if type(frame) is list:
+                        # Per-message isolation: one bad message must not
+                        # drop the rest of its batch (a lost TaskDone
+                        # hangs the caller forever).
+                        for m in frame:
+                            try:
+                                self._handle_msg(handle, m)
+                            except Exception:
+                                import traceback
+                                traceback.print_exc()
+                    else:
+                        try:
+                            self._handle_msg(handle, frame)
+                        except Exception:
+                            import traceback
+                            traceback.print_exc()
+                    try:
+                        # Raw select probe: Connection.poll(0) builds a
+                        # fresh selector object per call (~15us); this is
+                        # one cheap syscall.
+                        readable, _, _ = _select([c], [], [], 0)
+                    except (OSError, ValueError):
+                        break
+                    if not readable:
+                        break
+        sel.close()
+
+    def _send_loop(self) -> None:
+        """Drain the outbox, grouping queued messages per worker into one
+        list frame (single pickle + single write).  FIFO order within a
+        worker is preserved — actor-method ordering and the
+        creation-before-methods invariant depend on it."""
+        outbox, ev = self._outbox, self._out_ev
+        while True:
+            ev.wait()
+            ev.clear()
+            if self._closed:
+                # Checked after clear(): a close racing the wakeup must not
+                # have its set() erased and leave join() to time out.
+                return
+            groups: List[tuple] = []  # (handle, [msgs]) in first-seen order
+            index: Dict[int, int] = {}
+            while True:
                 try:
-                    msg = c.recv()
-                except (EOFError, OSError):
-                    with self._lock:
-                        self._poll_conns.pop(c, None)
-                    self._on_worker_death(handle)
-                    continue
+                    handle, msg = outbox.popleft()
+                except IndexError:
+                    break
+                i = index.get(id(handle))
+                if i is None:
+                    index[id(handle)] = len(groups)
+                    groups.append((handle, [msg]))
+                else:
+                    groups[i][1].append(msg)
+            for handle, msgs in groups:
                 try:
-                    self._handle_msg(handle, msg)
+                    with handle.send_lock:
+                        if handle.conn is None:
+                            # Worker still booting (async spawn): queue in
+                            # order; the acceptor flushes on registration.
+                            handle.pending_msgs.extend(msgs)
+                            continue
+                        handle.conn.send(msgs if len(msgs) > 1 else msgs[0])
+                except (BrokenPipeError, OSError):
+                    pass  # poll loop will notice the death
                 except Exception:
-                    import traceback
-                    traceback.print_exc()
+                    # e.g. an unpicklable field: isolate the poisonous
+                    # message so the rest of the batch (and this thread!)
+                    # survives — a dead sender wedges all outbound traffic.
+                    self._send_individually(handle, msgs)
+
+    def _send_individually(self, handle: WorkerHandle, msgs: List) -> None:
+        for m in msgs:
+            try:
+                with handle.send_lock:
+                    if handle.conn is None:
+                        handle.pending_msgs.append(m)
+                    else:
+                        handle.conn.send(m)
+            except (BrokenPipeError, OSError):
+                return
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                # A RunTask that can't serialize must fail its task, not
+                # silently hang the caller — and the node-side worker/pin
+                # state must unwind as if the task had died.
+                tid = None
+                if type(m) is tuple and m[0] == wire.RUN_TASK:
+                    try:
+                        tid = TaskID(m[1])
+                    except ValueError:
+                        pass
+                elif isinstance(m, RunTask):
+                    tid = m.spec.task_id
+                if tid is not None:
+                    self._abort_sent_task(handle, tid)
+                if type(m) is tuple and m[0] == wire.RUN_TASK:
+                    self.runtime.fail_task_bytes(
+                        m[1], m[6], "task message failed to serialize")
+                elif isinstance(m, RunTask):
+                    self.runtime.fail_task_bytes(
+                        m.spec.task_id.binary(),
+                        [r.binary() for r in m.spec.return_ids],
+                        "task message failed to serialize")
+
+    def _abort_sent_task(self, handle: WorkerHandle, task_id: TaskID) -> None:
+        """Unwind node-side state for a task whose RunTask never made it to
+        the worker (sender-side failure): drop running/meta, release arg
+        pins, return the worker to the pool."""
+        handle.running.discard(task_id)
+        handle.task_meta.pop(task_id, None)
+        if self._native_store:
+            for k in handle.arg_pins.pop(task_id, []):
+                self.store.unpin_key(k)
+        if handle.actor_id is None and not handle.dedicated:
+            self._release_worker(handle)
 
     def _spawn_worker(self, env: Optional[Dict[str, str]] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
@@ -472,13 +653,14 @@ class NodeManager:
             import copy as _copy
             spec = _copy.copy(spec)
             spec.runtime_env = dict(spec.runtime_env or {}, env_vars=env_vars)
-        if spec.fn_id is not None and spec.fn_blob is not None:
+        fn_blob = spec.fn_blob
+        if spec.fn_id is not None and fn_blob is not None:
             if spec.fn_id in handle.seen_fns:
-                # Worker already holds this function: ship the spec without
+                # Worker already holds this function: ship the frame without
                 # the blob (workers fall back to a ctl fetch on a miss).
-                import copy as _copy
-                spec = _copy.copy(spec)
-                spec.fn_blob = None
+                # The strip happens at encode time — the driver-side spec
+                # (lineage, retries) keeps its blob.
+                fn_blob = None
             else:
                 handle.seen_fns.add(spec.fn_id)
         if self._native_store:
@@ -495,7 +677,17 @@ class NodeManager:
             and spec.retry_count < spec.max_retries)
         self.runtime.note_task_running(spec.task_id, self.info.node_id,
                                        handle.worker_id)
-        self._send(handle, RunTask(spec, resolved_args, resolved_kwargs))
+        if spec.create_actor_id is None:
+            # Hot path: compact tuple frame (no dataclass pickling, no
+            # double-shipped arg payloads) — see wire.py.
+            self._send(handle, wire.encode_run_task(
+                spec, resolved_args, resolved_kwargs, fn_blob))
+        else:
+            if fn_blob is not spec.fn_blob:
+                import copy as _copy
+                spec = _copy.copy(spec)
+                spec.fn_blob = fn_blob
+            self._send(handle, RunTask(spec, resolved_args, resolved_kwargs))
         if spec.create_actor_id is not None:
             # Bind only after the creation message is on the wire so queued
             # method calls can never overtake __init__ on the worker pipe.
@@ -573,22 +765,18 @@ class NodeManager:
             self.store.unpin_key(k)
 
     def _send(self, handle: WorkerHandle, msg) -> None:
-        name = type(msg).__name__
-        delay_us = Config.get("testing_delay_us")
-        if delay_us:
-            time.sleep(random.random() * delay_us / 1e6)
-        p = self._drop_probs.get(name)
-        if p and random.random() < p:
-            return  # chaos: message dropped
-        try:
-            with handle.send_lock:
-                if handle.conn is None:
-                    # Worker still booting (async spawn): queue in order.
-                    handle.pending_msgs.append(msg)
-                    return
-                handle.conn.send(msg)
-        except (BrokenPipeError, OSError):
-            pass  # reader loop will notice the death
+        if self._drop_probs or Config.get("testing_delay_us"):
+            # Chaos hooks run on the caller (per message, pre-queue) so
+            # drop/delay semantics are unchanged by sender coalescing.
+            name = _wire_msg_name(msg)
+            delay_us = Config.get("testing_delay_us")
+            if delay_us:
+                time.sleep(random.random() * delay_us / 1e6)
+            p = self._drop_probs.get(name)
+            if p and random.random() < p:
+                return  # chaos: message dropped
+        self._outbox.append((handle, msg))
+        self._out_ev.set()
 
     def send_to_worker(self, worker_id: WorkerID, msg) -> None:
         with self._lock:
@@ -600,6 +788,11 @@ class NodeManager:
 
     def _handle_msg(self, handle: WorkerHandle, msg) -> None:
         rt = self.runtime
+        if type(msg) is tuple:
+            if msg[0] == wire.TASK_DONE:
+                self._handle_msg(handle, wire.decode_task_done(msg))
+                return
+            raise ValueError(f"unknown wire frame tag {msg[0]!r}")
         if isinstance(msg, WorkerReady):
             handle.ready.set()
         elif isinstance(msg, TaskDone):
@@ -619,6 +812,11 @@ class NodeManager:
             # device locks until process exit, so reuse must wait for
             # _on_worker_death (actors and dedicated task workers alike).
             is_actor_worker = handle.actor_id is not None
+            if not is_actor_worker and not handle.dedicated:
+                # Release BEFORE the done callback: lease-reuse dispatch
+                # inside on_task_done then lands on this (hot, LIFO-first)
+                # worker instead of spawning a new one.
+                self._release_worker(handle)
             rt.on_task_done(msg, self.info.node_id)
             if not is_actor_worker:
                 if handle.dedicated:
@@ -636,8 +834,6 @@ class NodeManager:
                     t = threading.Timer(2.0, _ensure_dead)
                     t.daemon = True
                     t.start()
-                else:
-                    self._release_worker(handle)
         elif isinstance(msg, SubmitFromWorker):
             rt.submit_spec(msg.spec)
         elif isinstance(msg, GetRequest):
@@ -800,6 +996,8 @@ class NodeManager:
     def shutdown(self) -> None:
         self._closed = True
         self.memory_monitor.stop()
+        self._out_ev.set()  # sender thread sees _closed and exits
+        self._sender.join(timeout=3.0)
         self._wake_poller()
         # The acceptor must be OUT of accept() before the listener fd is
         # closed: a thread blocked in accept() on a closed fd can adopt

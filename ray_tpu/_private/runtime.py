@@ -319,7 +319,10 @@ class Runtime:
         self.scheduler.notify_object_ready(object_id)
         if self._gc_enabled:
             # The ref was dropped while the producing task was in flight:
-            # collect the result now that it has landed.
+            # collect the result now that it has landed.  (The lock is
+            # required for the check: an unlocked emptiness pre-check races
+            # with the drop path's insert — drop reads event-unset, we set
+            # it and see _dropped still empty, drop inserts -> leak.)
             with self._ref_lock:
                 collect = object_id in self._dropped and \
                     self._collectable_locked(object_id)
@@ -775,8 +778,13 @@ class Runtime:
             # Function table (reference: GCS function_manager): workers
             # fetch by id when a stripped spec misses their local cache.
             self._fn_table[spec.fn_id] = spec.fn_blob
-        for oid in spec.return_ids:
-            self._state(oid)
+        if self._gc_enabled:
+            # Pre-create return states so a ref dropped while the task is
+            # in flight is distinguishable from a never-existed object:
+            # remove_local_ref defers those frees to mark_ready via
+            # _dropped, which needs the pending state to exist.
+            for oid in spec.return_ids:
+                self._state(oid)
         self._retain_deps(spec)
         self._record_lineage(spec)
         if spec.actor_id is not None:
@@ -1076,17 +1084,34 @@ class Runtime:
             self.events.record(msg.task_id.hex(), FINISHED)
             for oid, desc in msg.results:
                 self.mark_ready(oid, desc)
-            self._finish_recovery(msg.task_id)
+            if self._recovering:
+                self._finish_recovery(msg.task_id)
         if spec is not None and spec.create_actor_id is None:
             # Actor creation keeps its resources for the actor's lifetime.
             if not spec.resources.is_empty() or spec.placement_group is not None:
-                self.scheduler.release(node_id, spec.resources,
-                                       spec.placement_group, spec.bundle_index)
+                from .resources import TPU as _TPU
+                if msg.error is None and spec.actor_id is None \
+                        and spec.placement_group is None \
+                        and spec.runtime_env is None \
+                        and spec.scheduling_strategy is None \
+                        and spec.resources.get(_TPU) == 0:
+                    # Lease reuse: hand the booking straight to the next
+                    # queued task of this class and dispatch it onto the
+                    # just-freed worker — no release/re-book round trip
+                    # through the scheduler loop.
+                    nxt = self.scheduler.exchange_finished(node_id, spec)
+                    if nxt is not None:
+                        self.scheduler._dispatch_safely(
+                            nxt.spec, nxt.dispatch, node_id)
+                else:
+                    self.scheduler.release(node_id, spec.resources,
+                                           spec.placement_group,
+                                           spec.bundle_index)
         if resubmit:
             # Deps stay retained across the resubmit (releasing first could
             # let GC free a sibling input that nothing would re-produce).
             self.submit_spec(spec)
-        else:
+        elif self._deps_retained:
             self._release_deps(msg.task_id)
 
     def on_dispatch_failed(self, spec: TaskSpec, reason: str,
@@ -1107,6 +1132,38 @@ class Runtime:
                 self.submit_spec(spec)
                 return
         self._fail_task(spec, WorkerCrashedError(reason))
+
+    def fail_task_bytes(self, task_id_bytes: bytes, return_id_bytes,
+                        reason: str) -> None:
+        """Fail a task known only by its wire-frame ids (sender-side
+        serialization failure).  The tracked running spec — if still there
+        — provides the resource booking to release; without it, fall back
+        to erroring the raw return ids."""
+        try:
+            task_id = TaskID(task_id_bytes)
+        except ValueError:
+            return
+        with self._running_lock:
+            running = self._running.pop(task_id, None)
+        if running is not None:
+            spec = running.spec
+            if spec.create_actor_id is None and (
+                    not spec.resources.is_empty()
+                    or spec.placement_group is not None):
+                self.scheduler.release(running.node_id, spec.resources,
+                                       spec.placement_group,
+                                       spec.bundle_index)
+            self._fail_task(spec, WorkerCrashedError(reason))
+            return
+        self.events.record(task_id.hex(), FAILED, error_message=reason)
+        desc = ("err", serialization.pack_payload(WorkerCrashedError(reason)))
+        for rb in return_id_bytes:
+            try:
+                self.mark_ready(ObjectID(rb), desc)
+            except ValueError:
+                pass
+        self._release_deps(task_id)
+        self._finish_recovery(task_id)
 
     def _fail_task(self, spec: TaskSpec, exc: Exception) -> None:
         self.events.record(spec.task_id.hex(), FAILED, name=spec.name,

@@ -136,23 +136,75 @@ class ClusterScheduler:
         # become ready between the check and registration, and
         # notify_object_ready (which holds the same lock) would then have
         # already fired, stranding the task in _waiting forever.
+        inline_node: Optional[NodeID] = None
         with self._wake:
             unresolved = {d for d in deps if not self._object_ready(d)}
-            task = _PendingTask(spec, unresolved, dispatch,
-                                self._sched_key(spec))
-            if unresolved:
-                for d in unresolved:
-                    self._waiting[d].append(task)
-            else:
-                self._push_ready_locked(task)
-                # Wake the loop only when the task has a chance of placing
-                # right now: with every worker busy, the wakeup is a pure
-                # GIL handoff per submit (measured ~100us each at 2k
-                # submits/s) and release() will wake the loop anyway when
-                # capacity frees.  Both paths hold this lock, so the
-                # check-then-notify cannot miss a concurrent release.
-                if self._capacity_hint(spec):
-                    self._wake.notify_all()
+            if not unresolved and not self._ready_count \
+                    and not self._pending_pgs:
+                # Submit-time fast path: with an empty queue, place and
+                # book right here and dispatch on the caller's thread —
+                # no scheduler-loop wakeup, no GIL handoff per task
+                # (reference: normal_task_submitter.cc:142 pipelines
+                # lease grants the same way).
+                inline_node = self._try_place(spec)
+            if inline_node is None:
+                task = _PendingTask(spec, unresolved, dispatch,
+                                    self._sched_key(spec))
+                if unresolved:
+                    for d in unresolved:
+                        self._waiting[d].append(task)
+                else:
+                    self._push_ready_locked(task)
+                    # Wake the loop only when the task has a chance of
+                    # placing right now: with every worker busy, the wakeup
+                    # is a pure GIL handoff per submit (measured ~100us
+                    # each at 2k submits/s) and release() will wake the
+                    # loop anyway when capacity frees.  Both paths hold
+                    # this lock, so the check-then-notify cannot miss a
+                    # concurrent release.
+                    if self._capacity_hint(spec):
+                        self._wake.notify_all()
+        if inline_node is not None:
+            self._dispatch_safely(spec, dispatch, inline_node)
+
+    def _dispatch_safely(self, spec: TaskSpec, dispatch, node_id: NodeID):
+        try:
+            dispatch(spec, node_id)
+        except Exception as exc:
+            # Undo the resource deduction and surface the error; silently
+            # dropping would leak capacity and hang get().
+            self.release(node_id, spec.resources, spec.placement_group,
+                         spec.bundle_index)
+            if self.on_dispatch_error is not None:
+                try:
+                    self.on_dispatch_error(spec, exc)
+                except Exception:
+                    pass
+
+    def exchange_finished(self, node_id: NodeID,
+                          spec: TaskSpec) -> Optional[_PendingTask]:
+        """A task of ``spec``'s scheduling class just finished on
+        ``node_id``: transfer its resource booking to a queued task of the
+        SAME class and return it for immediate dispatch (lease reuse,
+        reference: normal-task lease pipelining) — or release the booking
+        and return None.  Caller restricts this to plain tasks (no PG, no
+        TPU grant, no runtime_env)."""
+        key = self._sched_key(spec)
+        with self._wake:
+            # Reuse only while this class is the ONLY queued class and the
+            # scheduler is live: with other classes waiting, release and
+            # let the loop's FIFO-over-classes scan arbitrate — an endless
+            # same-class stream must not starve earlier-queued classes.
+            bucket = self._ready.get(key)
+            if bucket and self._running and len(self._ready) == 1 \
+                    and not self._pending_pgs:
+                task = bucket.popleft()
+                self._ready_count -= 1
+                if not bucket:
+                    self._ready.pop(key, None)
+                return task
+        self.release(node_id, spec.resources)
+        return None
 
     def _capacity_hint(self, spec: TaskSpec) -> bool:
         """Cheap may-fit check (false negatives are latency-free thanks to
@@ -251,20 +303,7 @@ class ClusterScheduler:
                     # free (release/notify wake us).
                     self._wake.wait(timeout=0.05)
             for task, node_id in to_dispatch:
-                try:
-                    task.dispatch(task.spec, node_id)
-                except Exception as exc:
-                    # Undo the resource deduction and surface the error;
-                    # silently dropping would leak capacity and hang get().
-                    spec = task.spec
-                    self.release(node_id, spec.resources,
-                                 spec.placement_group,
-                                 spec.bundle_index)
-                    if self.on_dispatch_error is not None:
-                        try:
-                            self.on_dispatch_error(spec, exc)
-                        except Exception:
-                            pass
+                self._dispatch_safely(task.spec, task.dispatch, node_id)
 
     def stop(self) -> None:
         with self._wake:

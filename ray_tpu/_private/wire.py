@@ -1,0 +1,112 @@
+"""Compact wire encoding for the hot node<->worker messages.
+
+The reference amortizes per-task cost in a C++ core worker (reference:
+src/ray/core_worker/task_submission/normal_task_submitter.cc:142 lease
+pipelining; core_worker.h:167): task pushes and replies are protobufs on
+pooled gRPC streams.  The Python control plane here gets its throughput
+back a different way:
+
+  * RunTask / TaskDone travel as plain tuples of bytes/str/int — pickling
+    one is ~12x cheaper than pickling the nested dataclasses, and the
+    frame is ~5x smaller (no class references, no ResourceSet, and the
+    argument payloads are not double-shipped through both ``spec.arg_descs``
+    and the resolved args).
+  * Senders coalesce: a connection's pending messages go out as ONE list
+    frame (one pickle, one write) — see node.py ``_SendLoop`` and
+    worker.py ``WorkerRuntime._send_loop``.
+
+Only the hot messages are encoded here; everything else (actor creation,
+gets, control calls) stays as protocol.py dataclasses on the same pipes.
+A list frame means "batch"; a tuple frame dispatches on its tag string;
+anything else is a cold-path dataclass.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, ObjectID, TaskID, WorkerID
+
+RUN_TASK = "rt"
+TASK_DONE = "td"
+
+
+class WireSpec:
+    """Worker-side view of a task spec, rebuilt from a wire tuple.
+
+    Carries exactly the fields worker.py reads; driver-side scheduling
+    state (resources, placement group, retry counts) never crosses the
+    pipe for hot-path tasks.
+    """
+
+    __slots__ = ("task_id", "name", "fn_blob", "fn_id", "method_name",
+                 "return_ids", "actor_id", "create_actor_id", "streaming",
+                 "max_concurrency", "runtime_env")
+
+    def __init__(self, task_id, name, fn_blob, fn_id, method_name,
+                 return_ids, actor_id, streaming, max_concurrency,
+                 runtime_env):
+        self.task_id = task_id
+        self.name = name
+        self.fn_blob = fn_blob
+        self.fn_id = fn_id
+        self.method_name = method_name
+        self.return_ids = return_ids
+        self.actor_id = actor_id
+        self.create_actor_id = None
+        self.streaming = streaming
+        self.max_concurrency = max_concurrency
+        self.runtime_env = runtime_env
+
+
+def encode_run_task(spec, args: List, kwargs: Dict,
+                    fn_blob: Optional[bytes] = None) -> tuple:
+    """spec -> wire tuple.  Caller guarantees spec.create_actor_id is None
+    (creation ships the full dataclass: cold path, needs every field).
+    ``fn_blob`` is the possibly-stripped blob for THIS worker (the node
+    drops it once a worker has seen the fn_id)."""
+    return (RUN_TASK,
+            spec.task_id.binary(),
+            spec.name,
+            fn_blob,
+            spec.fn_id,
+            spec.method_name,
+            tuple(r.binary() for r in spec.return_ids),
+            spec.actor_id.binary() if spec.actor_id is not None else None,
+            spec.streaming,
+            spec.max_concurrency,
+            spec.runtime_env.get("env_vars") if spec.runtime_env else None,
+            args,
+            kwargs)
+
+
+def decode_run_task(t: tuple):
+    """wire tuple -> (WireSpec, args, kwargs)."""
+    env_vars = t[10]
+    return (WireSpec(
+        TaskID(t[1]), t[2], t[3], t[4], t[5],
+        [ObjectID(b) for b in t[6]],
+        ActorID(t[7]) if t[7] is not None else None,
+        t[8], t[9],
+        {"env_vars": env_vars} if env_vars else None,
+    ), t[11], t[12])
+
+
+def encode_task_done(task_id_bytes: bytes, worker_id_bytes: bytes,
+                     results: List[Tuple[bytes, tuple]],
+                     error: Optional[tuple], is_application_error: bool,
+                     actor_id_bytes: Optional[bytes],
+                     execution_time_s: float) -> tuple:
+    return (TASK_DONE, task_id_bytes, worker_id_bytes, results, error,
+            is_application_error, actor_id_bytes, execution_time_s)
+
+
+def decode_task_done(t: tuple):
+    """wire tuple -> protocol.TaskDone (driver side)."""
+    from .protocol import TaskDone
+    return TaskDone(
+        TaskID(t[1]), WorkerID(t[2]),
+        [(ObjectID(b), desc) for b, desc in t[3]],
+        t[4], t[5],
+        ActorID(t[6]) if t[6] is not None else None,
+        t[7])
