@@ -51,6 +51,7 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[int] = None,
          head_port: Optional[int] = None,
          cluster_token: Optional[bytes] = None,
          address: Optional[str] = None,
+         state_dir: Optional[str] = None,
          **_compat: Any):
     """Start the ray_tpu runtime in this process (driver).
 
@@ -86,7 +87,7 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[int] = None,
         return _runtime_mod.init_runtime(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
             namespace=namespace, head_port=head_port,
-            cluster_token=cluster_token)
+            cluster_token=cluster_token, state_dir=state_dir)
 
 
 def is_initialized() -> bool:

@@ -973,6 +973,10 @@ class NodeServer:
                                    self.node_id.binary(),
                                    self._resolve_address)
         self._closed = False
+        # Set by a NodeShutdown from the head: a deliberate stop, as
+        # opposed to a dropped head connection (which triggers rejoin in
+        # run_node_server).
+        self.stop_requested = False
         # Dispatch and worker-bound messages run on their own ordered
         # queues: localizing args may block on peer pulls (or a NodeRpc to
         # the head, whose reply arrives on the serve thread) — processing
@@ -1109,6 +1113,7 @@ class NodeServer:
                 except Exception:
                     pass
         elif isinstance(msg, NodeShutdown):
+            self.stop_requested = True
             self._closed = True
 
     def _localize_get_reply(self, worker_id: WorkerID,
@@ -1150,11 +1155,43 @@ def run_node_server(head_host: str, head_port: int, token: bytes,
                     num_cpus: Optional[float] = None,
                     num_tpus: Optional[int] = None,
                     resources: Optional[Dict[str, float]] = None,
-                    advertise_host: str = "127.0.0.1") -> None:
-    server = NodeServer((head_host, head_port), token, num_cpus=num_cpus,
+                    advertise_host: str = "127.0.0.1",
+                    reconnect_window_s: float = 60.0) -> None:
+    """Run a joined node, re-registering with the head if the control
+    connection drops (head restart, reference: raylets reconnecting after
+    GCS failover).  The node rejoins with a fresh identity: the restarted
+    head re-plans PG bundles and restarts actors onto re-registered nodes
+    via the normal node-death/revival paths, so no per-node state needs to
+    survive the reconnect."""
+    import time as _time
+    while True:
+        try:
+            server = NodeServer(
+                (head_host, head_port), token, num_cpus=num_cpus,
+                num_tpus=num_tpus, resources=resources,
+                advertise_host=advertise_host)
+        except (ConnectionRefusedError, OSError, EOFError):
+            deadline = _time.monotonic() + reconnect_window_s
+            ok = False
+            while _time.monotonic() < deadline:
+                _time.sleep(1.0)
+                try:
+                    server = NodeServer(
+                        (head_host, head_port), token, num_cpus=num_cpus,
                         num_tpus=num_tpus, resources=resources,
                         advertise_host=advertise_host)
-    server.serve_forever()
+                    ok = True
+                    break
+                except (ConnectionRefusedError, OSError, EOFError):
+                    continue
+            if not ok:
+                raise
+        server.serve_forever()
+        if server.stop_requested:
+            return
+        # serve_forever returned because the head connection dropped; loop
+        # to rejoin (the server shut down its local plane — a fresh one
+        # spawns clean worker pools).
 
 
 def main(argv=None) -> int:

@@ -161,6 +161,10 @@ D("enable_object_gc", bool, True,
 D("lineage_max_entries", int, 50000,
   "Bounded lineage table: task specs kept for object reconstruction, "
   "LRU-evicted (reference: ray_config_def.h max_lineage_bytes analog).")
+D("head_wal_fsync", bool, False,
+  "fsync each head-state WAL append.  Off by default: flush-per-append "
+  "already survives head-process death (the protected failure mode); "
+  "fsync buys machine-crash durability at write-latency cost.")
 D("object_reconstruction_max_attempts", int, 3,
   "How many times a lost object may be reconstructed by re-executing its "
   "producing task (reference: task_manager.h ResubmitTask retry caps).")

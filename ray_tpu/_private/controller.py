@@ -112,6 +112,59 @@ class Controller:
     # session's JSONL writer.  Signature: (source_type, event_dict).
     event_sink: Optional[Callable[[str, Dict[str, Any]], None]] = None
 
+    # Optional durable store (persist.StateStore); set by a Runtime started
+    # with a state_dir.  Every table mutation appends a replayable record
+    # (reference: GCS writing tables through its StoreClient).
+    persist: Optional[Any] = None
+
+    def _p(self, record: tuple) -> None:
+        store = self.persist
+        if store is not None:
+            try:
+                store.append(record)
+            except Exception:  # noqa: BLE001 — persistence must not break
+                pass
+
+    def restore(self, records: List[tuple]) -> None:
+        """Rebuild tables from a snapshot+WAL record stream (reference:
+        GcsInitData::AsyncLoad rebuilding managers on GCS restart).
+        Last record per key wins; node records are never persisted (nodes
+        re-register), and replayed bundle placements are reset by the
+        Runtime before re-planning."""
+        with self._lock:
+            for r in records:
+                kind = r[0]
+                if kind == "actor":
+                    info = r[1]
+                    self.actors[info.actor_id] = info
+                    if info.name:
+                        self.named_actors[(info.namespace, info.name)] = \
+                            info.actor_id
+                elif kind == "pg":
+                    self.placement_groups[r[1].pg_id] = r[1]
+                elif kind == "job":
+                    self.jobs[r[1].job_id] = r[1]
+                elif kind == "kv_put":
+                    self._kv.setdefault(r[1], {})[r[2]] = r[3]
+                elif kind == "kv_del":
+                    self._kv.get(r[1], {}).pop(r[2], None)
+
+    def snapshot_records(self) -> List[tuple]:
+        """Full table state as a compact record stream (for WAL
+        compaction)."""
+        with self._lock:
+            out: List[tuple] = []
+            for info in self.actors.values():
+                out.append(("actor", info))
+            for pg in self.placement_groups.values():
+                out.append(("pg", pg))
+            for job in self.jobs.values():
+                out.append(("job", job))
+            for ns, kv in self._kv.items():
+                for k, v in kv.items():
+                    out.append(("kv_put", ns, k, v))
+            return out
+
     def _export(self, source_type: str, event: Dict[str, Any]) -> None:
         sink = self.event_sink
         if sink is not None:
@@ -153,12 +206,15 @@ class Controller:
     def register_job(self, info: JobInfo) -> None:
         with self._lock:
             self.jobs[info.job_id] = info
+        self._p(("job", info))
 
     def finish_job(self, job_id: JobID) -> None:
         with self._lock:
             j = self.jobs.get(job_id)
             if j:
                 j.end_time = time.time()
+        if j:
+            self._p(("job", j))
 
     # -- actors -------------------------------------------------------------
 
@@ -174,6 +230,7 @@ class Controller:
                             f"actor name {info.name!r} already taken in "
                             f"namespace {info.namespace!r}")
                 self.named_actors[key] = info.actor_id
+        self._p(("actor", info))
 
     def set_actor_state(self, actor_id: ActorID, state: str,
                         node_id: Optional[NodeID] = None,
@@ -187,6 +244,7 @@ class Controller:
                 a.node_id = node_id
             if death_cause is not None:
                 a.death_cause = death_cause
+        self._p(("actor", a))
         self._export("EXPORT_ACTOR", {"actor_id": actor_id.hex(),
                                       "state": state,
                                       "death_cause": death_cause})
@@ -212,12 +270,15 @@ class Controller:
     def register_placement_group(self, info: PlacementGroupInfo) -> None:
         with self._lock:
             self.placement_groups[info.pg_id] = info
+        self._p(("pg", info))
 
     def set_pg_state(self, pg_id: PlacementGroupID, state: str) -> None:
         with self._lock:
             pg = self.placement_groups.get(pg_id)
             if pg:
                 pg.state = state
+        if pg:
+            self._p(("pg", pg))
         self.publish("pg_state", (pg_id, state))
 
     def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[PlacementGroupInfo]:
@@ -234,7 +295,8 @@ class Controller:
                 return False
             ns[key] = value
             self._kv_cond.notify_all()
-            return True
+        self._p(("kv_put", namespace, key, value))
+        return True
 
     def kv_wait(self, key: str, namespace: str = "default",
                 timeout: Optional[float] = None) -> Optional[bytes]:
@@ -260,7 +322,10 @@ class Controller:
 
     def kv_del(self, key: str, namespace: str = "default") -> bool:
         with self._lock:
-            return self._kv.get(namespace, {}).pop(key, None) is not None
+            existed = self._kv.get(namespace, {}).pop(key, None) is not None
+        if existed:
+            self._p(("kv_del", namespace, key))
+        return existed
 
     def kv_keys(self, prefix: str = "", namespace: str = "default") -> List[str]:
         with self._lock:

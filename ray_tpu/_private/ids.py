@@ -29,7 +29,7 @@ _NIL_FILL = b"\xff"
 
 class BaseID:
     SIZE = 0
-    __slots__ = ("_bytes", "_hash")
+    __slots__ = ("_bytes", "_hash", "_hex")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
@@ -37,6 +37,7 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}")
         self._bytes = bytes(binary)
         self._hash = None
+        self._hex = None
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -54,7 +55,10 @@ class BaseID:
         return self._bytes
 
     def hex(self) -> str:
-        return self._bytes.hex()
+        h = self._hex
+        if h is None:
+            h = self._hex = self._bytes.hex()
+        return h
 
     def is_nil(self) -> bool:
         return self._bytes == _NIL_FILL * self.SIZE

@@ -378,24 +378,19 @@ class NodeManager:
                 # A RunTask that can't serialize must fail its task, not
                 # silently hang the caller — and the node-side worker/pin
                 # state must unwind as if the task had died.
-                tid = None
                 if type(m) is tuple and m[0] == wire.RUN_TASK:
-                    try:
-                        tid = TaskID(m[1])
-                    except ValueError:
-                        pass
+                    ids = (m[1], m[6])
                 elif isinstance(m, RunTask):
-                    tid = m.spec.task_id
-                if tid is not None:
-                    self._abort_sent_task(handle, tid)
-                if type(m) is tuple and m[0] == wire.RUN_TASK:
-                    self.runtime.fail_task_bytes(
-                        m[1], m[6], "task message failed to serialize")
-                elif isinstance(m, RunTask):
-                    self.runtime.fail_task_bytes(
-                        m.spec.task_id.binary(),
-                        [r.binary() for r in m.spec.return_ids],
-                        "task message failed to serialize")
+                    ids = (m.spec.task_id.binary(),
+                           [r.binary() for r in m.spec.return_ids])
+                else:
+                    continue
+                try:
+                    self._abort_sent_task(handle, TaskID(ids[0]))
+                except ValueError:
+                    pass
+                self.runtime.fail_task_bytes(
+                    ids[0], ids[1], "task message failed to serialize")
 
     def _abort_sent_task(self, handle: WorkerHandle, task_id: TaskID) -> None:
         """Unwind node-side state for a task whose RunTask never made it to
@@ -694,8 +689,87 @@ class NodeManager:
             self.runtime.bind_actor_worker(
                 spec.create_actor_id, self.info.node_id, handle.worker_id)
 
+    def dispatch_actor_task(self, spec: TaskSpec, resolved_args,
+                            resolved_kwargs, worker_id: WorkerID) -> None:
+        """Slim dispatch for actor method calls: the worker is known and
+        bound, there is no env/chip/strategy work to do — just pin, track
+        and ship (reference: direct actor submission over the persistent
+        gRPC stream, actor_task_submitter.h)."""
+        with self._lock:
+            handle = self._workers.get(worker_id)
+        if handle is None or handle.state == DEAD:
+            self.runtime.on_dispatch_failed(spec, "target worker dead")
+            return
+        if self._native_store:
+            ok, resolved_args, resolved_kwargs = self._pin_args(
+                handle, spec, resolved_args, resolved_kwargs)
+            if not ok:
+                return
+        handle.running.add(spec.task_id)
+        handle.task_meta[spec.task_id] = (time.monotonic(), False)
+        self.runtime.note_task_running(spec.task_id, self.info.node_id,
+                                       handle.worker_id)
+        self._send(handle, wire.encode_run_task(
+            spec, resolved_args, resolved_kwargs, spec.fn_blob))
+
+    def dispatch_pipelined(self, spec: TaskSpec, resolved_args,
+                           resolved_kwargs, max_depth: int = 4) -> bool:
+        """Queue a plain task ahead on a busy pooled worker (pipelined
+        submission, reference: the C++ submitter's
+        max_tasks_in_flight_per_worker).  The task holds no resource
+        booking — per-worker execution is serial, so real parallelism
+        stays bounded by booked capacity; queueing ahead only hides the
+        TaskDone -> dispatch round-trip latency.  Returns False if no
+        worker has pipeline room."""
+        with self._lock:
+            best = None
+            best_depth = max_depth
+            for h in self._workers.values():
+                if (h.state in (BUSY, IDLE) and h.actor_id is None
+                        and not h.dedicated and h.env_key == ""
+                        and h.ready.is_set()
+                        and len(h.running) < best_depth):
+                    best = h
+                    best_depth = len(h.running)
+            if best is None:
+                return False
+            handle = best
+            claimed_idle = handle.state == IDLE
+            if claimed_idle:
+                # Claim it like _acquire_worker would (a worker released
+                # by lease reuse an instant ago, possibly with queued
+                # pipeline work).
+                handle.state = BUSY
+                bucket = self._idle.get(handle.env_key)
+                if bucket and handle.worker_id in bucket:
+                    bucket.remove(handle.worker_id)
+        if self._native_store:
+            ok, resolved_args, resolved_kwargs = self._pin_args(
+                handle, spec, resolved_args, resolved_kwargs,
+                release_on_fail=False)
+            if not ok:
+                if claimed_idle:
+                    # Revert the claim or the worker is stranded BUSY with
+                    # nothing running (unreachable by _acquire_worker).
+                    self._release_worker(handle)
+                return False
+        fn_blob = spec.fn_blob
+        if spec.fn_id is not None and fn_blob is not None:
+            if spec.fn_id in handle.seen_fns:
+                fn_blob = None
+            else:
+                handle.seen_fns.add(spec.fn_id)
+        handle.running.add(spec.task_id)
+        handle.task_meta[spec.task_id] = (
+            time.monotonic(), spec.retry_count < spec.max_retries)
+        self.runtime.note_task_running(spec.task_id, self.info.node_id,
+                                       handle.worker_id)
+        self._send(handle, wire.encode_run_task(
+            spec, resolved_args, resolved_kwargs, fn_blob))
+        return True
+
     def _pin_args(self, handle: WorkerHandle, spec: TaskSpec,
-                  resolved_args, resolved_kwargs):
+                  resolved_args, resolved_kwargs, release_on_fail=True):
         """Refresh + pin every arena descriptor among the resolved args.
 
         Pinning under the store lock guarantees the offsets we ship stay
@@ -734,6 +808,10 @@ class NodeManager:
         if not ok:
             for key in pinned:
                 self.store.unpin_key(key)
+            if not release_on_fail:
+                # Pipelined attempt: no booking to release, no failure to
+                # report — the caller just re-queues the task.
+                return False, resolved_args, resolved_kwargs
             if handle.dedicated:
                 # Chips stay in assigned_chips: they return to the pool only
                 # when the process death is observed (libtpu lock release).

@@ -1,0 +1,144 @@
+"""Head state persistence: WAL + snapshot for the controller tables.
+
+The reference's GCS survives restarts by writing its tables through a
+pluggable store (reference: src/ray/gcs/gcs_server.cc:164-189 choosing
+RedisStoreClient, gcs/store_client/redis_store_client.h) and rebuilding
+in-memory state from a full table read on boot (gcs_init_data.h
+GcsInitData::AsyncLoad).  Here the store is a local append-only WAL plus
+periodic snapshot in the head's state directory — the controller is a
+single writer, so a log of pickled mutation records replayed in order
+reconstructs the exact table state without any cross-table ordering
+machinery.
+
+What persists: actors (including pickled creation specs), named-actor
+bindings, placement groups (bundle *shapes*; node assignments are
+ephemeral and re-planned on restart), jobs, and the KV store.  What does
+NOT: node registrations (nodes re-register on reconnect, reference:
+raylets re-registering after GCS failover) and the object directory —
+object payloads live in the dead process's shm arena, so directory
+entries would dangle; lost objects are rebuilt by lineage reconstruction
+on the owning driver instead.
+
+Durability model: records are flushed (not fsynced) per append — a head
+process kill (the failure mode this protects against) loses nothing in
+the OS page cache; machine-level crash durability would need fsync and is
+configurable via ``head_wal_fsync``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+from typing import Any, Iterator, List, Optional
+
+_LEN = struct.Struct("<I")
+
+SNAPSHOT = "snapshot.bin"
+WAL = "wal.bin"
+
+
+class StateStore:
+    """Append-only record log with snapshot compaction.
+
+    Records are arbitrary picklable tuples; ``load()`` returns snapshot
+    records then WAL records, in append order.  A torn tail (partial final
+    record from a mid-write kill) is truncated silently.
+    """
+
+    def __init__(self, state_dir: str, fsync: bool = False,
+                 compact_every: int = 50_000):
+        self.dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+        self._fsync = fsync
+        self._lock = threading.Lock()
+        self._wal_path = os.path.join(state_dir, WAL)
+        self._snap_path = os.path.join(state_dir, SNAPSHOT)
+        self._wal_count = 0
+        self._compact_every = compact_every
+        self._wal_f = None  # opened lazily after any replay/compaction
+
+    # -- read side ----------------------------------------------------------
+
+    @staticmethod
+    def _read_records(path: str) -> List[Any]:
+        out: List[Any] = []
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return out
+        off = 0
+        n = len(data)
+        while off + _LEN.size <= n:
+            (rec_len,) = _LEN.unpack_from(data, off)
+            if off + _LEN.size + rec_len > n:
+                break  # torn tail from a mid-write kill
+            try:
+                out.append(pickle.loads(
+                    data[off + _LEN.size: off + _LEN.size + rec_len]))
+            except Exception:
+                break  # corrupt tail: stop at the last good record
+            off += _LEN.size + rec_len
+        return out
+
+    def load(self) -> List[Any]:
+        """All records in order (snapshot first, then WAL)."""
+        return (self._read_records(self._snap_path)
+                + self._read_records(self._wal_path))
+
+    # -- write side ---------------------------------------------------------
+
+    def _ensure_open(self):
+        if self._wal_f is None:
+            self._wal_f = open(self._wal_path, "ab")
+        return self._wal_f
+
+    def append(self, record: Any) -> None:
+        blob = pickle.dumps(record, protocol=5)
+        with self._lock:
+            f = self._ensure_open()
+            f.write(_LEN.pack(len(blob)))
+            f.write(blob)
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+            self._wal_count += 1
+        if self._wal_count >= self._compact_every and \
+                self.on_compact is not None:
+            try:
+                self.on_compact()
+            except Exception:
+                pass
+
+    # Set by the owner to a zero-arg callable that calls compact() with the
+    # current full state (the store can't snapshot tables it doesn't own).
+    on_compact: Optional[Any] = None
+
+    def compact(self, records: List[Any]) -> None:
+        """Replace snapshot+WAL with one snapshot of ``records``."""
+        with self._lock:
+            tmp = self._snap_path + ".tmp"
+            with open(tmp, "wb") as f:
+                for r in records:
+                    blob = pickle.dumps(r, protocol=5)
+                    f.write(_LEN.pack(len(blob)))
+                    f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._snap_path)
+            if self._wal_f is not None:
+                self._wal_f.close()
+                self._wal_f = None
+            try:
+                os.unlink(self._wal_path)
+            except FileNotFoundError:
+                pass
+            self._wal_count = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal_f is not None:
+                self._wal_f.close()
+                self._wal_f = None

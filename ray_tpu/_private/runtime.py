@@ -29,9 +29,9 @@ from . import serialization
 from .config import Config
 from .events import (FAILED, FINISHED, PENDING_ARGS, RUNNING,
                      SUBMITTED_TO_NODE, ProfileSpan, TaskEventBuffer)
-from .controller import (ALIVE, DEAD, PENDING_CREATION, RESTARTING,
-                         ActorInfo, Controller, JobInfo, NodeInfo,
-                         PlacementGroupInfo)
+from .controller import (ALIVE, DEAD, PENDING_CREATION, PG_PENDING,
+                         PG_REMOVED, RESTARTING, ActorInfo, Controller,
+                         JobInfo, NodeInfo, PlacementGroupInfo)
 from .exceptions import (ActorError, GetTimeoutError, ObjectLostError,
                          OutOfMemoryError, TaskError, WorkerCrashedError)
 from .ids import (ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID,
@@ -147,12 +147,33 @@ class Runtime:
                  namespace: str = "default",
                  head_port: Optional[int] = None,
                  cluster_token: Optional[bytes] = None,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 state_dir: Optional[str] = None):
         Config.initialize()
+        self.controller = Controller()
+        self.state_store = None
+        if state_dir:
+            # Head fault tolerance: replay persisted controller tables
+            # before anything registers (reference: gcs_server.cc loading
+            # GcsInitData on boot), then attach the WAL for new mutations.
+            from .persist import StateStore
+            store = StateStore(state_dir,
+                               fsync=bool(Config.get("head_wal_fsync")))
+            self.controller.restore(store.load())
+            self.controller.persist = store
+            store.on_compact = lambda: store.compact(
+                self.controller.snapshot_records())
+            self.state_store = store
+            # Job counter must advance past replayed jobs or the new
+            # driver job collides with a restored one.
+            import struct as _struct
+            with JobID._lock:
+                for j in self.controller.jobs:
+                    (val,) = _struct.unpack("<I", j.binary())
+                    JobID._counter = max(JobID._counter, val)
         self.job_id = JobID.next()
         self.namespace = namespace
         self.driver_task_id = TaskID.for_driver(self.job_id)
-        self.controller = Controller()
         self.controller.register_job(JobInfo(self.job_id))
 
         if num_tpus is None:
@@ -237,6 +258,10 @@ class Runtime:
 
         self.scheduler = ClusterScheduler(self.controller, self._object_ready)
         self.scheduler.on_dispatch_error = self._fail_task
+        self.scheduler.try_pipeline = self._try_pipeline
+        # Tasks queued ahead on a busy worker (pipelined submission):
+        # they hold no resource booking, so TaskDone skips release.
+        self._pipelined: set = set()
         self.node = NodeManager(node_info, self, num_tpu_chips=int(num_tpus or 0))
         self.scheduler.add_node(node_info)
         self.nodes: Dict[NodeID, NodeManager] = {self.node_id: self.node}
@@ -296,6 +321,48 @@ class Runtime:
                 max_workers=4, thread_name_prefix="head-xfer")
             threading.Thread(target=self._xfer_loop, name="head-xfer-ordered",
                              daemon=True).start()
+
+        if self.state_store is not None:
+            self._revive_persisted_state()
+
+    def _revive_persisted_state(self) -> None:
+        """After a head restart: re-plan replayed placement groups on the
+        fresh node set and restart replayed actors from their creation
+        specs (their workers died with the old head; restarting does NOT
+        consume the user's restart budget — reference: GCS failover
+        reconstructing actors from GcsInitData)."""
+        for pg in list(self.controller.placement_groups.values()):
+            if pg.state == PG_REMOVED:
+                continue
+            for b in pg.bundles:
+                b.node_id = None
+            pg.state = PG_PENDING
+            self.scheduler.create_placement_group(pg)
+        for info in list(self.controller.actors.values()):
+            if info.state == DEAD or info.creation_spec is None:
+                continue
+            with self._actors_lock:
+                self._actors[info.actor_id] = _ActorRuntimeState()
+            self.controller.set_actor_state(info.actor_id, RESTARTING)
+            self._submit_actor_creation(
+                self._restart_creation_spec(info.actor_id,
+                                            info.creation_spec))
+
+    @staticmethod
+    def _restart_creation_spec(actor_id: ActorID, spec: TaskSpec) -> TaskSpec:
+        """Fresh creation TaskSpec for restarting an actor from its
+        original creation spec (new task id; returns already delivered)."""
+        return TaskSpec(
+            task_id=TaskID.of(actor_id), name=spec.name,
+            fn_blob=spec.fn_blob, method_name=None,
+            arg_descs=spec.arg_descs, kwarg_descs=spec.kwarg_descs,
+            return_ids=[], resources=spec.resources,
+            create_actor_id=actor_id, max_retries=0,
+            placement_group=spec.placement_group,
+            bundle_index=spec.bundle_index,
+            scheduling_strategy=spec.scheduling_strategy,
+            runtime_env=spec.runtime_env,
+            max_concurrency=spec.max_concurrency)
 
     # ------------------------------------------------------------------ #
     # object directory
@@ -389,10 +456,23 @@ class Runtime:
             self.mark_ready(object_id, self.node.store.descriptor(object_id))
         return object_id
 
+    def _states(self, object_ids: List[ObjectID]) -> List[ObjectState]:
+        """Bulk _state(): one directory-lock round for the whole list."""
+        with self._dir_lock:
+            directory = self.directory
+            states = []
+            for o in object_ids:
+                st = directory.get(o)
+                if st is None:
+                    st = ObjectState()
+                    directory[o] = st
+                states.append(st)
+            return states
+
     def get(self, object_ids: List[ObjectID],
             timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
-        states = [self._state(o) for o in object_ids]
+        states = self._states(object_ids)
         for st in states:
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
@@ -441,7 +521,7 @@ class Runtime:
                 n_ready[0] += 1
                 cond.notify()
 
-        states = [self._state(o) for o in object_ids]
+        states = self._states(object_ids)
         for st in states:
             st.add_callback(on_ready)
         try:
@@ -575,18 +655,60 @@ class Runtime:
             self._ref_drop_q.put(("drop", oid))
 
     def _ref_drop_loop(self) -> None:
+        import queue as _q
         while True:
             item = self._ref_drop_q.get()
             if item is None or self._shutdown:
                 return
-            kind, oid = item
-            try:
-                if kind == "drop":
-                    self.remove_local_ref(oid)
+            # Batch everything already queued: one _ref_lock acquisition
+            # per batch instead of per dropped ref (a 1000-ref get()
+            # releases 1000 refs nearly at once).
+            batch = [item]
+            while len(batch) < 512:
+                try:
+                    batch.append(self._ref_drop_q.get_nowait())
+                except _q.Empty:
+                    break
+            done = False
+            drops: List[ObjectID] = []
+            for it in batch:
+                if it is None:
+                    done = True
+                elif it[0] == "drop":
+                    drops.append(it[1])
                 else:
-                    self._view_dead(oid)
-            except Exception:
-                pass
+                    try:
+                        self._view_dead(it[1])
+                    except Exception:
+                        pass
+            if drops:
+                try:
+                    self._apply_ref_drops(drops)
+                except Exception:
+                    pass
+            if done or self._shutdown:
+                return
+
+    def _apply_ref_drops(self, oids: List[ObjectID]) -> None:
+        """Batched remove_local_ref: same semantics, one lock round."""
+        to_free: List[ObjectID] = []
+        with self._ref_lock:
+            for oid in oids:
+                n = self._local_refs.get(oid, 0) - 1
+                if n > 0:
+                    self._local_refs[oid] = n
+                    continue
+                self._local_refs.pop(oid, None)
+                if not self._collectable_locked(oid):
+                    continue
+                with self._dir_lock:
+                    st = self.directory.get(oid)
+                if st is not None and not st.event.is_set():
+                    self._dropped.add(oid)
+                else:
+                    to_free.append(oid)
+        if to_free:
+            self.free(to_free)
 
     def remove_local_ref(self, oid: ObjectID) -> None:
         if not self._gc_enabled or self._shutdown:
@@ -891,6 +1013,42 @@ class Runtime:
         else:
             self._fail_task(spec, WorkerCrashedError(reason))
 
+    def _pipeline_topup(self, budget: int = 2) -> None:
+        """Move up to ``budget`` queued tasks into worker pipeline slots
+        (bounded so one TaskDone never monopolizes the poller thread)."""
+        for _ in range(budget):
+            nxt = self.scheduler.take_pipelineable()
+            if nxt is None:
+                return
+            if not self._try_pipeline(nxt.spec):
+                # No pipeline room: route back through normal (booked)
+                # submission.
+                self.scheduler.submit(nxt.spec, nxt.dispatch)
+                return
+
+    def _try_pipeline(self, spec: TaskSpec) -> bool:
+        """Scheduler callback when the cluster is full: queue the task
+        ahead on a busy local worker (no booking) to hide the
+        done->dispatch round trip.  Single-node only — remote pipelining
+        would need per-node credit accounting."""
+        if len(self.nodes) > 1 or self._puller is not None:
+            return False
+        try:
+            args, kwargs = self._resolve(spec)
+        except _DepsPending:
+            return False
+        with self._running_lock:
+            self._running[spec.task_id] = _RunningTask(spec, self.node_id)
+        self._pipelined.add(spec.task_id)
+        if self.node.dispatch_pipelined(spec, args, kwargs):
+            self.events.record(spec.task_id.hex(), SUBMITTED_TO_NODE,
+                               node_id=self.node_id.hex())
+            return True
+        self._pipelined.discard(spec.task_id)
+        with self._running_lock:
+            self._running.pop(spec.task_id, None)
+        return False
+
     def _dispatch_normal(self, spec: TaskSpec, node_id: NodeID) -> None:
         try:
             args, kwargs = self._resolve(spec)
@@ -953,6 +1111,15 @@ class Runtime:
             ast.next_seq += 1
         deps = [a[1] for a in spec.arg_descs if a[0] == "ref"]
         deps += [d[1] for d in spec.kwarg_descs.values() if d[0] == "ref"]
+        if not deps:
+            # Fast path: no ref args — resolution is a pure re-tag of the
+            # inline payloads, nothing can go back to pending.
+            self._enqueue_actor_dispatch(
+                ast, spec, seq,
+                [("inline", p) for _k, p in spec.arg_descs],
+                {k: ("inline", p) for k, (_kind, p)
+                 in spec.kwarg_descs.items()})
+            return
         unresolved = [d for d in deps if not self._object_ready(d)]
 
         def on_deps_ready():
@@ -1006,8 +1173,16 @@ class Runtime:
                 node.dispatch_task(spec, a, k, target_worker=worker_id)
             self._offload(run, ordered=True)
             return
-        self._track(spec, node_id)
-        node.dispatch_task(spec, args, kwargs, target_worker=worker_id)
+        if getattr(node, "is_remote", False):
+            self._track(spec, node_id)
+            node.dispatch_task(spec, args, kwargs, target_worker=worker_id)
+        else:
+            # Local fast path: insert into running without the
+            # SUBMITTED_TO_WORKER event — dispatch_actor_task records
+            # RUNNING immediately after anyway.
+            with self._running_lock:
+                self._running[spec.task_id] = _RunningTask(spec, node_id)
+            node.dispatch_actor_task(spec, args, kwargs, worker_id)
 
     def bind_actor_worker(self, actor_id: ActorID, node_id: NodeID,
                           worker_id: WorkerID) -> None:
@@ -1086,7 +1261,13 @@ class Runtime:
                 self.mark_ready(oid, desc)
             if self._recovering:
                 self._finish_recovery(msg.task_id)
-        if spec is not None and spec.create_actor_id is None:
+        if spec is not None and spec.task_id in self._pipelined:
+            # Pipelined task: never booked resources — nothing to release
+            # or exchange, but the freed worker-queue slot can take the
+            # next queued task.
+            self._pipelined.discard(spec.task_id)
+            self._pipeline_topup()
+        elif spec is not None and spec.create_actor_id is None:
             # Actor creation keeps its resources for the actor's lifetime.
             if not spec.resources.is_empty() or spec.placement_group is not None:
                 from .resources import TPU as _TPU
@@ -1103,6 +1284,10 @@ class Runtime:
                     if nxt is not None:
                         self.scheduler._dispatch_safely(
                             nxt.spec, nxt.dispatch, node_id)
+                        # Keep worker queues non-empty: a backlogged class
+                        # also tops up the pipeline window so workers never
+                        # idle through the done->dispatch round trip.
+                        self._pipeline_topup()
                 else:
                     self.scheduler.release(node_id, spec.resources,
                                            spec.placement_group,
@@ -1147,7 +1332,9 @@ class Runtime:
             running = self._running.pop(task_id, None)
         if running is not None:
             spec = running.spec
-            if spec.create_actor_id is None and (
+            if spec.task_id in self._pipelined:
+                self._pipelined.discard(spec.task_id)
+            elif spec.create_actor_id is None and (
                     not spec.resources.is_empty()
                     or spec.placement_group is not None):
                 self.scheduler.release(running.node_id, spec.resources,
@@ -1204,7 +1391,11 @@ class Runtime:
                     specs.append(rt.spec)
         oom = reason.startswith("OOM-killed")
         for spec in specs:
-            if spec.create_actor_id is None and (
+            if spec.task_id in self._pipelined:
+                # Pipelined task: no booking to release; the resubmit
+                # below goes through normal (booked) submission.
+                self._pipelined.discard(spec.task_id)
+            elif spec.create_actor_id is None and (
                     not spec.resources.is_empty()
                     or spec.placement_group is not None):
                 self.scheduler.release(node_id, spec.resources,
@@ -1243,19 +1434,8 @@ class Runtime:
         if info.num_restarts < info.max_restarts:
             info.num_restarts += 1
             self.controller.set_actor_state(actor_id, RESTARTING)
-            spec = info.creation_spec
-            new_spec = TaskSpec(
-                task_id=TaskID.of(actor_id), name=spec.name,
-                fn_blob=spec.fn_blob, method_name=None,
-                arg_descs=spec.arg_descs, kwarg_descs=spec.kwarg_descs,
-                return_ids=[], resources=spec.resources,
-                create_actor_id=actor_id, max_retries=0,
-                placement_group=spec.placement_group,
-                bundle_index=spec.bundle_index,
-                scheduling_strategy=spec.scheduling_strategy,
-                runtime_env=spec.runtime_env,
-                max_concurrency=spec.max_concurrency)
-            self._submit_actor_creation(new_spec)
+            self._submit_actor_creation(
+                self._restart_creation_spec(actor_id, info.creation_spec))
         else:
             self.controller.set_actor_state(actor_id, DEAD,
                                             death_cause="worker died")
@@ -1339,7 +1519,7 @@ class Runtime:
     # -- worker-initiated requests -------------------------------------- #
 
     def on_get_request(self, node, msg: GetRequest) -> None:
-        states = [self._state(o) for o in msg.object_ids]
+        states = self._states(msg.object_ids)
         remaining = {"n": len(states)}
         lock = threading.Lock()
         replied = {"done": False}
@@ -1504,6 +1684,9 @@ class Runtime:
         info = self.controller.get_actor(ActorID(actor_id_bytes))
         if info is not None:
             info.creation_spec = spec
+            # Re-persist: the creation spec is what a restarted head
+            # rebuilds the actor from.
+            self.controller._p(("actor", info))
         return True
 
     def ctl_kill_actor(self, actor_id_bytes, no_restart=True):
@@ -1677,6 +1860,23 @@ class Runtime:
         if self._data_client is not None:
             self._data_client.shutdown()
         self.node.shutdown()
+        if self.state_store is not None:
+            # Clean shutdown: actors die with the cluster — only a CRASHED
+            # head revives actors on restart.  Without this, a later
+            # unrelated `start --head` on the same state dir would re-run
+            # stale user actor code from the snapshot.
+            try:
+                for info in list(self.controller.actors.values()):
+                    if info.state != DEAD:
+                        self.controller.set_actor_state(
+                            info.actor_id, DEAD,
+                            death_cause="cluster shutdown")
+                # Compact so the next start replays a snapshot instead of
+                # the whole WAL.
+                self.state_store.compact(self.controller.snapshot_records())
+            except Exception:
+                pass
+            self.state_store.close()
         self.log_monitor.stop()
         self.log_monitor.poll_once()  # flush buffered worker output
         self.export_events.close()

@@ -92,6 +92,11 @@ class ClusterScheduler:
         self._pending_pgs: List[PlacementGroupInfo] = []
         # Set by the Runtime: called with (spec, exc) when dispatch blows up.
         self.on_dispatch_error: Optional[Callable] = None
+        # Set by the Runtime: called with (spec) when the cluster is full;
+        # returns True if the task was queued ahead on a busy worker
+        # (pipelined submission, reference: max_tasks_in_flight_per_worker
+        # in the C++ submitter) — such tasks hold NO resource booking.
+        self.try_pipeline: Optional[Callable] = None
         self._thread = threading.Thread(target=self._loop, name="scheduler",
                                         daemon=True)
         self._thread.start()
@@ -137,6 +142,7 @@ class ClusterScheduler:
         # notify_object_ready (which holds the same lock) would then have
         # already fired, stranding the task in _waiting forever.
         inline_node: Optional[NodeID] = None
+        pipeline_ok = False
         with self._wake:
             unresolved = {d for d in deps if not self._object_ready(d)}
             if not unresolved and not self._ready_count \
@@ -147,25 +153,63 @@ class ClusterScheduler:
                 # (reference: normal_task_submitter.cc:142 pipelines
                 # lease grants the same way).
                 inline_node = self._try_place(spec)
-            if inline_node is None:
-                task = _PendingTask(spec, unresolved, dispatch,
-                                    self._sched_key(spec))
-                if unresolved:
-                    for d in unresolved:
-                        self._waiting[d].append(task)
-                else:
-                    self._push_ready_locked(task)
-                    # Wake the loop only when the task has a chance of
-                    # placing right now: with every worker busy, the wakeup
-                    # is a pure GIL handoff per submit (measured ~100us
-                    # each at 2k submits/s) and release() will wake the
-                    # loop anyway when capacity frees.  Both paths hold
-                    # this lock, so the check-then-notify cannot miss a
-                    # concurrent release.
-                    if self._capacity_hint(spec):
-                        self._wake.notify_all()
+                if inline_node is None and self.try_pipeline is not None \
+                        and self._pipelineable(spec):
+                    pipeline_ok = True  # attempt outside the lock
+            if inline_node is None and not pipeline_ok:
+                self._queue_task_locked(spec, dispatch, unresolved)
         if inline_node is not None:
             self._dispatch_safely(spec, dispatch, inline_node)
+        elif pipeline_ok:
+            if not self.try_pipeline(spec):
+                with self._wake:
+                    self._queue_task_locked(spec, dispatch, set())
+
+    def take_pipelineable(self) -> Optional[_PendingTask]:
+        """Pop a queued task eligible for pipelined dispatch (a pipelined
+        completion freed a worker queue slot)."""
+        with self._wake:
+            if not self._running:
+                return None
+            for key in list(self._ready):
+                bucket = self._ready[key]
+                t = bucket[0]
+                if self._pipelineable(t.spec):
+                    bucket.popleft()
+                    self._ready_count -= 1
+                    if not bucket:
+                        self._ready.pop(key, None)
+                    return t
+            return None
+
+    @staticmethod
+    def _pipelineable(spec: TaskSpec) -> bool:
+        """Plain CPU-only tasks can queue ahead on a busy worker: execution
+        stays serial per worker, so actual parallelism remains bounded by
+        the booked capacity."""
+        return (spec.placement_group is None
+                and spec.scheduling_strategy is None
+                and spec.runtime_env is None
+                and spec.actor_id is None and spec.create_actor_id is None
+                and all(k == "CPU" for k in spec.resources.keys()))
+
+    def _queue_task_locked(self, spec: TaskSpec, dispatch,
+                           unresolved: Set[ObjectID]) -> None:
+        task = _PendingTask(spec, unresolved, dispatch,
+                            self._sched_key(spec))
+        if unresolved:
+            for d in unresolved:
+                self._waiting[d].append(task)
+        else:
+            self._push_ready_locked(task)
+            # Wake the loop only when the task has a chance of placing
+            # right now: with every worker busy, the wakeup is a pure GIL
+            # handoff per submit (measured ~100us each at 2k submits/s)
+            # and release() will wake the loop anyway when capacity frees.
+            # Both paths hold this lock, so the check-then-notify cannot
+            # miss a concurrent release.
+            if self._capacity_hint(spec):
+                self._wake.notify_all()
 
     def _dispatch_safely(self, spec: TaskSpec, dispatch, node_id: NodeID):
         try:

