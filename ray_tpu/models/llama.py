@@ -53,9 +53,10 @@ class LlamaConfig:
     # MoE: 0 experts = dense model.
     num_experts: int = 0
     moe_top_k: int = 2
-    # 0 = dense (masked) dispatch; > 0 = capacity-based sparse dispatch
-    # with this capacity factor (see ops/moe.py).
-    moe_capacity_factor: float = 0.0
+    # 0 = dense (masked) dispatch; > 0 = capacity-based sorted dispatch
+    # with this capacity factor (see ops/moe.py).  Sparse is the default:
+    # expert FLOPs scale as top_k*capacity_factor/num_experts of dense.
+    moe_capacity_factor: float = 1.25
     # "auto" (flash on TPU / reference on CPU), "reference", "flash",
     # "flash_interpret", "ring", "ulysses"
     attention_impl: str = "auto"

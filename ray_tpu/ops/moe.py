@@ -3,10 +3,11 @@
 Absent from the reference (SURVEY §2.4 EP row: delegated to vLLM) — built
 natively.  The expert dimension carries the ``expert`` logical axis, so
 under the ``ep`` mesh axis GSPMD partitions the expert einsums and inserts
-the token all-to-all implied by the dispatch.  Round-1 implementation uses
-dense dispatch (every expert sees every token, masked by routing weights):
-exactly correct, MXU-friendly, and the partitioning already exercises EP;
-a capacity-based sparse dispatch kernel is the planned optimization.
+the token exchange implied by the dispatch.  The default dispatch is
+capacity-based and SORTED (argsort assignments by expert + segment
+offsets -> O(T*k) index arrays) rather than the GShard one-hot
+``[T, X, C]`` tensor; dense (masked) dispatch remains available via
+``capacity_factor=0`` for exactness tests.
 """
 
 from __future__ import annotations
@@ -88,22 +89,63 @@ def capacity_dispatch(info: RoutingInfo, num_experts: int,
     return dispatch, combine
 
 
+def sorted_dispatch(info: RoutingInfo, num_experts: int, capacity: int):
+    """Sort-based token routing: assignments ordered by expert, with
+    per-expert segment offsets giving each token its slot.
+
+    Replaces the one-hot ``[T, X, C]`` dispatch tensor (O(T*X*C) memory
+    and FLOPs) with O(T*k) index arrays: argsort assignments by expert,
+    slot = position - expert segment start, drop slots >= capacity.
+
+    Returns (tok_s [N], e_s [N], slot_s [N], w_s [N], keep [N]) over
+    N = T*k assignments in expert-sorted order; ``slot_s`` equals
+    ``capacity`` (out of range -> scatter mode 'drop') for dropped
+    assignments.
+    """
+    B, S, X = info.combine_weights.shape
+    k = info.expert_index.shape[-1]
+    T = B * S
+    N = T * k
+    e_flat = info.expert_index.reshape(N)
+    tok_flat = jnp.arange(N, dtype=jnp.int32) // k
+    weights = info.combine_weights.reshape(T, X)
+    w_flat = jnp.take_along_axis(
+        weights, info.expert_index.reshape(T, k), axis=-1).reshape(N)
+    order = jnp.argsort(e_flat, stable=True)  # token order within expert
+    e_s = e_flat[order]
+    tok_s = tok_flat[order]
+    w_s = w_flat[order]
+    counts = jnp.bincount(e_flat, length=num_experts)
+    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                              jnp.cumsum(counts)[:-1]])
+    slot_s = jnp.arange(N, dtype=counts.dtype) - starts[e_s]
+    keep = slot_s < capacity
+    slot_s = jnp.where(keep, slot_s, capacity)  # OOB -> dropped by scatter
+    return tok_s, e_s, slot_s, w_s, keep
+
+
 def moe_layer(x, router_w, w_gate, w_up, w_down, k: int = 2,
               rng: Optional[jax.Array] = None,
               router_noise: float = 0.0,
-              capacity_factor: float = 0.0) -> Tuple[jax.Array, jax.Array]:
+              capacity_factor: float = 1.25) -> Tuple[jax.Array, jax.Array]:
     """SwiGLU expert MLPs with top-k routing.
 
     x: [B, S, E]; router_w: [E, X]; w_gate/w_up: [X, E, M]; w_down: [X, M, E].
     Returns (output [B, S, E], aux_loss scalar).
 
-    ``capacity_factor`` == 0 keeps the dense dispatch (every expert sees
-    every token, masked — exact, but O(num_experts) FLOPs); > 0 switches to
-    capacity-based sparse dispatch where each expert processes at most
+    The default is capacity-based sparse dispatch (sorted, see
+    ``sorted_dispatch``): each expert processes at most
     ``ceil(k * T * capacity_factor / X)`` token slots, so expert FLOPs
-    scale as top_k * capacity_factor / num_experts of dense.  Under the
-    ``ep`` mesh axis the dispatch/combine einsums lower to the token
-    all-to-all (GShard recipe).
+    scale as top_k * capacity_factor / num_experts of dense; overflowing
+    assignments are dropped (the residual stream carries them).  Under the
+    ``ep`` mesh axis the per-expert buffers carry the ``expert`` logical
+    axis, so GSPMD partitions the expert einsums and inserts the token
+    exchange implied by the scatter/gather (GShard recipe with sorted
+    instead of one-hot dispatch).
+
+    ``capacity_factor == 0`` selects dense (masked) dispatch: every expert
+    sees every token — exact, O(num_experts) FLOPs, useful for parity
+    tests and tiny models.
     """
     import math
 
@@ -114,17 +156,22 @@ def moe_layer(x, router_w, w_gate, w_up, w_down, k: int = 2,
         B, S, E = x.shape
         T = B * S
         capacity = max(int(math.ceil(k * T * capacity_factor / X)), 1)
-        dispatch, combine = capacity_dispatch(info, X, capacity)
+        tok_s, e_s, slot_s, w_s, keep = sorted_dispatch(info, X, capacity)
         xt = x.reshape(T, E)
-        # Token all-to-all: [T, E] x [T, X, C] -> per-expert slot inputs.
-        expert_in = jnp.einsum("te,txc->xce", xt,
-                               dispatch.astype(x.dtype))
+        # Dispatch: gather token embeddings into per-expert slot buffers
+        # (slot == capacity is out of bounds -> mode='drop').
+        expert_in = jnp.zeros((X, capacity, E), x.dtype).at[
+            e_s, slot_s].set(xt[tok_s], mode="drop")
         gate = jnp.einsum("xce,xem->xcm", expert_in, w_gate)
         up = jnp.einsum("xce,xem->xcm", expert_in, w_up)
         h = jax.nn.silu(gate) * up
         expert_out = jnp.einsum("xcm,xme->xce", h, w_down)
-        out = jnp.einsum("xce,txc->te", expert_out,
-                         combine.astype(expert_out.dtype))
+        # Combine: weighted gather back to tokens (dropped slots read the
+        # zero row via clamped slot? no — 'fill' gathers zeros for OOB).
+        per_asgn = expert_out.at[e_s, slot_s].get(
+            mode="fill", fill_value=0)                       # [N, E]
+        contrib = per_asgn * (w_s * keep)[:, None].astype(per_asgn.dtype)
+        out = jnp.zeros((T, E), contrib.dtype).at[tok_s].add(contrib)
         out = out.reshape(B, S, E)
     else:
         # Dense dispatch: compute all experts, weight by combine matrix.

@@ -30,21 +30,25 @@ from .impala import (APPO, APPOConfig, IMPALA, IMPALAConfig,
 from .learner import JaxLearner, LearnerGroup
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
                           MultiAgentPPOConfig, MultiGuess)
+from .iql import IQL, IQLConfig
 from .offline import (BC, BCConfig, CQL, CQLConfig, MARWIL, MARWILConfig,
-                      OfflineData, collect_from_env, save_shard)
+                      OfflineData, collect_from_env, save_parquet,
+                      save_shard)
 from .ppo import PPO, PPOConfig, compute_gae
 from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from .rl_module import (ContinuousModuleSpec, DiscretePolicyModule,
                         GaussianPolicyModule, QModule, RLModuleSpec,
                         TwinQModule)
 from .sac import SAC, SACConfig
+from .tqc import TQC, TQCConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "IMPALA", "IMPALAConfig", "vtrace",
     "APPO", "APPOConfig",
     "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
-    "OfflineData", "collect_from_env", "save_shard",
+    "IQL", "IQLConfig", "TQC", "TQCConfig",
+    "OfflineData", "collect_from_env", "save_shard", "save_parquet",
     "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
     "MultiAgentPPOConfig", "MultiGuess",
     "Connector", "ConnectorPipeline", "MeanStdFilter", "FrameStack",

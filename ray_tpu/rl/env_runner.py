@@ -85,6 +85,13 @@ class EnvRunner:
             self.env_to_module.set_state(state["connectors"])
         return True
 
+    def set_weights(self, params) -> bool:
+        """Weights-only update; called with an ObjectRef argument the
+        params materialize on this worker straight from the object store
+        (no driver copy)."""
+        self.params = params
+        return True
+
     # -- sampling -------------------------------------------------------- #
 
     def sample(self, num_steps: int = 256) -> Dict[str, np.ndarray]:
@@ -221,12 +228,31 @@ class EnvRunnerGroup:
         connectors, also merge per-runner connector stats into one
         canonical state and broadcast it back (reference:
         env_runner_group.py sync_weights + rllib's distributed
-        MeanStdFilter aggregation)."""
-        state = {"params": params}
-        if self.local is not None:
-            self.local.set_state(state)
-            return
+        MeanStdFilter aggregation).
+
+        ``params`` may be an ObjectRef (from
+        ``LearnerGroup.get_weights_ref``): runners then materialize the
+        pytree straight from the object store and the driver never holds
+        it."""
         import ray_tpu
+        if self.local is not None:
+            if isinstance(params, ray_tpu.ObjectRef):
+                params = ray_tpu.get(params)
+            self.local.set_state({"params": params})
+            return
+        if isinstance(params, ray_tpu.ObjectRef):
+            # Top-level ref arg: resolved on each runner's node from the
+            # store — no driver hop for the weights payload.
+            ray_tpu.get([r.set_weights.remote(params)
+                         for r in self.remotes])
+            if self._connector_proto is not None:
+                states = ray_tpu.get([r.get_connector_state.remote()
+                                      for r in self.remotes])
+                merged = self._connector_proto.merge_states(states)
+                ray_tpu.get([r.set_connector_state.remote(merged)
+                             for r in self.remotes])
+            return
+        state = {"params": params}
         if self._connector_proto is not None:
             states = ray_tpu.get([r.get_connector_state.remote()
                                   for r in self.remotes])

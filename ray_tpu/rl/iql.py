@@ -1,0 +1,172 @@
+"""IQL: implicit Q-learning over offline transitions (discrete actions).
+
+Reference: rllib/algorithms/iql/ (IQLConfig — expectile value learning +
+advantage-weighted policy extraction, continuous form); here the discrete
+form on the same offline scaffolding as BC/CQL:
+
+  * V(s) learns the tau-expectile of Q_target(s, a_data) — an upper
+    expectile approximates max_a Q over the DATA distribution without
+    ever querying out-of-distribution actions.
+  * Q(s, a) regresses on r + gamma * (1 - d) * V(s') (SARSA-style; no
+    argmax over OOD actions).
+  * pi extracts by advantage-weighted regression:
+    max E[exp(beta * (Q_target - V)) * log pi(a_data | s)].
+
+The three heads update in ONE jitted step (a single fused loss with
+stop-gradients where IQL decouples them) — the XLA-friendly shape, no
+Python between the optimizer steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .learner import JaxLearner
+from .offline import BCConfig, OfflineData
+from .algorithm import Algorithm
+from .env import make_env
+from .rl_module import _init_mlp, _mlp
+
+
+class IQLModule:
+    """Composite module: q / v / pi MLP heads over the observation."""
+
+    def __init__(self, spec):
+        self.spec = spec
+
+    def init(self, key):
+        import jax
+        kq, kv, kp = jax.random.split(key, 3)
+        obs, act, hidden = (self.spec.observation_dim,
+                            self.spec.num_actions,
+                            tuple(self.spec.hidden))
+        return {
+            "q": _init_mlp(kq, (obs, *hidden, act)),
+            "v": _init_mlp(kv, (obs, *hidden, 1)),
+            "pi": _init_mlp(kp, (obs, *hidden, act)),
+        }
+
+    def q_values(self, params, obs):
+        return _mlp(params["q"], obs)
+
+    def value(self, params, obs):
+        return _mlp(params["v"], obs)[..., 0]
+
+    def logits(self, params, obs):
+        return _mlp(params["pi"], obs)
+
+    def forward_inference(self, params, obs):
+        import jax.numpy as jnp
+        return jnp.argmax(self.logits(params, obs), axis=-1)
+
+
+def iql_loss(module: IQLModule, params, batch):
+    import jax
+    import jax.numpy as jnp
+
+    obs, actions = batch["obs"], batch["actions"][:, None].astype(jnp.int32)
+    tau = batch["expectile"][0]
+    beta = batch["awr_beta"][0]
+    target_q_params = batch["target_q"]
+
+    # Expectile regression: V toward Q_target(s, a_data).
+    tq = jnp.take_along_axis(
+        _mlp(target_q_params, obs), actions, axis=-1)[:, 0]
+    tq = jax.lax.stop_gradient(tq)
+    v = module.value(params, obs)
+    diff = tq - v
+    weight = jnp.where(diff > 0, tau, 1.0 - tau)
+    v_loss = jnp.mean(weight * diff ** 2)
+
+    # Q TD toward r + gamma (1-d) V(s') (value net gradient-stopped).
+    v_next = jax.lax.stop_gradient(module.value(params, batch["next_obs"]))
+    targets = batch["rewards"] + batch["gamma"][0] * \
+        (1.0 - batch["terminateds"]) * v_next
+    q_taken = jnp.take_along_axis(
+        module.q_values(params, obs), actions, axis=-1)[:, 0]
+    q_loss = jnp.mean((q_taken - targets) ** 2)
+
+    # Advantage-weighted policy extraction.
+    adv = jax.lax.stop_gradient(tq - v)
+    w = jnp.minimum(jnp.exp(beta * adv), 100.0)
+    logp = jnp.take_along_axis(
+        jax.nn.log_softmax(module.logits(params, obs)), actions, axis=-1)[:, 0]
+    pi_loss = -jnp.mean(w * logp)
+
+    total = q_loss + v_loss + pi_loss
+    return total, {"q_loss": q_loss, "v_loss": v_loss, "pi_loss": pi_loss,
+                   "adv_mean": jnp.mean(adv), "w_mean": jnp.mean(w)}
+
+
+class IQLConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = IQL
+        self.expectile = 0.8
+        self.awr_beta = 3.0
+        self.target_update_freq = 10  # in updates
+
+    def training(self, *, expectile=None, awr_beta=None,
+                 target_update_freq=None, **kw) -> "IQLConfig":
+        super().training(**kw)
+        if expectile is not None:
+            self.expectile = expectile
+        if awr_beta is not None:
+            self.awr_beta = awr_beta
+        if target_update_freq is not None:
+            self.target_update_freq = target_update_freq
+        return self
+
+
+class IQL(Algorithm):
+    """Discrete implicit Q-learning (reference: rllib/algorithms/iql)."""
+
+    _use_env_runner_group = False
+
+    def setup(self, config: IQLConfig) -> None:
+        import jax
+        if config.input_path is None:
+            raise ValueError("IQLConfig.offline_data(input_path=...) "
+                             "required")
+        self.data = OfflineData(config.input_path, seed=config.seed)
+        for c in ("rewards", "next_obs", "terminateds"):
+            if c not in self.data.columns:
+                raise ValueError(f"IQL needs transition column {c!r}")
+        self.env = make_env(config.env_spec)
+        self.module = IQLModule(config.module_spec())
+        self.learner = JaxLearner(self.module, iql_loss,
+                                  learning_rate=config.lr, seed=config.seed)
+        self.target_q = self.learner.params["q"]
+        self._infer = jax.jit(self.module.forward_inference)
+        self._n_updates = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: IQLConfig = self.config
+        metrics: Dict[str, float] = {}
+        for _ in range(cfg.updates_per_iteration):
+            batch = self.data.sample(cfg.train_batch_size)
+            metrics = self.learner.update({
+                "obs": batch["obs"], "actions": batch["actions"],
+                "rewards": batch["rewards"], "next_obs": batch["next_obs"],
+                "terminateds": batch["terminateds"],
+                "target_q": self.target_q,
+                "gamma": np.array([cfg.gamma], np.float32),
+                "expectile": np.array([cfg.expectile], np.float32),
+                "awr_beta": np.array([cfg.awr_beta], np.float32)})
+            self._n_updates += 1
+            if self._n_updates % cfg.target_update_freq == 0:
+                self.target_q = self.learner.params["q"]
+        return {"learner": metrics, "dataset_size": self.data.size}
+
+    def compute_single_action(self, obs: np.ndarray) -> int:
+        return int(np.asarray(
+            self._infer(self.learner.params, obs[None]))[0])
+
+    def get_weights(self):
+        return {"params": self.learner.params, "target_q": self.target_q}
+
+    def set_weights(self, params) -> None:
+        self.learner.set_weights(params["params"])
+        self.target_q = params["target_q"]

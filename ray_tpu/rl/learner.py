@@ -2,12 +2,15 @@
 
 Reference: rllib/core/learner/learner.py:112 (Learner — update:1028,
 compute_gradients:511, apply_gradients:657) and learner_group.py:100
-(LearnerGroup of remote learners with DDP gradient sync).  The torch/DDP
-pattern becomes JAX: one jit'd ``(params, opt_state, batch) -> (params,
-opt_state, metrics)`` step per learner; multi-learner data parallelism
-averages gradients — on TPU slices that average is a psum over the mesh
-inside the jit; across learner actors here it is a driver-side tree-mean,
-the CPU-testable equivalent of the reference's NCCL allreduce.
+(LearnerGroup of remote learners with DDP gradient sync,
+torch_learner.py:67 DDP wrapping).  The torch/DDP pattern becomes JAX:
+one jit'd ``(params, opt_state, batch) -> (params, opt_state, metrics)``
+step per learner; multi-learner data parallelism averages gradients by an
+ALLREDUCE among the learner actors over ray_tpu.collective (gloo on CPU
+hosts, XLA collectives over ICI on TPU slices) — the driver dispatches
+batch shards and reads metrics, it never touches a gradient.  If the
+collective group cannot form, the group falls back to a driver-side
+tree-mean (same numerics, driver-bandwidth-bound).
 """
 
 from __future__ import annotations
@@ -116,8 +119,45 @@ class LearnerGroup:
             class LearnerActor:
                 def __init__(self, factory_blob):
                     from ray_tpu._private import serialization
-                    factory = serialization.loads_control(factory_blob)
-                    self.learner = factory()
+                    self._factory = serialization.loads_control(factory_blob)
+                    self.learner = None
+                    self._ddp_group = None
+
+                def setup_ddp(self, world_size, rank, group_name,
+                              backend="xla"):
+                    """Join the learner allreduce group (reference:
+                    learner_group.py:187 DDP setup).  Must run BEFORE the
+                    learner builds: the XLA backend's jax.distributed
+                    world has to initialize before this process's first
+                    jax computation."""
+                    from ray_tpu import collective
+                    collective.init_collective_group(
+                        world_size, rank, backend=backend,
+                        group_name=group_name)
+                    self._ddp_group = group_name
+                    return True
+
+                def build(self):
+                    if self.learner is None:
+                        self.learner = self._factory()
+                    return True
+
+                def update_ddp(self, batch):
+                    """Grad step with gradients averaged across the learner
+                    group by allreduce — gradients never leave the actors."""
+                    import jax.numpy as jnp
+                    import numpy as _np
+                    from jax.flatten_util import ravel_pytree
+                    from ray_tpu import collective
+                    grads, metrics = self.learner.compute_gradients(batch)
+                    flat, unravel = ravel_pytree(grads)
+                    summed = collective.allreduce(
+                        _np.asarray(flat), group_name=self._ddp_group)
+                    world = collective.get_collective_group_size(
+                        self._ddp_group)
+                    self.learner.apply_gradients(
+                        unravel(jnp.asarray(summed) / world))
+                    return metrics
 
                 def compute_gradients(self, batch):
                     return self.learner.compute_gradients(batch)
@@ -142,27 +182,51 @@ class LearnerGroup:
             self.local = None
             self.remotes = [LearnerActor.options(**opts).remote(blob)
                             for _ in range(num_learners)]
+            import ray_tpu as _rt
+            self._ddp = False
+            if num_learners >= 2:
+                import os
+                group = f"learner_ddp_{os.getpid()}_{id(self):x}"
+                try:
+                    # Group setup BEFORE building the learners: the XLA
+                    # collective world must initialize before each actor's
+                    # first jax computation.
+                    _rt.get([r.setup_ddp.remote(num_learners, i, group)
+                             for i, r in enumerate(self.remotes)],
+                            timeout=120)
+                    self._ddp = True
+                except Exception:
+                    # Collective group could not form (e.g. no loopback
+                    # rendezvous): keep the driver tree-mean fallback.
+                    pass
+            _rt.get([r.build.remote() for r in self.remotes])
             # Align initial weights to replica 0 so gradient averaging keeps
             # them identical forever after.
-            import ray_tpu as _rt
             w0 = _rt.get(self.remotes[0].get_weights.remote())
             _rt.get([r.set_weights.remote(w0) for r in self.remotes[1:]])
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         if self.local is not None:
             return self.local.update(batch)
-        import jax
         import ray_tpu
         shards = _split_batch(batch, len(self.remotes))
-        outs = ray_tpu.get([
-            r.compute_gradients.remote(s)
-            for r, s in zip(self.remotes, shards)])
-        grads = [g for g, _ in outs]
-        mean_grads = jax.tree.map(
-            lambda *gs: sum(np.asarray(g) for g in gs) / len(gs), *grads)
-        ray_tpu.get([r.apply_gradients.remote(mean_grads)
-                     for r in self.remotes])
-        metrics_list = [m for _, m in outs]
+        if self._ddp:
+            # Gradients allreduce among the learner actors; the driver
+            # only sees metrics (reference: DDP across learner workers).
+            metrics_list = ray_tpu.get([
+                r.update_ddp.remote(s)
+                for r, s in zip(self.remotes, shards)])
+        else:
+            import jax
+            outs = ray_tpu.get([
+                r.compute_gradients.remote(s)
+                for r, s in zip(self.remotes, shards)])
+            grads = [g for g, _ in outs]
+            mean_grads = jax.tree.map(
+                lambda *gs: sum(np.asarray(g) for g in gs) / len(gs), *grads)
+            ray_tpu.get([r.apply_gradients.remote(mean_grads)
+                         for r in self.remotes])
+            metrics_list = [m for _, m in outs]
         return {k: float(np.mean([m[k] for m in metrics_list]))
                 for k in metrics_list[0]}
 
@@ -171,6 +235,15 @@ class LearnerGroup:
             return self.local.get_weights()
         import ray_tpu
         return ray_tpu.get(self.remotes[0].get_weights.remote())
+
+    def get_weights_ref(self):
+        """Weights as an ObjectRef (remote mode): consumers materialize
+        straight from the object store — the driver never holds the
+        pytree (reference: learner->env-runner weight broadcast without a
+        driver hop)."""
+        if self.local is not None:
+            return self.local.get_weights()
+        return self.remotes[0].get_weights.remote()
 
     def set_weights(self, params) -> None:
         if self.local is not None:

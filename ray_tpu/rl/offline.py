@@ -1,10 +1,12 @@
 """Offline RL: dataset IO + BC / MARWIL / discrete CQL.
 
-Reference: rllib/offline/ (dataset readers/writers feeding offline
-algorithms) and rllib/algorithms/{bc,marwil,cql}/.  Data is stored as
-columnar .npz shards — the layout that feeds jit'd update steps with a
-single fancy-index, and maps directly onto ray_tpu.data datasets for
-large-scale preprocessing.
+Reference: rllib/offline/ (offline_data.py — datasets read through Ray
+Data and streamed to learners) and rllib/algorithms/{bc,marwil,cql}/.
+Episode data is stored as columnar parquet shards written and read
+through ``ray_tpu.data`` (distributed read tasks, streaming executor),
+so offline preprocessing composes with the Data pipeline ops
+(map_batches, shuffle, repartition); .npz shards remain supported as the
+zero-dependency local format.
 
 Algorithms:
   * BC      — behavior cloning: max log pi(a|s) (discrete cross-entropy /
@@ -35,13 +37,60 @@ REQUIRED_COLUMNS = ("obs", "actions")
 
 
 def save_shard(path: str, columns: Dict[str, np.ndarray]) -> str:
-    """Write one columnar shard (creates parent dirs)."""
+    """Write one columnar shard: a ``.npz`` file, or (any other path) a
+    directory of parquet shards written through ray_tpu.data."""
     for c in REQUIRED_COLUMNS:
         if c not in columns:
             raise ValueError(f"offline shard missing column {c!r}")
+    if not path.endswith(".npz"):
+        return save_parquet(path, columns)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     np.savez_compressed(path, **columns)
     return path
+
+
+def save_parquet(path: str, columns: Dict[str, np.ndarray],
+                 shards: int = 4) -> str:
+    """Write episode columns as parquet shards via the Data pipeline
+    (reference: rllib offline writers emitting parquet through Ray Data).
+    Vector columns (obs) become per-dimension scalar columns
+    ``name/<i>``; readers stack them back."""
+    from ray_tpu import data as rdata
+    out: Dict[str, np.ndarray] = {}
+    n = len(next(iter(columns.values())))
+    for k, v in columns.items():
+        v = np.asarray(v)
+        if v.ndim == 1:
+            out[k] = v
+        elif v.ndim == 2:
+            for i in range(v.shape[1]):
+                out[f"{k}/{i}"] = v[:, i]
+        else:
+            raise ValueError(
+                f"parquet episode column {k!r} has ndim={v.ndim}; flatten "
+                "to <= 2 dims first")
+        assert len(v) == n, f"column {k!r} length mismatch"
+    ds = rdata.Dataset.from_numpy(out, parallelism=shards)
+    ds.write_parquet(path)
+    return path
+
+
+def _unflatten_columns(cols: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Inverse of save_parquet's vector flattening: stack name/<i>."""
+    out: Dict[str, np.ndarray] = {}
+    grouped: Dict[str, Dict[int, np.ndarray]] = {}
+    for k, v in cols.items():
+        if "/" in k:
+            base, _, idx = k.rpartition("/")
+            try:
+                grouped.setdefault(base, {})[int(idx)] = v
+                continue
+            except ValueError:
+                pass
+        out[k] = v
+    for base, parts in grouped.items():
+        out[base] = np.stack([parts[i] for i in range(len(parts))], axis=1)
+    return out
 
 
 def collect_from_env(env_spec: Any, policy_fn, num_steps: int,
@@ -97,24 +146,62 @@ def collect_from_env(env_spec: Any, policy_fn, num_steps: int,
 
 
 class OfflineData:
-    """Columnar in-memory dataset over one or more .npz shards
-    (reference: rllib/offline/offline_data.py)."""
+    """Columnar dataset over .npz shards or parquet directories
+    (reference: rllib/offline/offline_data.py — parquet episode data read
+    through the Data library).
+
+    Parquet paths (a directory from ``save_parquet`` / ``write_parquet``,
+    a ``*.parquet`` glob, or a ``ray_tpu.data.Dataset``) stream through
+    the Data executor: shard reads run as tasks and batches flow back
+    through ``iter_batches`` — the npz path stays a zero-runtime local
+    loader."""
 
     def __init__(self, paths, seed: int = 0):
-        if isinstance(paths, str):
-            paths = sorted(glob.glob(paths)) if any(
-                ch in paths for ch in "*?[") else [paths]
-        if not paths:
-            raise ValueError("no offline data shards found")
-        parts: Dict[str, List[np.ndarray]] = {}
-        for p in paths:
-            with np.load(p) as z:
-                for k in z.files:
-                    parts.setdefault(k, []).append(z[k])
-        self.columns: Dict[str, np.ndarray] = {
-            k: np.concatenate(v) for k, v in parts.items()}
+        from ray_tpu.data import Dataset as _DataDataset
+        if isinstance(paths, _DataDataset):
+            self.columns = self._from_dataset(paths)
+        else:
+            if isinstance(paths, str):
+                expanded = sorted(glob.glob(paths)) if any(
+                    ch in paths for ch in "*?[") else [paths]
+            else:
+                expanded = list(paths)
+            if not expanded:
+                raise ValueError("no offline data shards found")
+            if all(p.endswith(".npz") for p in expanded):
+                parts: Dict[str, List[np.ndarray]] = {}
+                for p in expanded:
+                    with np.load(p) as z:
+                        for k in z.files:
+                            parts.setdefault(k, []).append(z[k])
+                self.columns = {k: np.concatenate(v)
+                                for k, v in parts.items()}
+            else:
+                from ray_tpu import data as rdata
+                files: List[str] = []
+                for p in expanded:
+                    files.extend(sorted(
+                        glob.glob(os.path.join(p, "*.parquet")))
+                        if os.path.isdir(p) else [p])
+                if not files:
+                    raise ValueError(f"no parquet shards under {paths!r}")
+                self.columns = self._from_dataset(
+                    rdata.read_parquet(files))
         self.size = len(self.columns["obs"])
         self._rng = np.random.default_rng(seed)
+
+    @staticmethod
+    def _from_dataset(ds) -> Dict[str, np.ndarray]:
+        parts: Dict[str, List[np.ndarray]] = {}
+        # Streaming consumption: shard reads execute as Data tasks while
+        # earlier batches are already being accumulated here.
+        for batch in ds.iter_batches(batch_size=4096):
+            for k, v in batch.items():
+                parts.setdefault(k, []).append(np.asarray(v))
+        if not parts:
+            raise ValueError("offline dataset is empty")
+        return _unflatten_columns(
+            {k: np.concatenate(v) for k, v in parts.items()})
 
     def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
         idx = self._rng.integers(0, self.size, batch_size)

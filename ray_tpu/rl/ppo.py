@@ -116,7 +116,8 @@ class PPO(Algorithm):
         self.learner_group = LearnerGroup(
             factory, num_learners=config.num_learners)
         self._rng = np.random.default_rng(config.seed)
-        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights_ref())
 
     def training_step(self) -> Dict[str, Any]:
         cfg: PPOConfig = self.config
@@ -156,8 +157,10 @@ class PPO(Algorithm):
                 minibatch = {k: v[idx] for k, v in batch.items()}
                 minibatch.update(consts)
                 metrics = self.learner_group.update(minibatch)
-        weights = self.learner_group.get_weights()
-        self.env_runner_group.sync_weights(weights)
+        # Ref-based broadcast: runners pull the new weights from the object
+        # store; the driver never materializes the pytree.
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights_ref())
         return {"learner": metrics,
                 "num_env_steps_sampled": n}
 

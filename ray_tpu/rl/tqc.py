@@ -1,0 +1,234 @@
+"""TQC: truncated quantile critics for continuous control.
+
+Reference: rllib/algorithms/tqc/ (SAC with an ensemble of distributional
+critics; overestimation is controlled by dropping the top quantiles of
+the pooled target distribution instead of clipped double-Q).  Built on
+the SAC scaffolding: the whole update — quantile critics, actor,
+temperature, polyak — is one jitted function of (state, batch, key).
+
+The critic ensemble is a single vmapped MLP (leading axis = critic):
+one XLA program evaluates all N critics as a batched matmul stack —
+the TPU-friendly layout (no Python loop over ensemble members).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import numpy as np
+
+from .algorithm import Algorithm
+from .env import make_env
+from .replay_buffer import ReplayBuffer
+from .rl_module import (ContinuousModuleSpec, GaussianPolicyModule,
+                        _init_mlp, _mlp)
+from .sac import SAC, SACConfig
+
+
+class TQCState(NamedTuple):
+    pi_params: Any
+    z_params: Any     # quantile critic ensemble
+    z_target: Any
+    log_alpha: Any
+    pi_opt: Any
+    z_opt: Any
+    alpha_opt: Any
+
+
+class QuantileCriticEnsemble:
+    """N critics x M quantiles of Z(s, a), vmapped over the ensemble."""
+
+    def __init__(self, spec: ContinuousModuleSpec, num_critics: int,
+                 num_quantiles: int):
+        self.spec = spec
+        self.n = num_critics
+        self.m = num_quantiles
+
+    def init(self, key):
+        import jax
+        dims = (self.spec.observation_dim + self.spec.action_dim,
+                *self.spec.hidden, self.m)
+        keys = jax.random.split(key, self.n)
+        per = [_init_mlp(k, dims) for k in keys]
+        return jax.tree.map(lambda *xs: jax.numpy.stack(xs), *per)
+
+    def quantiles(self, params, obs, actions):
+        """-> [N, B, M]."""
+        import jax
+        import jax.numpy as jnp
+        x = jnp.concatenate([obs, actions], axis=-1)
+        return jax.vmap(_mlp, in_axes=(0, None))(params, x)
+
+
+def _quantile_huber(pred, target, taus, kappa: float = 1.0):
+    """pred [B, M]; target [B, K] (stop-gradded); taus [M] -> scalar."""
+    import jax.numpy as jnp
+    delta = target[:, None, :] - pred[:, :, None]          # [B, M, K]
+    abs_d = jnp.abs(delta)
+    huber = jnp.where(abs_d <= kappa, 0.5 * delta ** 2,
+                      kappa * (abs_d - 0.5 * kappa))
+    weight = jnp.abs(taus[None, :, None]
+                     - (delta < 0).astype(jnp.float32))
+    return jnp.mean(jnp.sum(weight * huber, axis=1) / kappa)
+
+
+class TQCConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = TQC
+        self.num_critics = 3
+        self.num_quantiles = 13
+        self.top_quantiles_to_drop = 2  # per critic
+
+    def training(self, *, num_critics=None, num_quantiles=None,
+                 top_quantiles_to_drop=None, **kw) -> "TQCConfig":
+        super().training(**kw)
+        if num_critics is not None:
+            self.num_critics = num_critics
+        if num_quantiles is not None:
+            self.num_quantiles = num_quantiles
+        if top_quantiles_to_drop is not None:
+            self.top_quantiles_to_drop = top_quantiles_to_drop
+        return self
+
+
+class TQC(Algorithm):
+    """Off-policy, drives its own env loop (SAC scaffolding)."""
+
+    _use_env_runner_group = False
+
+    def setup(self, config: TQCConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        env = make_env(config.env_spec)
+        if not env.is_continuous:
+            raise ValueError("TQC requires a continuous-action env")
+        self.env = env
+        spec = ContinuousModuleSpec(env.observation_dim, env.action_dim,
+                                    env.action_low, env.action_high,
+                                    tuple(config.module_hidden))
+        self.pi = GaussianPolicyModule(spec)
+        self.z = QuantileCriticEnsemble(spec, config.num_critics,
+                                        config.num_quantiles)
+        n, m = config.num_critics, config.num_quantiles
+        kept = n * (m - config.top_quantiles_to_drop)
+        if kept <= 0:
+            raise ValueError("top_quantiles_to_drop leaves no target atoms")
+        taus = (2 * jnp.arange(m, dtype=jnp.float32) + 1) / (2 * m)
+        target_entropy = (config.target_entropy
+                          if config.target_entropy is not None
+                          else -float(env.action_dim))
+        pi_optim = optax.adam(config.actor_lr or config.lr)
+        z_optim = optax.adam(config.critic_lr or config.lr)
+        alpha_optim = optax.adam(config.alpha_lr)
+        gamma, tau_polyak = config.gamma, config.tau
+
+        key = jax.random.key(config.seed)
+        kp, kz = jax.random.split(key)
+        pi_params = self.pi.init(kp)
+        z_params = self.z.init(kz)
+        log_alpha = jnp.log(jnp.asarray(config.initial_alpha, jnp.float32))
+        self.state = TQCState(
+            pi_params, z_params, z_params, log_alpha,
+            pi_optim.init(pi_params), z_optim.init(z_params),
+            alpha_optim.init(log_alpha))
+
+        pi, z = self.pi, self.z
+
+        def update(state: TQCState, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(state.log_alpha)
+
+            # -- critics: truncated pooled target distribution ------------
+            next_a, next_logp = pi.sample(state.pi_params,
+                                          batch["next_obs"], k1)
+            tz = z.quantiles(state.z_target, batch["next_obs"], next_a)
+            B = tz.shape[1]
+            pooled = jnp.sort(jnp.transpose(tz, (1, 0, 2)).reshape(B, -1),
+                              axis=-1)[:, :kept]          # drop top atoms
+            target = batch["rewards"][:, None] + gamma * \
+                (1.0 - batch["terminateds"])[:, None] * \
+                (pooled - alpha * next_logp[:, None])
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(zp):
+                qs = z.quantiles(zp, batch["obs"], batch["actions"])
+                loss = sum(_quantile_huber(qs[i], target, taus)
+                           for i in range(n)) / n
+                return loss, jnp.mean(qs)
+
+            (closs, z_mean), z_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(state.z_params)
+            z_updates, z_opt = z_optim.update(z_grads, state.z_opt,
+                                              state.z_params)
+            z_params = optax.apply_updates(state.z_params, z_updates)
+
+            # -- actor: maximize mean of ALL quantiles - alpha log pi -----
+            def actor_loss(pp):
+                a, logp = pi.sample(pp, batch["obs"], k2)
+                qs = z.quantiles(z_params, batch["obs"], a)
+                return jnp.mean(alpha * logp - jnp.mean(qs, axis=(0, 2))), \
+                    jnp.mean(logp)
+
+            (aloss, logp_mean), pi_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(state.pi_params)
+            pi_updates, pi_opt = pi_optim.update(pi_grads, state.pi_opt,
+                                                 state.pi_params)
+            pi_params = optax.apply_updates(state.pi_params, pi_updates)
+
+            # -- temperature ----------------------------------------------
+            def alpha_loss(la):
+                return -jnp.exp(la) * jax.lax.stop_gradient(
+                    logp_mean + target_entropy)
+
+            _, a_grads = jax.value_and_grad(alpha_loss)(state.log_alpha)
+            a_updates, alpha_opt = alpha_optim.update(a_grads,
+                                                      state.alpha_opt)
+            log_alpha = optax.apply_updates(state.log_alpha, a_updates)
+
+            z_target = jax.tree.map(
+                lambda t, o: (1 - tau_polyak) * t + tau_polyak * o,
+                state.z_target, z_params)
+            metrics = {"critic_loss": closs, "actor_loss": aloss,
+                       "alpha": alpha, "z_mean": z_mean,
+                       "logp_mean": logp_mean}
+            return TQCState(pi_params, z_params, z_target, log_alpha,
+                            pi_opt, z_opt, alpha_opt), metrics
+
+        self._update = jax.jit(update)
+        self._sample_act = jax.jit(pi.sample)
+        self._infer_act = jax.jit(pi.forward_inference)
+
+        self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self._key = jax.random.key(config.seed + 1)
+        self._obs, _ = self.env.reset(seed=config.seed)
+        self._steps = 0
+        self._rng = np.random.default_rng(config.seed)
+        self._ep_return = 0.0
+        self._returns: list = []
+
+    # Env loop identical to SAC's (same state/act/update contract).
+    _act = SAC._act
+    training_step = SAC.training_step
+
+    def get_weights(self):
+        return {"pi": self.state.pi_params, "z": self.state.z_params,
+                "z_target": self.state.z_target,
+                "log_alpha": self.state.log_alpha}
+
+    def set_weights(self, params) -> None:
+        self.state = self.state._replace(
+            pi_params=params["pi"], z_params=params["z"],
+            z_target=params["z_target"], log_alpha=params["log_alpha"])
+
+    def compute_single_action(self, obs: np.ndarray,
+                              explore: bool = False) -> np.ndarray:
+        import jax
+        if explore:
+            self._key, sub = jax.random.split(self._key)
+            a, _ = self._sample_act(self.state.pi_params, obs[None], sub)
+            return np.asarray(a)[0]
+        return np.asarray(self._infer_act(self.state.pi_params,
+                                          obs[None]))[0]

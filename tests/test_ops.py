@@ -200,7 +200,7 @@ class TestMoE:
         wg = jax.random.normal(ks[2], (X, E, M)) * 0.1
         wu = jax.random.normal(ks[3], (X, E, M)) * 0.1
         wd = jax.random.normal(ks[4], (X, M, E)) * 0.1
-        dense, _ = moe_layer(x, rw, wg, wu, wd, k=2)
+        dense, _ = moe_layer(x, rw, wg, wu, wd, k=2, capacity_factor=0.0)
         # capacity_factor X/k -> capacity == T: no token can overflow.
         sparse, _ = moe_layer(x, rw, wg, wu, wd, k=2,
                               capacity_factor=X / 2)
@@ -231,6 +231,33 @@ class TestMoE:
             return (o ** 2).mean() + 0.01 * a
         g = jax.grad(loss)(rw)
         assert np.isfinite(np.asarray(g)).all()
+
+    def test_sorted_dispatch_invariants(self):
+        from ray_tpu.ops.moe import sorted_dispatch
+        B, S, E, X, k = 2, 16, 8, 4, 2
+        ks = jax.random.split(jax.random.key(3), 2)
+        x = jax.random.normal(ks[0], (B, S, E))
+        rw = jax.random.normal(ks[1], (E, X)) * 0.1
+        info = top_k_routing(x, rw, k=k)
+        capacity = 4  # below T*k/X = 16: forces drops
+        tok_s, e_s, slot_s, w_s, keep = sorted_dispatch(info, X, capacity)
+        tok_s, e_s, slot_s, keep = (np.asarray(tok_s), np.asarray(e_s),
+                                    np.asarray(slot_s), np.asarray(keep))
+        # Kept (expert, slot) pairs are unique and within capacity.
+        kept = [(int(e), int(s)) for e, s, f in zip(e_s, slot_s, keep) if f]
+        assert len(kept) == len(set(kept))
+        assert all(0 <= s < capacity for _e, s in kept)
+        # Per-expert kept load <= capacity; dropped slots read as OOB.
+        for e in range(X):
+            assert sum(1 for ee, _s in kept if ee == e) <= capacity
+        assert (slot_s[~keep] == capacity).all()
+        # Every (token, expert) assignment appears exactly once.
+        pairs = sorted(zip(tok_s.tolist(), e_s.tolist()))
+        want = sorted((t, int(e))
+                      for t in range(B * S)
+                      for e in np.asarray(info.expert_index).reshape(
+                          B * S, k)[t])
+        assert pairs == want
 
 
 class TestMeshSharding:

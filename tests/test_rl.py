@@ -175,8 +175,19 @@ class TestPPO:
                 .training(minibatch_size=64)
                 .build_algo())
         try:
+            lg = algo.learner_group
+            # Gradient sync is an allreduce among the learner actors, not a
+            # driver tree-mean (reference: DDP across learner workers).
+            assert lg._ddp, "learner collective group failed to form"
             res = algo.train()
             assert np.isfinite(res["learner"]["loss"])
+            # DDP contract: replicas stay bit-identical after updates even
+            # though the driver never touched a gradient.
+            import jax
+            import ray_tpu
+            w = [ray_tpu.get(r.get_weights.remote()) for r in lg.remotes]
+            for a, b in zip(jax.tree.leaves(w[0]), jax.tree.leaves(w[1])):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         finally:
             algo.stop()
 
