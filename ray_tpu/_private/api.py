@@ -23,6 +23,7 @@ from .protocol import TaskSpec
 from .resources import ResourceSet, task_resources
 from . import runtime as _rtmod
 from .runtime import current_runtime, driver_runtime
+from ..util import tracing as _tracing
 from .scheduler import (NodeAffinitySchedulingStrategy,
                         PlacementGroupSchedulingStrategy)
 
@@ -296,7 +297,11 @@ class RemoteFunction:
             placement_group=pg, bundle_index=bundle,
             scheduling_strategy=strategy,
             runtime_env=_prepare_env(opts.get("runtime_env")),
-            streaming=streaming, fn_id=self._fn_id)
+            streaming=streaming, fn_id=self._fn_id,
+            trace_ctx=_tracing.submit_span(
+                opts.get("name") or self._fn.__name__, task_id.hex())
+            if (_tracing._enabled or _tracing.current() is not None)
+            else None)
         rt.submit_spec(spec)
         if streaming:
             return ObjectRefGenerator(task_id)
@@ -341,7 +346,12 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
         kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
         return_ids=return_ids, resources=ResourceSet(),
         actor_id=handle._actor_id,
-        max_concurrency=handle._max_concurrency)
+        max_concurrency=handle._max_concurrency,
+        trace_ctx=_tracing.submit_span(
+            f"{handle._class_name}.{method_name or '__ray_call__'}",
+            task_id.hex())
+        if (_tracing._enabled or _tracing.current() is not None)
+        else None)
     rt.submit_spec(spec)
     refs = [ObjectRef(oid) for oid in return_ids]
     return refs[0] if num_returns == 1 else refs

@@ -58,6 +58,10 @@ class TaskSpec:
     # node strips fn_blob for workers that have already received it, and
     # workers reuse the unpickled callable instead of re-loading per task.
     fn_id: Optional[bytes] = None
+    # W3C traceparent of the submit span (reference:
+    # tracing_helper.py:34 — span context propagated in task metadata);
+    # None unless tracing is enabled on the submitting process.
+    trace_ctx: Optional[str] = None
 
 
 @dataclass

@@ -1843,6 +1843,27 @@ class Runtime:
         self.metrics_snapshots[source_id] = snapshot
         return True
 
+    # -- tracing (reference: util/tracing/tracing_helper.py spans routed
+    #    to a collector; here an in-memory bounded span table) ----------- #
+
+    def ctl_add_trace_span(self, span: dict):
+        buf = getattr(self, "_trace_spans", None)
+        if buf is None:
+            from collections import deque
+            buf = self._trace_spans = deque(maxlen=50_000)
+        buf.append(span)
+        return True
+
+    def ctl_get_trace_spans(self, trace_id=None):
+        buf = getattr(self, "_trace_spans", None) or ()
+        return [s for s in buf
+                if trace_id is None or s.get("trace_id") == trace_id]
+
+    def ctl_list_trace_ids(self):
+        buf = getattr(self, "_trace_spans", None) or ()
+        seen = dict.fromkeys(s.get("trace_id") for s in buf)
+        return list(seen)
+
     # ------------------------------------------------------------------ #
 
     def shutdown(self) -> None:

@@ -41,11 +41,11 @@ class WireSpec:
 
     __slots__ = ("task_id", "name", "fn_blob", "fn_id", "method_name",
                  "return_ids", "actor_id", "create_actor_id", "streaming",
-                 "max_concurrency", "runtime_env")
+                 "max_concurrency", "runtime_env", "trace_ctx")
 
     def __init__(self, task_id, name, fn_blob, fn_id, method_name,
                  return_ids, actor_id, streaming, max_concurrency,
-                 runtime_env):
+                 runtime_env, trace_ctx=None):
         self.task_id = task_id
         self.name = name
         self.fn_blob = fn_blob
@@ -57,6 +57,7 @@ class WireSpec:
         self.streaming = streaming
         self.max_concurrency = max_concurrency
         self.runtime_env = runtime_env
+        self.trace_ctx = trace_ctx
 
 
 def encode_run_task(spec, args: List, kwargs: Dict,
@@ -77,7 +78,8 @@ def encode_run_task(spec, args: List, kwargs: Dict,
             spec.max_concurrency,
             spec.runtime_env.get("env_vars") if spec.runtime_env else None,
             args,
-            kwargs)
+            kwargs,
+            spec.trace_ctx)
 
 
 def decode_run_task(t: tuple):
@@ -89,6 +91,7 @@ def decode_run_task(t: tuple):
         ActorID(t[7]) if t[7] is not None else None,
         t[8], t[9],
         {"env_vars": env_vars} if env_vars else None,
+        t[13] if len(t) > 13 else None,
     ), t[11], t[12])
 
 

@@ -374,6 +374,19 @@ class WorkerLoop:
 
     def _run_task(self, msg: RunTask) -> None:
         spec = msg.spec
+        trace_ctx = getattr(spec, "trace_ctx", None)
+        if trace_ctx is not None:
+            # Execute span + context install: nested submits inside the
+            # task join the same trace (reference: tracing_helper.py:181).
+            from ray_tpu.util import tracing
+            with tracing.task_span(trace_ctx, spec.name,
+                                   spec.task_id.hex()):
+                self._run_task_inner(msg)
+        else:
+            self._run_task_inner(msg)
+
+    def _run_task_inner(self, msg: RunTask) -> None:
+        spec = msg.spec
         rt = self.runtime
         rt.current_task_id = spec.task_id
         # Actor tasks may stash zero-copy arg views in actor state, so their

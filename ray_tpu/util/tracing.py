@@ -1,0 +1,225 @@
+"""Distributed tracing: W3C trace context propagated through task submission.
+
+Reference: python/ray/util/tracing/tracing_helper.py:34 (_propagate span
+context into task metadata), :181 (server-side spans around execution) and
+src/ray/observability/open_telemetry_metric_recorder.h — the reference
+injects OpenTelemetry span contexts into TaskSpec metadata so a driver ->
+task -> nested-task chain renders as one trace tree.
+
+Here the context is the W3C ``traceparent`` string
+(``00-<trace_id:32hex>-<span_id:16hex>-01``) carried in
+``TaskSpec.trace_ctx``:
+
+  * ``enable()`` on the driver turns on submit spans; every ``.remote()``
+    records a ``submit`` span and stamps the child context into the spec.
+  * Workers see the context, record an ``execute`` span, and install it as
+    the current context — nested ``.remote()`` calls inherit it, so the
+    whole cascade shares one trace id.
+  * Spans flow to the driver's in-memory span table (ctl RPC from
+    workers); ``get_trace`` returns one trace, ``render_trace`` a textual
+    tree, and ``export_otlp_json`` writes the OTLP/JSON shape for
+    offline import into any OTel-compatible viewer (no network export:
+    zero-egress environments).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_tls = threading.local()
+_enabled = False
+
+
+def _rand_hex(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+class SpanContext:
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @staticmethod
+    def from_traceparent(tp: str) -> Optional["SpanContext"]:
+        try:
+            _ver, trace_id, span_id, _flags = tp.split("-")
+            if len(trace_id) == 32 and len(span_id) == 16:
+                return SpanContext(trace_id, span_id)
+        except ValueError:
+            pass
+        return None
+
+
+def enable() -> None:
+    """Turn on tracing in this process (driver: submit spans + context
+    injection; the flag travels to workers implicitly via specs that carry
+    a context)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def current() -> Optional[SpanContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def set_current(ctx: Optional[SpanContext]) -> None:
+    _tls.ctx = ctx
+
+
+def _record(span: Dict[str, Any]) -> None:
+    """Route a finished span to the driver's span table."""
+    from .._private import runtime as rtmod
+    rt = rtmod.current_runtime()
+    if rt is None:
+        return
+    if hasattr(rt, "control"):  # worker / client
+        try:
+            rt.control("add_trace_span", span)
+        except Exception:
+            pass
+    else:
+        rt.ctl_add_trace_span(span)
+
+
+def submit_span(task_name: str, task_id_hex: str) -> Optional[str]:
+    """Driver/worker side of ``.remote()``: record a submit span and
+    return the traceparent for the spec (None when tracing is off and no
+    ambient context exists)."""
+    parent = current()
+    if not _enabled and parent is None:
+        return None
+    trace_id = parent.trace_id if parent else _rand_hex(16)
+    span_id = _rand_hex(8)
+    now = time.time()
+    _record({
+        "trace_id": trace_id, "span_id": span_id,
+        "parent_span_id": parent.span_id if parent else None,
+        "name": f"submit {task_name}", "kind": "PRODUCER",
+        "start_s": now, "end_s": now,
+        "attributes": {"task_id": task_id_hex, "op": "submit"},
+    })
+    return SpanContext(trace_id, span_id).traceparent()
+
+
+class task_span:
+    """Worker-side context manager around task execution: records the
+    execute span and installs the context so nested submits nest."""
+
+    def __init__(self, traceparent: Optional[str], task_name: str,
+                 task_id_hex: str):
+        self._parent = SpanContext.from_traceparent(traceparent) \
+            if traceparent else None
+        self._name = task_name
+        self._task_id = task_id_hex
+        self._prev = None
+        self._ctx = None
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if self._parent is None:
+            return self
+        self._prev = current()
+        self._ctx = SpanContext(self._parent.trace_id, _rand_hex(8))
+        set_current(self._ctx)
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, _exc, _tb):
+        if self._parent is None:
+            return False
+        set_current(self._prev)
+        _record({
+            "trace_id": self._ctx.trace_id, "span_id": self._ctx.span_id,
+            "parent_span_id": self._parent.span_id,
+            "name": f"execute {self._name}", "kind": "CONSUMER",
+            "start_s": self._t0, "end_s": time.time(),
+            "attributes": {"task_id": self._task_id, "op": "execute",
+                           "error": exc_type.__name__ if exc_type else None},
+        })
+        return False
+
+
+# -- consumption ----------------------------------------------------------- #
+
+def get_trace(trace_id: str) -> List[Dict[str, Any]]:
+    """All spans of one trace, start-ordered."""
+    from .._private.api import _control
+    spans = _control("get_trace_spans", trace_id)
+    return sorted(spans, key=lambda s: s["start_s"])
+
+
+def list_traces() -> List[str]:
+    from .._private.api import _control
+    return _control("list_trace_ids")
+
+
+def render_trace(trace_id: str) -> str:
+    """Textual tree of one trace (parent/child by span ids)."""
+    spans = get_trace(trace_id)
+    by_parent: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent_span_id"), []).append(s)
+    lines: List[str] = [f"trace {trace_id}"]
+
+    def walk(parent_id, depth):
+        for s in by_parent.get(parent_id, ()):
+            dur_ms = (s["end_s"] - s["start_s"]) * 1e3
+            lines.append("  " * depth + f"- {s['name']} "
+                         f"[{s['span_id']}] {dur_ms:.1f}ms")
+            walk(s["span_id"], depth + 1)
+
+    walk(None, 1)
+    return "\n".join(lines)
+
+
+def export_otlp_json(path: str, trace_id: Optional[str] = None) -> str:
+    """Write spans in the OTLP/JSON resource-spans shape (importable by
+    OTel-compatible tools; file export only — zero-egress)."""
+    import json
+
+    from .._private.api import _control
+    spans = (_control("get_trace_spans", trace_id) if trace_id
+             else _control("get_trace_spans", None))
+    otlp = {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": "ray_tpu"}}]},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.tracing"},
+                "spans": [{
+                    "traceId": s["trace_id"],
+                    "spanId": s["span_id"],
+                    "parentSpanId": s.get("parent_span_id") or "",
+                    "name": s["name"],
+                    "kind": 4 if s["kind"] == "PRODUCER" else 5,
+                    "startTimeUnixNano": int(s["start_s"] * 1e9),
+                    "endTimeUnixNano": int(s["end_s"] * 1e9),
+                    "attributes": [
+                        {"key": k, "value": {"stringValue": str(v)}}
+                        for k, v in (s.get("attributes") or {}).items()
+                        if v is not None],
+                } for s in spans],
+            }],
+        }],
+    }
+    with open(path, "w") as f:
+        json.dump(otlp, f)
+    return path

@@ -183,3 +183,87 @@ class TestMetrics:
                 break
             time.sleep(0.2)
         assert "test_worker_side_total 5.0" in metrics_mod.prometheus_text()
+
+
+class TestTracing:
+    """W3C trace-context propagation through task submission (reference:
+    python/ray/util/tracing/tracing_helper.py:34,181)."""
+
+    def test_driver_task_nested_task_one_tree(self, ray_start_isolated):
+        import ray_tpu
+        from ray_tpu.util import tracing
+
+        @ray_tpu.remote
+        def inner(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def outer(x):
+            return ray_tpu.get(inner.remote(x)) + 1
+
+        tracing.enable()
+        try:
+            assert ray_tpu.get(outer.remote(20), timeout=60) == 41
+        finally:
+            tracing.disable()
+
+        # Give the workers' span RPCs a moment to land.
+        import time as _t
+        deadline = _t.monotonic() + 20
+        spans = []
+        while _t.monotonic() < deadline:
+            ids = tracing.list_traces()
+            if ids:
+                spans = tracing.get_trace(ids[0])
+                if len(spans) >= 4:
+                    break
+            _t.sleep(0.2)
+        names = [s["name"] for s in spans]
+        assert "submit outer" in names and "execute outer" in names
+        assert "submit inner" in names and "execute inner" in names
+        # One trace id across the whole cascade.
+        assert len({s["trace_id"] for s in spans}) == 1
+        by_id = {s["span_id"]: s for s in spans}
+        sub_inner = next(s for s in spans if s["name"] == "submit inner")
+        exec_outer = next(s for s in spans if s["name"] == "execute outer")
+        # The nested submit is a child of the outer execute span.
+        assert sub_inner["parent_span_id"] == exec_outer["span_id"]
+        # The outer execute chains to the driver's submit span.
+        sub_outer = next(s for s in spans if s["name"] == "submit outer")
+        assert exec_outer["parent_span_id"] == sub_outer["span_id"]
+        assert sub_outer["parent_span_id"] is None
+        # The tree renders with every span on its own line.
+        txt = tracing.render_trace(spans[0]["trace_id"])
+        assert txt.count("- ") >= 4
+
+    def test_otlp_json_export(self, ray_start_isolated, tmp_path):
+        import json
+
+        import ray_tpu
+        from ray_tpu.util import tracing
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        tracing.enable()
+        try:
+            ray_tpu.get(f.remote(), timeout=60)
+        finally:
+            tracing.disable()
+        out = tracing.export_otlp_json(str(tmp_path / "trace.json"))
+        doc = json.load(open(out))
+        spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert spans and all(s["traceId"] and s["spanId"] for s in spans)
+
+    def test_tracing_disabled_adds_no_context(self, ray_start_isolated):
+        import ray_tpu
+        from ray_tpu.util import tracing
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert not tracing.is_enabled()
+        ray_tpu.get(f.remote(), timeout=60)
+        assert tracing.list_traces() == []
