@@ -88,6 +88,47 @@ class Dataset:
         return _read_files(paths, load, parallelism)
 
     @staticmethod
+    def read_binary_files(paths: Union[str, List[str]],
+                          parallelism: int = 8) -> "Dataset":
+        """One row per file: {'bytes', 'path'} (reference:
+        _internal/datasource/binary_datasource.py)."""
+        from .datasource import expand_paths, load_binary_block
+        return _read_files(expand_paths(paths), load_binary_block,
+                           parallelism)
+
+    @staticmethod
+    def read_images(paths: Union[str, List[str]], *,
+                    size: Optional[tuple] = None,
+                    mode: Optional[str] = None,
+                    parallelism: int = 8) -> "Dataset":
+        """Decode image files to {'image', 'path'} rows; ``size=(H, W)``
+        resizes at decode, ``mode`` converts color space (reference:
+        _internal/datasource/image_datasource.py)."""
+        import functools
+
+        from .datasource import (IMAGE_EXTS, expand_paths,
+                                 load_image_block)
+        loader = functools.partial(load_image_block, size=size, mode=mode)
+        return _read_files(expand_paths(paths, IMAGE_EXTS), loader,
+                           parallelism)
+
+    @staticmethod
+    def read_tfrecord(paths: Union[str, List[str]], *,
+                      verify_crc: bool = False,
+                      parallelism: int = 8) -> "Dataset":
+        """Parse tf.train.Example TFRecord shards into columnar blocks —
+        self-contained framing + protobuf codec, no tensorflow
+        (reference: _internal/datasource/tfrecords_datasource.py)."""
+        import functools
+
+        from .datasource import expand_paths, load_tfrecord_block
+        loader = functools.partial(load_tfrecord_block,
+                                   verify_crc=verify_crc)
+        return _read_files(
+            expand_paths(paths, (".tfrecord", ".tfrecords")), loader,
+            parallelism)
+
+    @staticmethod
     def read_csv(paths: Union[str, List[str]],
                  parallelism: int = 8) -> "Dataset":
         import glob as g
@@ -259,6 +300,10 @@ class Dataset:
     def write_parquet(self, path: str) -> List[str]:
         return self._write(path, _parquet_writer, "parquet")
 
+    def write_tfrecord(self, path: str) -> List[str]:
+        from .datasource import write_tfrecord_block
+        return self._write(path, write_tfrecord_block, "tfrecord")
+
     def write_csv(self, path: str) -> List[str]:
         return self._write(path, _csv_writer, "csv")
 
@@ -372,6 +417,18 @@ def from_pandas(df, parallelism: int = 8) -> Dataset:
 
 def read_parquet(paths, parallelism: int = 8) -> Dataset:
     return Dataset.read_parquet(paths, parallelism)
+
+
+def read_binary_files(paths, parallelism: int = 8, **kw) -> Dataset:
+    return Dataset.read_binary_files(paths, parallelism=parallelism, **kw)
+
+
+def read_images(paths, parallelism: int = 8, **kw) -> Dataset:
+    return Dataset.read_images(paths, parallelism=parallelism, **kw)
+
+
+def read_tfrecord(paths, parallelism: int = 8, **kw) -> Dataset:
+    return Dataset.read_tfrecord(paths, parallelism=parallelism, **kw)
 
 
 def read_csv(paths, parallelism: int = 8) -> Dataset:

@@ -25,8 +25,57 @@ import numpy as np
 
 from .block import Block, BlockAccessor
 
-# At most this many block tasks in flight per stage (backpressure).
+# Fallback in-flight cap where no per-operator policy instance exists
+# (driver-local paths); streaming stages use _OpBackpressure below.
 MAX_IN_FLIGHT = 8
+
+
+class _OpBackpressure:
+    """Per-operator in-flight window, sized from observed block bytes
+    against the context memory budget (reference:
+    _internal/execution/backpressure_policy/ — per-op resource budgets
+    instead of one global constant)."""
+
+    def __init__(self):
+        from .context import DataContext
+        self._ctx = DataContext.get()
+        self._ema: float = 0.0
+
+    def note_block(self, ref) -> None:
+        nbytes = _block_nbytes(ref)
+        if nbytes:
+            self._ema = nbytes if not self._ema else \
+                0.7 * self._ema + 0.3 * nbytes
+
+    def window(self) -> int:
+        ctx = self._ctx
+        if not self._ema:
+            return ctx.initial_in_flight
+        w = int(ctx.op_memory_budget_bytes // max(self._ema, 1.0))
+        return max(ctx.min_in_flight, min(ctx.max_in_flight, w))
+
+
+def _block_nbytes(ref) -> int:
+    """Driver-side size of a ready block from its store descriptor."""
+    import ray_tpu
+    if not isinstance(ref, ray_tpu.ObjectRef):
+        return 0
+    from ray_tpu._private.runtime import driver_runtime
+    rt = driver_runtime()
+    if rt is None:
+        return 0
+    with rt._dir_lock:
+        st = rt.directory.get(ref.id())
+    d = st.desc if st is not None else None
+    if not isinstance(d, tuple) or not d:
+        return 0
+    if d[0] == "inline":
+        return len(d[1])
+    if d[0] == "shm":
+        return int(d[2])
+    if d[0] == "shma":
+        return int(d[3])
+    return 0
 
 
 def _apply_chain(fns, block_or_read):
@@ -125,13 +174,15 @@ def _stream_fused(blocks: List[Any], fns: List[Callable]) -> Iterator[Any]:
         return
 
     apply_remote = ray_tpu.remote(_apply_chain)
+    bp = _OpBackpressure()
     pending: List[Any] = []
     idx = 0
     while idx < len(blocks) or pending:
-        while idx < len(blocks) and len(pending) < MAX_IN_FLIGHT:
+        while idx < len(blocks) and len(pending) < bp.window():
             pending.append(apply_remote.remote(fns, blocks[idx]))
             idx += 1
         ray_tpu.wait([pending[0]], num_returns=1, timeout=600)
+        bp.note_block(pending[0])
         yield pending.pop(0)
 
 
@@ -175,14 +226,16 @@ def _run_shuffle(blocks: List[Any], fused: List[Callable], stage
         return _split_block(seed_i, n, rand, _apply_chain(fns, block_or_read))
 
     split_remote = ray_tpu.remote(map_side).options(num_returns=n_out)
+    bp = _OpBackpressure()
     parts: List[List[Any]] = []
     for i, b in enumerate(blocks):
-        # Windowed submission (the documented per-stage backpressure):
-        # throttle map-task *execution*; the N*n_out part objects still
+        # Windowed submission (per-operator backpressure): throttle
+        # map-task *execution*; the N*n_out part objects still
         # accumulate, which is inherent to an all-to-all exchange.
-        if i >= MAX_IN_FLIGHT:
-            ray_tpu.wait([parts[i - MAX_IN_FLIGHT][0]], num_returns=1,
-                         timeout=600)
+        w = bp.window()
+        if i >= w:
+            ray_tpu.wait([parts[i - w][0]], num_returns=1, timeout=600)
+            bp.note_block(parts[i - w][0])
         s = None if seed is None else seed + i
         refs = split_remote.remote(s, n_out, randomize, fused, b)
         parts.append(refs if isinstance(refs, list) else [refs])
@@ -287,11 +340,13 @@ def _run_key_exchange(blocks: List[Any], fused: List[Callable], stage
             pass
 
     split_remote = ray_tpu.remote(_key_split).options(num_returns=n_out)
+    bp = _OpBackpressure()
     parts: List[List[Any]] = []
     for i, b in enumerate(blocks):
-        if i >= MAX_IN_FLIGHT:
-            ray_tpu.wait([parts[i - MAX_IN_FLIGHT][0]], num_returns=1,
-                         timeout=600)
+        w = bp.window()
+        if i >= w:
+            ray_tpu.wait([parts[i - w][0]], num_returns=1, timeout=600)
+            bp.note_block(parts[i - w][0])
         refs = split_remote.remote(key, boundaries, n_out, fused, b)
         parts.append(refs if isinstance(refs, list) else [refs])
 
