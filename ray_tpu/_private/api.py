@@ -311,7 +311,7 @@ class RemoteFunction:
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
-                 num_returns: int = 1):
+                 num_returns: Any = 1):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
@@ -333,11 +333,16 @@ class ActorMethod:
 
 
 def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
-                       args, kwargs, num_returns: int):
-    """Shared submit path for actor methods and __ray_call__ applies."""
+                       args, kwargs, num_returns: Any):
+    """Shared submit path for actor methods and __ray_call__ applies.
+    ``num_returns="streaming"`` runs a generator method: yielded items
+    publish one-by-one and the caller gets an ObjectRefGenerator
+    (reference: streaming actor calls via ObjectRefStream)."""
     rt = _require_runtime()
+    streaming = num_returns == "streaming"
     task_id = TaskID.of(handle._actor_id)
-    return_ids = [ObjectID.of(task_id, i) for i in range(num_returns)]
+    return_ids = [] if streaming else [
+        ObjectID.of(task_id, i) for i in range(num_returns)]
     spec = TaskSpec(
         task_id=task_id,
         name=f"{handle._class_name}.{method_name or '__ray_call__'}",
@@ -347,12 +352,15 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
         return_ids=return_ids, resources=ResourceSet(),
         actor_id=handle._actor_id,
         max_concurrency=handle._max_concurrency,
+        streaming=streaming,
         trace_ctx=_tracing.submit_span(
             f"{handle._class_name}.{method_name or '__ray_call__'}",
             task_id.hex())
         if (_tracing._enabled or _tracing.current() is not None)
         else None)
     rt.submit_spec(spec)
+    if streaming:
+        return ObjectRefGenerator(task_id)
     refs = [ObjectRef(oid) for oid in return_ids]
     return refs[0] if num_returns == 1 else refs
 

@@ -440,35 +440,21 @@ class WorkerLoop:
                     # __ray_call__-style apply: run fn(actor_instance, ...)
                     # on the actor's worker (used by compiled DAG loops).
                     fn = serialization.loads_control(spec.fn_blob)
-                    out = fn(self.actor_instance, *args, **kwargs)
+                    call = lambda: fn(self.actor_instance, *args, **kwargs)  # noqa: E731
                 else:
                     method = getattr(self.actor_instance, spec.method_name)
-                    out = method(*args, **kwargs)
-                value_list = self._split_returns(out, spec)
-            elif spec.streaming:
-                # Streaming generator (reference: ObjectRefStream,
-                # task_manager.h:86): each yielded item is published
-                # immediately as ObjectID.of(task_id, i); the final
-                # ("end",) marker closes the stream, and a mid-stream
-                # exception lands as an err descriptor at the failing
-                # index so the consumer raises at the right position.
-                fn = self._load_fn(spec)
-                count = 0
-                try:
-                    for item in fn(*args, **kwargs):
-                        oid = ObjectID.of(spec.task_id, count)
-                        rt.send(PutFromWorker(
-                            oid, _serialize_result(rt, oid, item)))
-                        count += 1
-                except BaseException as exc:  # noqa: BLE001
-                    stream_err = TaskError(exc, spec.name,
-                                           traceback.format_exc())
-                    results.append((
-                        ObjectID.of(spec.task_id, count),
-                        ("err", serialization.pack_payload(stream_err))))
+                    call = lambda: method(*args, **kwargs)  # noqa: E731
+                if getattr(spec, "streaming", False):
+                    # Streaming actor method: yielded items publish
+                    # one-by-one (reference: streaming actor calls).
+                    self._run_stream(call, spec, rt, results)
+                    value_list = []
                 else:
-                    results.append((ObjectID.of(spec.task_id, count),
-                                    ("end",)))
+                    value_list = self._split_returns(call(), spec)
+            elif spec.streaming:
+                fn = self._load_fn(spec)
+                self._run_stream(lambda: fn(*args, **kwargs), spec, rt,
+                                 results)
                 value_list = []
             else:
                 fn = self._load_fn(spec)
@@ -500,6 +486,28 @@ class WorkerLoop:
             error, is_app_error,
             aid.binary() if aid is not None else None,
             _time.monotonic() - t0))
+
+    @staticmethod
+    def _run_stream(produce, spec, rt, results) -> None:
+        """Streaming generator (reference: ObjectRefStream,
+        task_manager.h:86): each yielded item is published immediately
+        as ObjectID.of(task_id, i); the final ("end",) marker closes the
+        stream, and a mid-stream exception lands as an err descriptor at
+        the failing index so the consumer raises at the right position."""
+        count = 0
+        try:
+            for item in produce():
+                oid = ObjectID.of(spec.task_id, count)
+                rt.send(PutFromWorker(
+                    oid, _serialize_result(rt, oid, item)))
+                count += 1
+        except BaseException as exc:  # noqa: BLE001
+            stream_err = TaskError(exc, spec.name, traceback.format_exc())
+            results.append((
+                ObjectID.of(spec.task_id, count),
+                ("err", serialization.pack_payload(stream_err))))
+        else:
+            results.append((ObjectID.of(spec.task_id, count), ("end",)))
 
     @staticmethod
     def _split_returns(out: Any, spec) -> List[Any]:

@@ -77,6 +77,45 @@ class LLMServer:
         return {"output_tokens": req.output_tokens,
                 "finish_reason": req.finish_reason}
 
+    def stream(self, body: Dict[str, Any]):
+        """Token-streaming entry point: yields tokens as the engine emits
+        them (served via ``handle.options(stream=True)`` -> a streaming
+        actor call, so each token publishes the moment it exists —
+        reference: serve.llm streaming chat completions)."""
+        import time as _time
+        params = SamplingParams(
+            max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            stop_token_ids=tuple(body.get("stop_token_ids", ())))
+        ev = threading.Event()
+        with self._lock:
+            rid = self.engine.add_request(
+                list(body["prompt_tokens"]), params)
+            self._events[rid] = ev
+            req = self.engine.running.get(rid)
+        deadline = _time.monotonic() + float(body.get("timeout_s", 300))
+        sent = 0
+        try:
+            while True:
+                done = ev.wait(timeout=0.01)
+                toks = list(req.output_tokens) if req is not None else []
+                while sent < len(toks):
+                    yield {"token": int(toks[sent]), "index": sent}
+                    sent += 1
+                if done and sent >= len(req.output_tokens):
+                    yield {"finish_reason": req.finish_reason,
+                           "num_tokens": sent}
+                    return
+                if _time.monotonic() > deadline:
+                    self.engine.cancel(rid)
+                    yield {"error": "generation timed out"}
+                    return
+        finally:
+            with self._lock:
+                self._events.pop(rid, None)
+                self._results.pop(rid, None)
+
     def generate_batch(self, prompts: List[List[int]],
                        max_tokens: int = 64) -> List[List[int]]:
         """Offline batch entry point (reference: llm batch stages)."""

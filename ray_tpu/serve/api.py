@@ -1,11 +1,17 @@
 """Serve core: deployments, replicas, router, handles, HTTP ingress.
 
-The controller lives in the driver process (reference runs it as an actor,
-_private/controller.py:126 — the single-host round-1 simplification);
-replicas are runtime actors; the router does power-of-two-choices over
-per-replica in-flight counts (reference: pow_2_router.py); the optional
-HTTP proxy is an aiohttp app on a daemon thread (reference: proxy.py
-uvicorn ingress).
+The control plane lives in the ``SERVE_CONTROLLER`` actor (reference:
+_private/controller.py:126 — ServeController as a detached actor): it
+owns replica actors, so deployments keep serving after the creating
+driver exits.  Versioned replica-set snapshots flow through the cluster
+KV (reference: _private/long_poll.py LongPollHost); each consuming
+process runs a local ``_Router`` that rebuilds replica handles from the
+snapshot and does power-of-two-choices over its own in-flight counts
+(reference: pow_2_router.py — per-router counts, exactly the reference's
+model), pushing totals back to the controller for request-based
+autoscaling.  The optional HTTP proxy is an aiohttp app on a daemon
+thread (reference: proxy.py uvicorn ingress) with chunked streaming for
+generator responses.
 """
 
 from __future__ import annotations
@@ -20,9 +26,9 @@ if TYPE_CHECKING:
     from .controller import AutoscalingConfig
 
 _app_lock = threading.Lock()
-_deployments: Dict[str, "_DeploymentState"] = {}
+_routers: Dict[str, "_Router"] = {}
 _http_server = None
-_controller = None
+_controller_handle = None
 
 
 @dataclass
@@ -86,13 +92,17 @@ class _ReplicaActor:
         else:
             self._callable = target
 
-    def handle_request(self, method: str, args, kwargs,
-                       multiplexed_model_id: Optional[str] = None):
+    def _resolve_target(self, method: str):
         target = getattr(self._callable, method, None)
         if target is None and method == "__call__":
             target = self._callable
         if target is None:
             raise AttributeError(f"deployment has no method {method!r}")
+        return target
+
+    def handle_request(self, method: str, args, kwargs,
+                       multiplexed_model_id: Optional[str] = None):
+        target = self._resolve_target(method)
         if multiplexed_model_id is None:
             return target(*args, **kwargs)
         # Multiplexed request: expose the model id for the duration of the
@@ -107,6 +117,23 @@ class _ReplicaActor:
 
     def ping(self):
         return "ok"
+
+    def handle_request_stream(self, method: str, args, kwargs,
+                              multiplexed_model_id: Optional[str] = None):
+        """Generator entry point: runs as a streaming actor call — each
+        yielded item publishes immediately (token streaming).  Must BE a
+        generator (not return one) so the multiplexed-model context stays
+        installed while the body executes, not just until first return."""
+        target = self._resolve_target(method)
+        if multiplexed_model_id is None:
+            yield from target(*args, **kwargs)
+            return
+        from .multiplex import _current_model_id, _set_current_model_id
+        token = _set_current_model_id(multiplexed_model_id)
+        try:
+            yield from target(*args, **kwargs)
+        finally:
+            _current_model_id.reset(token)
 
 
 class _DeploymentState:
@@ -124,10 +151,11 @@ class _DeploymentState:
         ac = dep.autoscaling_config
         self.target_replicas = max(dep.num_replicas, ac.min_replicas) \
             if ac is not None else dep.num_replicas
-        from .multiplex import RouterAffinity, _MultiplexedDescriptor
-        # Mirror the replica LRU size so the router stops preferring a
+        from .multiplex import _MultiplexedDescriptor
+        # Mirror the replica LRU size so routers stop preferring a
         # replica once it would have evicted the model (avoids reload
-        # thrash pinning all hot models to one replica).
+        # thrash pinning all hot models to one replica); shipped to
+        # routers in the replica-set snapshot.
         cap = None
         target = dep.cls_or_fn
         if isinstance(target, type):
@@ -138,7 +166,7 @@ class _DeploymentState:
                         break
                 if cap is not None:
                     break
-        self.affinity = RouterAffinity(cap if cap is not None else 8)
+        self.multiplex_cap = cap if cap is not None else 8
         self._lock = threading.Lock()
         self._opts: Optional[Dict[str, Any]] = None
         self._cls_blob: Optional[bytes] = None
@@ -180,51 +208,26 @@ class _DeploymentState:
             self.inflight[id(r)] = 0
         return r
 
-    def remove_replica(self):
-        import ray_tpu
+    def pop_replica(self, min_load: Optional[Dict[str, int]] = None):
+        """Detach and return the least-loaded replica (by the router-
+        reported per-replica loads) WITHOUT killing it — the controller
+        drains it first."""
         with self._lock:
             if not self.replicas:
-                return
-            # Prefer draining an idle replica (reference: deployment_state
-            # drains before stopping); fall back to the least-loaded one.
+                return None
+            loads = min_load or {}
             idx = min(range(len(self.replicas)),
-                      key=lambda i: self.inflight.get(
-                          id(self.replicas[i]), 0))
+                      key=lambda i: loads.get(
+                          self.replicas[i]._actor_id.hex(), 0))
             r = self.replicas.pop(idx)
             self.inflight.pop(id(r), None)
-            self.affinity.drop_replica(id(r))
-        try:
-            ray_tpu.kill(r)
-        except Exception:
-            pass
+            return r
 
     def start(self):
         import ray_tpu
         refs = [self.add_replica().ping.remote()
                 for _ in range(self.target_replicas)]
         ray_tpu.get(refs, timeout=120)
-
-    def pick_replica(self, multiplexed_model_id: Optional[str] = None):
-        """Power-of-two-choices on in-flight counts (reference:
-        pow_2_router.py), preferring model-affine replicas for multiplexed
-        requests (reference: multiplex-aware request router)."""
-        with self._lock:
-            n = len(self.replicas)
-            if n == 0:
-                return None
-            if multiplexed_model_id is not None and n > 1:
-                affine = set(self.affinity.replicas_for(multiplexed_model_id))
-                if affine:
-                    cands = [r for r in self.replicas if id(r) in affine]
-                    if cands:
-                        return min(cands, key=lambda r:
-                                   self.inflight.get(id(r), 0))
-            if n == 1:
-                return self.replicas[0]
-            ia, ib = random.sample(range(n), 2)
-            a, b = self.replicas[ia], self.replicas[ib]
-            return a if self.inflight.get(id(a), 0) <= \
-                self.inflight.get(id(b), 0) else b
 
     def stop(self):
         import ray_tpu
@@ -239,62 +242,311 @@ class _DeploymentState:
                 pass
 
 
+def _rt_token() -> int:
+    from .._private import runtime as rtmod
+    return id(rtmod.current_runtime())
+
+
+def _cached_controller() -> Optional[Any]:
+    """Cached handle, valid only for the CURRENT runtime (a new init()
+    after shutdown must not reuse a dead cluster's controller)."""
+    with _app_lock:
+        if _controller_handle is not None and \
+                _controller_handle[0] == _rt_token():
+            return _controller_handle[1]
+    return None
+
+
+def _controller() -> Any:
+    """Get-or-create the cluster's SERVE_CONTROLLER actor handle."""
+    global _controller_handle
+    import ray_tpu
+    cached = _cached_controller()
+    if cached is not None:
+        return cached
+    from .controller import (CONTROLLER_NAME, CONTROLLER_NAMESPACE,
+                             ServeControllerActor)
+    cls = ray_tpu.remote(ServeControllerActor)
+    last_exc: Optional[Exception] = None
+    for _attempt in range(10):
+        handle = cls.options(
+            name=CONTROLLER_NAME, namespace=CONTROLLER_NAMESPACE,
+            get_if_exists=True, max_restarts=10, num_cpus=0,
+            max_concurrency=16).remote()
+        try:
+            ray_tpu.get(handle.ping.remote(), timeout=120)
+        except Exception as e:  # noqa: BLE001
+            # A dying controller (shutdown race) can win the name lookup;
+            # wait for its death to land, then create fresh.
+            last_exc = e
+            time.sleep(0.3)
+            continue
+        with _app_lock:
+            _controller_handle = (_rt_token(), handle)
+        return handle
+    raise RuntimeError(
+        f"could not reach or recreate the serve controller: {last_exc!r}")
+
+
+def _existing_controller() -> Optional[Any]:
+    global _controller_handle
+    cached = _cached_controller()
+    if cached is not None:
+        return cached
+    import ray_tpu
+    from .controller import CONTROLLER_NAME, CONTROLLER_NAMESPACE
+    try:
+        handle = ray_tpu.get_actor(CONTROLLER_NAME,
+                                   namespace=CONTROLLER_NAMESPACE)
+    except ValueError:
+        return None
+    with _app_lock:
+        _controller_handle = (_rt_token(), handle)
+    return handle
+
+
+class _Router:
+    """Per-process replica-set cache + pow-2 routing over LOCAL in-flight
+    counts (reference: pow_2_router.py — routers track their own counts;
+    the controller aggregates pushed totals for autoscaling)."""
+
+    REFRESH_S = 1.0
+
+    def __init__(self, name: str):
+        import os
+        self.name = name
+        self.router_id = os.urandom(8).hex()
+        self._lock = threading.Lock()
+        self._version = -1
+        self._replicas: List[tuple] = []  # (actor_id_hex, handle)
+        self._inflight: Dict[str, int] = {}
+        self._fetched = 0.0
+        from .multiplex import RouterAffinity
+        self.affinity = RouterAffinity(8)
+        self._metrics_started = False
+        # Driver-local fast path: evict replicas the moment the controller
+        # marks their actor DEAD (reference: router reacting to
+        # long-poll replica-set pushes) — the KV TTL refresh alone leaves
+        # a window where fresh requests route to a corpse.
+        import weakref
+
+        from .._private import runtime as rtmod
+        rt = rtmod.current_runtime()
+        if rt is not None and hasattr(rt, "controller"):
+            self_ref = weakref.ref(self)
+
+            def on_actor_state(msg, _ref=self_ref):
+                router = _ref()
+                if router is None:
+                    return
+                actor_id, state = msg
+                if state == "DEAD":
+                    router.evict(actor_id.hex())
+            rt.controller.subscribe("actor_state", on_actor_state)
+
+    def evict(self, hexid: str) -> None:
+        with self._lock:
+            before = len(self._replicas)
+            self._replicas = [e for e in self._replicas if e[0] != hexid]
+            if len(self._replicas) != before:
+                self._inflight.pop(hexid, None)
+                self.affinity.drop_replica(hexid)
+                # Force the next pick to consult the KV snapshot.
+                self._fetched = 0.0
+
+    def _refresh(self, force: bool = False) -> None:
+        import pickle
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._fetched < self.REFRESH_S:
+                return
+        from .._private.api import _control
+        from .controller import REPLICA_KV_PREFIX
+        blob = _control("kv_get", REPLICA_KV_PREFIX + self.name)
+        entries: List[tuple] = []
+        version = None
+        cap = None
+        if blob is not None:
+            snap = pickle.loads(blob)
+            version, entries = snap[0], snap[1]
+            if len(snap) > 2:
+                cap = snap[2]
+        with self._lock:
+            self._fetched = now
+            if version is None or version == self._version:
+                if blob is None:
+                    self._replicas = []
+                return
+            self._version = version
+            if cap is not None and cap != self.affinity._max:
+                from .multiplex import RouterAffinity
+                self.affinity = RouterAffinity(cap)
+            from .._private.api import ActorHandle
+            from .._private.ids import ActorID
+            live = set()
+            handles = []
+            for hexid, cls_name, max_ongoing in entries:
+                live.add(hexid)
+                handles.append((hexid, ActorHandle(
+                    ActorID(bytes.fromhex(hexid)), cls_name)))
+            self._replicas = handles
+            for gone in set(self._inflight) - live:
+                self._inflight.pop(gone, None)
+                self.affinity.drop_replica(gone)
+
+    def pick(self, model_id: Optional[str]) -> Optional[tuple]:
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                return None
+            if model_id is not None and n > 1:
+                affine = set(self.affinity.replicas_for(model_id))
+                cands = [e for e in self._replicas if e[0] in affine]
+                if cands:
+                    return min(cands, key=lambda e:
+                               self._inflight.get(e[0], 0))
+            if n == 1:
+                return self._replicas[0]
+            ia, ib = random.sample(range(n), 2)
+            a, b = self._replicas[ia], self._replicas[ib]
+            return a if self._inflight.get(a[0], 0) <= \
+                self._inflight.get(b[0], 0) else b
+
+    def note_start(self, hexid: str) -> None:
+        with self._lock:
+            self._inflight[hexid] = self._inflight.get(hexid, 0) + 1
+        self._ensure_metrics_thread()
+
+    def note_done(self, hexid: str) -> None:
+        with self._lock:
+            if hexid in self._inflight:
+                self._inflight[hexid] = max(0, self._inflight[hexid] - 1)
+
+    def total_inflight(self) -> int:
+        with self._lock:
+            return sum(self._inflight.values())
+
+    def _ensure_metrics_thread(self) -> None:
+        with self._lock:
+            if self._metrics_started:
+                return
+            self._metrics_started = True
+
+        def push():
+            try:
+                while True:
+                    time.sleep(1.0)
+                    with _app_lock:
+                        if _routers.get(self.name) is not self:
+                            return  # router replaced (redeploy): retire
+                    from .._private import runtime as rtmod
+                    if rtmod.current_runtime() is None:
+                        return  # runtime shut down
+                    try:
+                        ctrl = _existing_controller()
+                        if ctrl is None:
+                            continue  # controller restarting: keep trying
+                        with self._lock:
+                            counts = {k: v
+                                      for k, v in self._inflight.items()
+                                      if v}
+                        ctrl.report_metrics.remote(
+                            self.name, self.router_id, counts)
+                    except Exception:
+                        # Transient (controller swap, runtime teardown
+                        # race): retry next tick; the loop exits via the
+                        # runtime/router checks above.
+                        continue
+            finally:
+                # Let a future request respawn the pusher if this router
+                # is still the live one (a dead pusher would silently
+                # starve the autoscaler and mis-drain downscales).
+                with self._lock:
+                    self._metrics_started = False
+        threading.Thread(target=push, name=f"serve-metrics-{self.name}",
+                         daemon=True).start()
+
+
+def _router_for(name: str) -> _Router:
+    with _app_lock:
+        r = _routers.get(name)
+        if r is None:
+            r = _routers[name] = _Router(name)
+    return r
+
+
 class DeploymentHandle:
-    """reference: serve/handle.py:1041 — .remote() routes a request."""
+    """reference: serve/handle.py:1041 — .remote() routes a request;
+    ``options(stream=True)`` returns an ObjectRefGenerator over a
+    generator method's yielded items (token streaming)."""
 
     def __init__(self, name: str, method: str = "__call__",
-                 multiplexed_model_id: Optional[str] = None):
+                 multiplexed_model_id: Optional[str] = None,
+                 stream: bool = False):
         self._name = name
         self._method = method
         self._model_id = multiplexed_model_id
+        self._stream = stream
 
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(self._name, method_name or self._method,
-                                multiplexed_model_id or self._model_id)
+                                multiplexed_model_id or self._model_id,
+                                self._stream if stream is None else stream)
 
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
-        return DeploymentHandle(self._name, item, self._model_id)
+        return DeploymentHandle(self._name, item, self._model_id,
+                                self._stream)
 
     def remote(self, *args, **kwargs):
-        with _app_lock:
-            state = _deployments.get(self._name)
-        if state is None:
-            raise ValueError(f"no deployment named {self._name!r}")
+        router = _router_for(self._name)
+        router._refresh()
         # A reconcile may briefly leave zero replicas (all died at once);
         # wait for the controller to backfill rather than failing the
         # request (reference: router retries against the long-poll set).
         deadline = time.monotonic() + 60
         while True:
-            replica = state.pick_replica(self._model_id)
-            if replica is not None:
+            picked = router.pick(self._model_id)
+            if picked is not None:
                 break
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"deployment {self._name!r} has no live replicas")
             time.sleep(0.05)
-        with state._lock:
-            state.inflight[id(replica)] = \
-                state.inflight.get(id(replica), 0) + 1
+            router._refresh(force=True)
+        hexid, replica = picked
+        router.note_start(hexid)
         if self._model_id is not None:
-            state.affinity.note(id(replica), self._model_id)
-            ref = replica.handle_request.remote(
-                self._method, args, kwargs,
-                multiplexed_model_id=self._model_id)
+            router.affinity.note(hexid, self._model_id)
+        method = "handle_request_stream" if self._stream \
+            else "handle_request"
+        submit = getattr(replica, method)
+        if self._stream:
+            submit = submit.options(num_returns="streaming")
+        if self._model_id is not None:
+            ref = submit.remote(self._method, args, kwargs,
+                                multiplexed_model_id=self._model_id)
         else:
-            ref = replica.handle_request.remote(self._method, args, kwargs)
+            ref = submit.remote(self._method, args, kwargs)
+        if self._stream:
+            # Streamed request: the wrapper decrements in-flight when the
+            # consumer finishes (or abandons) the stream.
+            def _stream_refs(gen=ref):
+                try:
+                    for item_ref in gen:
+                        yield item_ref
+                finally:
+                    router.note_done(hexid)
+            return _stream_refs()
 
         def _done():
-            with state._lock:
-                if id(replica) in state.inflight:
-                    state.inflight[id(replica)] = max(
-                        0, state.inflight[id(replica)] - 1)
+            _wait_quiet(ref)
+            router.note_done(hexid)
         # Decrement when the result materializes.
-        threading.Thread(target=lambda: (_wait_quiet(ref), _done()),
-                         daemon=True).start()
+        threading.Thread(target=_done, daemon=True).start()
         return ref
 
 
@@ -309,57 +561,61 @@ def _wait_quiet(ref):
 def run(app: Application, *, name: Optional[str] = None,
         route_prefix: Optional[str] = None,
         http_port: Optional[int] = None) -> DeploymentHandle:
-    """Deploy and return a handle (reference: serve/api.py:902)."""
-    global _controller
+    """Deploy through the controller actor and return a handle
+    (reference: serve/api.py:902).  The controller owns the replicas, so
+    the deployment keeps serving if this driver exits."""
     import ray_tpu
     if not ray_tpu.is_initialized():
         ray_tpu.init()
     dep = app.deployment if isinstance(app, Application) else app
+    from .._private import serialization
+    ctrl = _controller()
+    ray_tpu.get(ctrl.deploy.remote(serialization.dumps_control(dep)),
+                timeout=300)
     with _app_lock:
-        old = _deployments.get(dep.name)
-        if old is not None:
-            old.stop()
-        state = _DeploymentState(dep)
-        _deployments[dep.name] = state
-    state.start()
-    if _controller is None:
-        from .controller import ServeController
-        _controller = ServeController(_deployments, _app_lock)
+        _routers.pop(dep.name, None)  # drop stale replica cache
     if http_port is not None:
         _ensure_http(http_port)
     return DeploymentHandle(dep.name)
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
-    with _app_lock:
-        if name not in _deployments:
-            raise ValueError(f"no deployment named {name!r}")
+    import pickle
+
+    from .._private.api import _control
+    from .controller import REPLICA_KV_PREFIX
+    if _control("kv_get", REPLICA_KV_PREFIX + name) is None:
+        raise ValueError(f"no deployment named {name!r}")
+    _ = pickle  # (snapshot validated lazily by the router)
     return DeploymentHandle(name)
 
 
 def status() -> Dict[str, Dict[str, Any]]:
-    with _app_lock:
-        states = list(_deployments.items())
-    out = {}
-    for name, s in states:
-        with s._lock:
-            out[name] = {
-                "num_replicas": len(s.replicas),
-                "target_replicas": s.target_replicas,
-                "inflight": dict(s.inflight),
-            }
-    return out
+    import ray_tpu
+    ctrl = _existing_controller()
+    if ctrl is None:
+        return {}
+    return ray_tpu.get(ctrl.status.remote(), timeout=60)
 
 
 def shutdown() -> None:
-    global _http_server, _controller
-    if _controller is not None:
-        _controller.stop()
-        _controller = None
+    """Stop every deployment and the controller actor (reference:
+    serve.shutdown tearing down the Serve instance)."""
+    global _http_server, _controller_handle
+    import ray_tpu
+    ctrl = _existing_controller()
+    if ctrl is not None:
+        try:
+            ray_tpu.get(ctrl.shutdown_all.remote(), timeout=120)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(ctrl)
+        except Exception:
+            pass
     with _app_lock:
-        for s in _deployments.values():
-            s.stop()
-        _deployments.clear()
+        _controller_handle = None
+        _routers.clear()
     if _http_server is not None:
         _http_server.stop()
         _http_server = None
@@ -386,16 +642,50 @@ class _HttpServer:
         from aiohttp import web
 
         async def handle(request: "web.Request"):
+            import json as _json
             name = request.match_info["deployment"]
             try:
                 body = await request.json()
             except Exception:
                 body = {}
+            stream = bool(body.pop("stream", False)) if isinstance(
+                body, dict) else False
             try:
                 handle_ = get_deployment_handle(name)
-                ref = handle_.remote(body)
                 import ray_tpu
-                result = await asyncio.get_event_loop().run_in_executor(
+                loop = asyncio.get_event_loop()
+                if stream:
+                    # Chunked streaming ingress (reference: proxy.py
+                    # streaming responses): each generator item is one
+                    # newline-delimited JSON chunk.
+                    gen = handle_.options(stream=True).remote(body)
+                    resp = web.StreamResponse(headers={
+                        "Content-Type": "application/x-ndjson"})
+                    await resp.prepare(request)
+                    it = iter(gen)
+                    try:
+                        while True:
+                            item_ref = await loop.run_in_executor(
+                                None, lambda: next(it, None))
+                            if item_ref is None:
+                                break
+                            item = await loop.run_in_executor(
+                                None, lambda: ray_tpu.get(item_ref,
+                                                          timeout=300))
+                            await resp.write(
+                                (_json.dumps({"result": item})
+                                 + "\n").encode())
+                    except Exception as e:  # noqa: BLE001
+                        # Mid-stream failure: the chunked response is
+                        # already prepared — emit an error CHUNK, never a
+                        # second response.
+                        await resp.write(
+                            (_json.dumps({"error": repr(e)})
+                             + "\n").encode())
+                    await resp.write_eof()
+                    return resp
+                ref = handle_.remote(body)
+                result = await loop.run_in_executor(
                     None, lambda: ray_tpu.get(ref, timeout=300))
                 return web.json_response({"result": result})
             except Exception as e:  # noqa: BLE001

@@ -1,12 +1,20 @@
-"""Serve controller: reconciliation + autoscaling control loop.
+"""Serve controller: reconciliation + autoscaling, hosted in an actor.
 
-Reference: the ServeController actor's update loops
-(python/ray/serve/_private/deployment_state.py:2795 — reconcile target vs
-running replicas, recover dead ones) and request-based autoscaling
+Reference: the ServeController ACTOR (_private/controller.py:126) and its
+update loops (deployment_state.py:2795 — reconcile target vs running
+replicas, recover dead ones) and request-based autoscaling
 (serve/autoscaling_policy.py + _private/autoscaling_state.py — desired =
 total ongoing requests / target per replica, clamped with up/downscale
-delays).  One background thread reconciles every deployment; replica-set
-changes are pushed to routers through the long-poll broker.
+delays).
+
+``ServeControllerActor`` runs as a named actor ("SERVE_CONTROLLER" in
+the "serve" namespace): it owns the replica actors, so deployments
+outlive the driver that created them; replica-set snapshots publish
+through the cluster KV (version-bumped, reference: long_poll.py:318
+LongPollHost) and routers in any process — drivers, proxies, workers —
+pull them from there.  Routers push their in-flight counts back
+(report_metrics) to feed autoscaling, mirroring the reference's
+handle-side autoscaling metrics push.
 """
 
 from __future__ import annotations
@@ -15,9 +23,13 @@ import math
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 from .long_poll import LongPollBroker
+
+REPLICA_KV_PREFIX = "serve:replicas:"
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+CONTROLLER_NAMESPACE = "serve"
 
 
 @dataclass
@@ -42,9 +54,42 @@ class ServeController:
         self._stop = threading.Event()
         # Autoscaling decision memory: name -> (direction, since_ts)
         self._pending_scale: Dict[str, tuple] = {}
+        # Router-pushed ongoing-request metrics:
+        # name -> router_id -> (monotonic_ts, total_inflight)
+        # (reference: handle-side autoscaling metrics pushed to the
+        # controller, _private/autoscaling_state.py).
+        self._router_metrics: Dict[str, Dict[str, tuple]] = {}
         self._thread = threading.Thread(target=self._loop,
                                         name="serve-controller", daemon=True)
         self._thread.start()
+
+    def record_metrics(self, name: str, router_id: str,
+                       counts: Dict[str, int]) -> None:
+        """counts: replica actor-id hex -> that router's in-flight."""
+        now = time.monotonic()
+        per_router = self._router_metrics.setdefault(name, {})
+        per_router[router_id] = (now, dict(counts))
+        # Prune long-dead routers so redeploy churn can't grow this
+        # unboundedly (freshness filtering only affects reads).
+        if len(per_router) > 8:
+            for rid in [r for r, (ts, _c) in per_router.items()
+                        if now - ts > 60.0]:
+                per_router.pop(rid, None)
+
+    def _replica_loads(self, state) -> Dict[str, int]:
+        """Aggregated fresh per-replica in-flight across routers."""
+        loads: Dict[str, int] = {}
+        now = time.monotonic()
+        for ts, counts in self._router_metrics.get(
+                state.deployment.name, {}).values():
+            if now - ts < 5.0:
+                for hexid, n in counts.items():
+                    loads[hexid] = loads.get(hexid, 0) + n
+        return loads
+
+    def _ongoing(self, state) -> int:
+        """Total in-flight requests across routers' fresh reports."""
+        return sum(self._replica_loads(state).values())
 
     def stop(self) -> None:
         self._stop.set()
@@ -101,7 +146,7 @@ class ServeController:
             return
         with state._lock:
             n = len(state.replicas)
-            total_inflight = sum(state.inflight.values())
+        total_inflight = self._ongoing(state)
         if n == 0:
             return
         desired = math.ceil(total_inflight / max(cfg.target_ongoing_requests,
@@ -147,13 +192,164 @@ class ServeController:
                 break
             n += 1
         while n > target:
-            state.remove_replica()
+            self._downscale_one(state)
             changed = True
             n -= 1
         if changed:
             self._publish(state)
 
+    def _downscale_one(self, state) -> None:
+        """Remove the least-loaded replica WITH draining: unpublish first
+        (routers stop sending), wait for its reported in-flight to hit
+        zero, then kill (reference: deployment_state drains replicas
+        before stopping them)."""
+        loads = self._replica_loads(state)
+        r = state.pop_replica(min_load=loads)
+        if r is None:
+            return
+        hexid = r._actor_id.hex()
+        self._publish(state)
+
+        def drain():
+            import ray_tpu
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if self._replica_loads(state).get(hexid, 0) <= 0:
+                    # One extra beat: metrics lag the actual completions.
+                    time.sleep(0.5)
+                    if self._replica_loads(state).get(hexid, 0) <= 0:
+                        break
+                time.sleep(0.2)
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        threading.Thread(target=drain, name="serve-drain",
+                         daemon=True).start()
+
     def _publish(self, state) -> None:
         with state._lock:
             snapshot = list(state.replicas)
         self.broker.publish(state.deployment.name, snapshot)
+        # Cross-process push: versioned replica-set snapshot in the
+        # cluster KV (reference: LongPollHost snapshots keyed by
+        # deployment); routers anywhere rebuild handles from actor ids.
+        # The version is monotonic ACROSS redeploys (read-modify-write
+        # against the stored snapshot): a fresh _DeploymentState must not
+        # restart at 1 or remote routers would skip the new set.
+        try:
+            import pickle
+
+            from .._private.api import _control
+            key = REPLICA_KV_PREFIX + state.deployment.name
+            stored = 0
+            try:
+                blob = _control("kv_get", key)
+                if blob is not None:
+                    stored = pickle.loads(blob)[0]
+            except Exception:
+                pass
+            state._version = max(getattr(state, "_version", 0), stored) + 1
+            entries = [(r._actor_id.hex(), state.deployment.name,
+                        state.deployment.max_ongoing_requests)
+                       for r in snapshot]
+            _control("kv_put", key,
+                     pickle.dumps((state._version, entries,
+                                   state.multiplex_cap)))
+        except Exception:
+            pass
+
+
+class ServeControllerActor:
+    """Actor-hosted serve control plane (reference:
+    _private/controller.py:126 ServeController as a detached actor).
+
+    Owns every replica actor: deployments keep serving after the driver
+    that created them exits.  One instance runs cluster-wide as the named
+    actor ``SERVE_CONTROLLER`` (namespace ``serve``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._deployments: Dict[str, Any] = {}
+        self._ctrl = ServeController(self._deployments, self._lock)
+
+    def ping(self) -> str:
+        return "ok"
+
+    def deploy(self, dep_blob: bytes) -> bool:
+        """(Re)deploy from a pickled Deployment; replaces an existing
+        deployment of the same name."""
+        from .._private import serialization
+        from .api import _DeploymentState
+        dep = serialization.loads_control(dep_blob)
+        with self._lock:
+            old = self._deployments.get(dep.name)
+        if old is not None:
+            old.stop()
+        state = _DeploymentState(dep)
+        with self._lock:
+            self._deployments[dep.name] = state
+        state.start()
+        self._ctrl._publish(state)
+        return True
+
+    def stop_deployment(self, name: str) -> bool:
+        with self._lock:
+            state = self._deployments.pop(name, None)
+        if state is None:
+            return False
+        state.stop()
+        self._clear_kv(name)
+        return True
+
+    def shutdown_all(self) -> bool:
+        with self._lock:
+            states = dict(self._deployments)
+            self._deployments.clear()
+        for name, s in states.items():
+            s.stop()
+            self._clear_kv(name)
+        self._ctrl.stop()
+        return True
+
+    @staticmethod
+    def _clear_kv(name: str) -> None:
+        try:
+            from .._private.api import _control
+            _control("kv_del", REPLICA_KV_PREFIX + name)
+        except Exception:
+            pass
+
+    def report_metrics(self, name: str, router_id: str,
+                       counts: Dict[str, int]) -> bool:
+        self._ctrl.record_metrics(name, router_id, counts)
+        return True
+
+    def status(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            states = list(self._deployments.items())
+        out = {}
+        for name, s in states:
+            with s._lock:
+                n = len(s.replicas)
+                target = s.target_replicas
+            out[name] = {
+                "num_replicas": n,
+                "target_replicas": target,
+                "inflight": self._ctrl._replica_loads(s),
+            }
+        return out
+
+    def replica_snapshot(self, name: str):
+        with self._lock:
+            s = self._deployments.get(name)
+        if s is None:
+            return None
+        with s._lock:
+            return [(r._actor_id.hex(), name,
+                     s.deployment.max_ongoing_requests)
+                    for r in s.replicas]
+
+    def list_deployments(self):
+        with self._lock:
+            return list(self._deployments)

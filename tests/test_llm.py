@@ -108,7 +108,16 @@ class TestLLMServing:
         prompt = [3, 17, 92, 5, 41]
         out = ray_tpu.get(h.remote({"prompt_tokens": prompt,
                                     "max_tokens": 6}), timeout=120)
-        assert out["output_tokens"] == naive_greedy(
-            init_params(CFG, jax.random.key(0)), prompt, 6)
+        expected = naive_greedy(init_params(CFG, jax.random.key(0)),
+                                prompt, 6)
+        assert out["output_tokens"] == expected
         assert out["finish_reason"] == "length"
+        # Token streaming: the stream method yields the same tokens one by
+        # one through a streaming actor call (num_returns="streaming").
+        gen = h.options(stream=True, method_name="stream").remote(
+            {"prompt_tokens": prompt, "max_tokens": 6})
+        items = [ray_tpu.get(r, timeout=120) for r in gen]
+        streamed = [it["token"] for it in items if "token" in it]
+        assert streamed == expected
+        assert items[-1]["finish_reason"] == "length"
         serve.shutdown()

@@ -1,0 +1,110 @@
+"""Serve control-plane HA + streaming:
+
+* The controller is an ACTOR owning the replicas (reference:
+  _private/controller.py:126): a deployment created by one driver keeps
+  serving after that driver disconnects — a second driver picks up the
+  handle and calls it.
+* Streaming handles: ``handle.options(stream=True)`` yields items one by
+  one through a streaming actor call; the HTTP ingress exposes the same
+  as chunked ndjson (reference: proxy.py streaming responses).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.test_head_ft import _connect, _start_head
+
+
+class TestDeploymentOutlivesDriver:
+    def test_second_driver_can_call_after_first_exits(self, tmp_path):
+        """Client A deploys through the head's controller actor and
+        disconnects; client B connects and the deployment still serves."""
+        import ray_tpu
+
+        proc, info = _start_head(str(tmp_path), str(tmp_path / "state"))
+        code_a = f"""
+import ray_tpu
+from ray_tpu import serve
+ray_tpu.init(address={info["node_address"]!r},
+             cluster_token={("a" * 32).encode()!r})
+
+@serve.deployment(num_replicas=1)
+class Echo:
+    def __call__(self, x):
+        return {{"echo": x}}
+
+h = serve.run(Echo.bind())
+assert ray_tpu.get(h.remote(7), timeout=120)["echo"] == 7
+print("DEPLOYED-OK", flush=True)
+"""
+        env = dict(os.environ)
+        env.pop("RAY_TPU_CONFIG_BLOB", None)
+        a = subprocess.run([sys.executable, "-c", code_a], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert a.returncode == 0 and "DEPLOYED-OK" in a.stdout, \
+            a.stderr[-2000:]
+        # Driver A is gone.  Driver B (this process) connects and calls.
+        _connect(info)
+        from ray_tpu import serve
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                h = serve.get_deployment_handle("Echo")
+                out = ray_tpu.get(h.remote(41), timeout=60)
+                assert out["echo"] == 41
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+        assert serve.status()["Echo"]["num_replicas"] == 1
+        ray_tpu.shutdown()
+        proc.kill()
+        proc.wait(timeout=15)
+
+
+class TestStreamingServe:
+    def test_handle_stream_yields_items(self, ray_start_isolated):
+        import ray_tpu
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=1)
+        class Tok:
+            def __call__(self, n):
+                for i in range(n):
+                    yield {"token": i * 11}
+
+        h = serve.run(Tok.bind())
+        gen = h.options(stream=True).remote(4)
+        items = [ray_tpu.get(r, timeout=60) for r in gen]
+        assert [it["token"] for it in items] == [0, 11, 22, 33]
+        serve.shutdown()
+
+    def test_http_chunked_stream(self, ray_start_isolated):
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=1)
+        class Tok:
+            def __call__(self, body):
+                for i in range(int(body.get("n", 3))):
+                    yield {"token": i}
+
+        serve.run(Tok.bind(), http_port=18231)
+        import urllib.request
+        req = urllib.request.Request(
+            "http://127.0.0.1:18231/Tok",
+            data=json.dumps({"n": 3, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["Content-Type"] == "application/x-ndjson"
+            lines = [json.loads(l) for l in resp.read().splitlines() if l]
+        assert [l["result"]["token"] for l in lines] == [0, 1, 2]
+        serve.shutdown()
