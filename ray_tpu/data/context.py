@@ -24,6 +24,12 @@ class DataContext:
         self.max_in_flight: int = 32
         # Window used before any block size has been observed.
         self.initial_in_flight: int = 8
+        # Whether streaming iteration yields blocks in plan order.  False
+        # (the reference's ExecutionOptions.preserve_order default) lets
+        # iter_batches surface whichever block finishes first, so one slow
+        # task never head-of-line-blocks the consumer.  take()/execute()
+        # always preserve order regardless.
+        self.preserve_order: bool = False
 
     @classmethod
     def get(cls) -> "DataContext":

@@ -136,10 +136,14 @@ def execute(ds) -> List[Any]:
     return list(execute_streaming(ds))
 
 
-def execute_streaming(ds) -> Iterator[Any]:
+def execute_streaming(ds, ordered: bool = True) -> Iterator[Any]:
     """Generator of output blocks/refs: map stages stream block-by-block
     (a consumer can iterate results while later blocks still compute);
-    all-to-all stages are task-level shuffles whose outputs stream too."""
+    all-to-all stages are task-level shuffles whose outputs stream too.
+
+    ``ordered=False`` (iteration paths, when DataContext.preserve_order is
+    off) yields whichever block completes first so a slow task never
+    head-of-line-blocks the consumer."""
     blocks: List[Any] = list(ds._source)
     stages = list(ds._stages)
     while stages:
@@ -152,7 +156,7 @@ def execute_streaming(ds) -> Iterator[Any]:
             barrier = stages.pop(0)
             blocks = _run_shuffle(blocks, fused, barrier)
         elif fused or _has_read_markers(blocks):
-            yield from _stream_fused(blocks, fused)
+            yield from _stream_fused(blocks, fused, ordered=ordered)
             return
         else:
             break
@@ -164,9 +168,12 @@ def _has_read_markers(blocks: List[Any]) -> bool:
                for b in blocks)
 
 
-def _stream_fused(blocks: List[Any], fns: List[Callable]) -> Iterator[Any]:
-    """Submit fused block tasks with a bounded window, yielding refs in
-    order as they complete — consumption overlaps production."""
+def _stream_fused(blocks: List[Any], fns: List[Callable],
+                  ordered: bool = True) -> Iterator[Any]:
+    """Submit fused block tasks with a bounded window, yielding refs as
+    they complete — consumption overlaps production.  ``ordered=False``
+    yields first-completed (reference: streaming_executor.py:423 dispatches
+    eagerly; preserve_order=False is the execution-options default)."""
     import ray_tpu
     if not ray_tpu.is_initialized():
         for b in blocks:
@@ -181,9 +188,17 @@ def _stream_fused(blocks: List[Any], fns: List[Callable]) -> Iterator[Any]:
         while idx < len(blocks) and len(pending) < bp.window():
             pending.append(apply_remote.remote(fns, blocks[idx]))
             idx += 1
-        ray_tpu.wait([pending[0]], num_returns=1, timeout=600)
-        bp.note_block(pending[0])
-        yield pending.pop(0)
+        if ordered:
+            ray_tpu.wait([pending[0]], num_returns=1, timeout=600)
+            done = pending.pop(0)
+        else:
+            ready, _ = ray_tpu.wait(pending, num_returns=1, timeout=600)
+            # On wait timeout fall back to the oldest task; the consumer's
+            # fetch() blocks on it just like the ordered path would.
+            done = ready[0] if ready else pending[0]
+            pending.remove(done)
+        bp.note_block(done)
+        yield done
 
 
 def _run_shuffle(blocks: List[Any], fused: List[Callable], stage

@@ -21,7 +21,10 @@ def iter_batches(ds, *, batch_size: int = 256, drop_last: bool = False,
     carry: Optional[Block] = None
     rng = (np.random.default_rng(shuffle_seed)
            if shuffle_seed is not None else None)
-    for block in map(_maybe_shuffle(rng), _blocks_of(ds)):
+    # A seeded shuffle must consume blocks in plan order to be
+    # reproducible; otherwise first-completed order is fine (and faster).
+    for block in map(_maybe_shuffle(rng),
+                     _blocks_of(ds, force_ordered=rng is not None)):
         if carry is not None and BlockAccessor(carry).num_rows():
             block = BlockAccessor.concat([carry, block])
             carry = None
@@ -37,11 +40,15 @@ def iter_batches(ds, *, batch_size: int = 256, drop_last: bool = False,
         yield carry
 
 
-def _blocks_of(ds):
+def _blocks_of(ds, force_ordered: bool = False):
     # Streaming execution: batches can be consumed while later blocks are
     # still being produced by worker tasks (produce/consume overlap).
+    # Unless preserve_order is set, yield first-completed so one slow
+    # block task never delays the first batch.
+    from .context import DataContext
     from .executor import execute_streaming, fetch
-    for b in execute_streaming(ds):
+    ordered = force_ordered or DataContext.get().preserve_order
+    for b in execute_streaming(ds, ordered=ordered):
         yield fetch(b)
 
 

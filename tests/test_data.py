@@ -152,6 +152,10 @@ class TestDistributedShuffle:
             _t.sleep(0.4)
             return block
 
+        # Warm the worker pool so timings measure pipeline overlap, not
+        # process spin-up.
+        data.range(8, parallelism=8).map_batches(lambda b: b).take_all()
+
         ds = data.range(800, parallelism=8).map_batches(slow)
         t0 = _t.monotonic()
         it = ds.iter_batches(batch_size=100)
@@ -162,6 +166,10 @@ class TestDistributedShuffle:
         assert len(first["id"]) == 100
         # First batch arrives well before the full pipeline drains.
         assert t_first < t_all * 0.8, (t_first, t_all)
+        # And within ~2x one task's duration: iter_batches yields the
+        # first *completed* block (preserve_order=False default), so one
+        # slow/late task cannot head-of-line-block the consumer.
+        assert t_first < 2 * 0.4 + 0.4, (t_first, t_all)
 
     def test_shuffle_after_map_fuses(self, ray_start):
         ds = (data.range(500, parallelism=4)
