@@ -6,9 +6,9 @@ two extra programs, both jit-compiled with static shapes:
 - ``prefill``: run a (padded) prompt through the model, returning the last
   valid position's logits and the per-layer K/V to seed the cache.
 - ``decode_step``: one token per active slot, attending over the paged
-  cache via block tables — the jnp gather path is exact and runs anywhere;
-  on TPU the same layout feeds the pallas paged-attention kernel
-  (jax.experimental.pallas.ops.tpu.paged_attention).
+  cache via block tables through ops/paged_attention.py — the pallas
+  block-table kernel on TPU (page-granular DMA, no full-KV gather), the
+  exact jnp path elsewhere.
 
 Weights are the training pytree unchanged (init_params layout), so a
 trained checkpoint serves directly.
@@ -16,8 +16,6 @@ trained checkpoint serves directly.
 
 from __future__ import annotations
 
-import math
-from functools import partial
 from typing import Any, Dict, Tuple
 
 import jax
@@ -25,9 +23,8 @@ import jax.numpy as jnp
 
 from ..models.llama import LlamaConfig
 from ..ops.norms import rms_norm
+from ..ops.paged_attention import paged_decode_attention
 from ..ops.rope import rope_frequencies
-
-NEG_INF = -1e30
 
 
 def _rope_batched(x, cos, sin, positions):
@@ -94,33 +91,6 @@ def prefill(params: Dict[str, Any], tokens: jax.Array, length: jax.Array,
     return logits, ks, vs
 
 
-def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens,
-                           page_size: int):
-    """Exact jnp paged attention for one decode step.
-
-    q: [B, H, D]; k_pages/v_pages: [Hkv, NP, page, D];
-    block_table: [B, P]; seq_lens: [B] (length INCLUDING the new token).
-    """
-    B, H, D = q.shape
-    Hkv = k_pages.shape[0]
-    P = block_table.shape[1]
-    group = H // Hkv
-    # Gather each sequence's pages: [B, Hkv, P, page, D] -> [B, Hkv, S_max, D]
-    k = jnp.take(k_pages, block_table, axis=1)   # [Hkv, B, P, page, D]
-    v = jnp.take(v_pages, block_table, axis=1)
-    k = k.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, P * page_size, D)
-    v = v.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, P * page_size, D)
-    if group > 1:
-        k = jnp.repeat(k, group, axis=1)
-        v = jnp.repeat(v, group, axis=1)
-    scores = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) / math.sqrt(D)
-    kv_pos = jnp.arange(P * page_size)
-    mask = kv_pos[None, :] < seq_lens[:, None]          # [B, S_max]
-    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhk,bhkd->bhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
 
 
 def decode_step(params: Dict[str, Any], k_pages, v_pages,

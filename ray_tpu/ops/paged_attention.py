@@ -1,0 +1,93 @@
+"""Paged decode attention over a block-table KV cache.
+
+The serving engine (llm/engine.py) keeps K/V in fixed-size pages,
+``[num_kv_heads, total_pages, page_size, head_dim]`` per layer, with a
+per-slot block table mapping sequence positions to pages.  One decode
+step attends each slot's single query token over its pages.
+
+Two execution paths, chosen statically at trace time:
+
+- TPU: the pallas paged-attention kernel
+  (jax.experimental.pallas.ops.tpu.paged_attention) — block-table-indexed
+  async DMA of pages into VMEM with online softmax, so HBM traffic per
+  step is the *live* KV only.  This is the kernel the reference's serving
+  stack reaches through vLLM's PagedAttention CUDA ops
+  (reference: python/ray/llm/_internal/serve/engines/vllm/); here the
+  TPU-native analog is a pallas kernel over the same page layout.
+- elsewhere (CPU tests): an exact jnp path that gathers pages and does
+  dense masked attention — numerically the spec for the kernel.
+
+Capability parity: reference vLLM engine's paged KV decode
+(python/ray/llm/_internal/serve/engines/vllm/vllm_engine.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens,
+                           page_size: int, *,
+                           pages_per_compute_block: int = 8):
+    """One decode step of attention over the paged cache.
+
+    q: [B, H, D] (one new token per slot); k_pages/v_pages:
+    [Hkv, NP, page, D]; block_table: [B, P] page ids; seq_lens: [B]
+    sequence length INCLUDING the new token.  Returns [B, H, D].
+    """
+    from .attention import _on_tpu
+    if _on_tpu():
+        return _pallas_path(q, k_pages, v_pages, block_table, seq_lens,
+                            page_size, pages_per_compute_block)
+    return _exact_path(q, k_pages, v_pages, block_table, seq_lens, page_size)
+
+
+def _pallas_path(q, k_pages, v_pages, block_table, seq_lens, page_size: int,
+                 pages_per_compute_block: int):
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention)
+
+    D = q.shape[-1]
+    P = block_table.shape[1]
+    # The kernel applies no softmax scale; fold 1/sqrt(D) into q.
+    q_scaled = (q.astype(jnp.float32) / math.sqrt(D)).astype(q.dtype)
+    block = min(pages_per_compute_block, P)
+    while P % block:
+        block -= 1
+    out = paged_attention(
+        q_scaled, k_pages, v_pages,
+        lengths=seq_lens.astype(jnp.int32),
+        page_indices=block_table.astype(jnp.int32),
+        pages_per_compute_block=block,
+    )
+    return out.astype(q.dtype)
+
+
+def _exact_path(q, k_pages, v_pages, block_table, seq_lens, page_size: int):
+    """Reference semantics: gather each sequence's pages and run dense
+    masked attention.  Materializes [B, H, S_max, D] — fine for CPU tests,
+    never the TPU path."""
+    B, H, D = q.shape
+    Hkv = k_pages.shape[0]
+    P = block_table.shape[1]
+    group = H // Hkv
+    k = jnp.take(k_pages, block_table, axis=1)   # [Hkv, B, P, page, D]
+    v = jnp.take(v_pages, block_table, axis=1)
+    k = k.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, P * page_size, D)
+    v = v.transpose(1, 0, 2, 3, 4).reshape(B, Hkv, P * page_size, D)
+    if group > 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    scores = jnp.einsum("bhd,bhkd->bhk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(D)
+    kv_pos = jnp.arange(P * page_size)
+    mask = kv_pos[None, :] < seq_lens[:, None]          # [B, S_max]
+    scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhk,bhkd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
