@@ -46,6 +46,66 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import pytest  # noqa: E402
 
+# -- test tiers (reference pattern: bazel size/tags partitioning,
+# python/ray/tests/BUILD.bazel) --------------------------------------------
+#
+# ``pytest -m quick`` is the fast CI tier: every subsystem represented,
+# compile-heavy jax modules excluded except for hand-picked cheap
+# representatives.  The full suite (no -m) is unchanged.
+
+_SLOW_MODULES = {
+    "test_7b_shapes", "test_models", "test_ops", "test_pipeline",
+    "test_llm", "test_rl", "test_rl_breadth", "test_train",
+    "test_train_elastic", "test_collective", "test_dag", "test_tune",
+    "test_chaos", "test_recovery", "test_oom", "test_serve_ha",
+    "test_runtime_env", "test_autoscaler", "test_head_ft",
+}
+
+# Fast representatives inside slow modules so the quick tier still touches
+# every subsystem (node ids are matched by substring).
+_QUICK_IN_SLOW = {
+    "test_models": ("test_num_params_matches",
+                    "test_logical_axes_tree_matches_params"),
+    "test_ops": ("TestRmsNorm", "TestRope", "TestMeshSharding",
+                 "test_routing_topk"),
+    "test_llm": ("test_stop_tokens",),
+    "test_rl": ("TestBuffers", "TestGAE"),
+    "test_pipeline": ("test_pp_requires_mesh",),
+    "test_tune": ("test_variant_expansion", "test_schedulers_unit",
+                  "test_concurrency_limiter"),
+    "test_collective": ("TestKVBackend::test_all_ops",),
+    "test_dag": ("TestShmChannel::test_roundtrip", "test_chain"),
+    "test_train": ("test_single_worker_e2e",),
+    "test_recovery": ("test_put_refs_freed_on_drop",
+                      "test_reconstruct_lost_object_on_get"),
+    "test_oom": ("TestPolicy",),
+    "test_autoscaler": ("test_demand_driven_scale_up",),
+    "test_head_ft": ("test_wal_snapshot_roundtrip",
+                     "test_torn_tail_is_ignored"),
+    "test_runtime_env": ("test_working_dir_ships_files", "test_endpoints"),
+    "test_chaos": ("test_workload_correct_under_message_delays",),
+    "test_serve_ha": (),
+    "test_7b_shapes": (),
+    "test_rl_breadth": (),
+    "test_train_elastic": (),
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = os.path.basename(item.nodeid.split("::", 1)[0])
+        mod = mod[:-3] if mod.endswith(".py") else mod
+        if item.get_closest_marker("slow") is not None:
+            continue  # source-level @pytest.mark.slow wins
+        if mod in _SLOW_MODULES:
+            picks = _QUICK_IN_SLOW.get(mod, ())
+            if any(p in item.nodeid for p in picks):
+                item.add_marker(pytest.mark.quick)
+            else:
+                item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.quick)
+
 
 @pytest.fixture(scope="module")
 def ray_start():
