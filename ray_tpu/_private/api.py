@@ -337,18 +337,37 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
     """Shared submit path for actor methods and __ray_call__ applies.
     ``num_returns="streaming"`` runs a generator method: yielded items
     publish one-by-one and the caller gets an ObjectRefGenerator
-    (reference: streaming actor calls via ObjectRefStream)."""
+    (reference: streaming actor calls via ObjectRefStream).
+
+    Plain method calls with inline args take the direct fast path
+    (reference: actor_task_submitter.h:68 caller->actor push): the frame
+    goes straight to the bound worker, skipping spec/events/scheduling."""
     rt = _require_runtime()
     streaming = num_returns == "streaming"
     task_id = TaskID.of(handle._actor_id)
     return_ids = [] if streaming else [
         ObjectID.of(task_id, i) for i in range(num_returns)]
+    arg_descs = [_pack_arg(a) for a in args]
+    kwarg_descs = {k: _pack_arg(v) for k, v in kwargs.items()}
+    if (not streaming and method_name is not None
+            and not (_tracing._enabled or _tracing.current() is not None)
+            and isinstance(rt, _rtmod.Runtime)
+            and all(d[0] == "val" for d in arg_descs)
+            and all(d[0] == "val" for d in kwarg_descs.values())):
+        if rt.submit_actor_direct(
+                handle._actor_id, task_id,
+                f"{handle._class_name}.{method_name}", method_name,
+                return_ids,
+                [("inline", p) for _t, p in arg_descs],
+                {k: ("inline", p) for k, (_t, p) in kwarg_descs.items()},
+                handle._max_concurrency):
+            refs = [ObjectRef(oid) for oid in return_ids]
+            return refs[0] if num_returns == 1 else refs
     spec = TaskSpec(
         task_id=task_id,
         name=f"{handle._class_name}.{method_name or '__ray_call__'}",
         fn_blob=fn_blob, method_name=method_name,
-        arg_descs=[_pack_arg(a) for a in args],
-        kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
+        arg_descs=arg_descs, kwarg_descs=kwarg_descs,
         return_ids=return_ids, resources=ResourceSet(),
         actor_id=handle._actor_id,
         max_concurrency=handle._max_concurrency,

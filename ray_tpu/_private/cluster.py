@@ -862,6 +862,11 @@ class _NodeServerRuntime:
         msg.results = [(oid, tag_desc(d, nid)) for oid, d in msg.results]
         self._server.send_up(UpTaskDone(msg))
 
+    def on_direct_task_done(self, t: tuple) -> bool:
+        # Direct actor calls are local-node-only (see submit_actor_direct);
+        # everything arriving here takes the full TaskDone path.
+        return False
+
     def on_dispatch_failed(self, spec, reason: str,
                            lost_object_bytes=None) -> None:
         self._server.send_up(UpDispatchFailed(spec, reason,

@@ -77,6 +77,10 @@ class WorkerHandle:
     running: Set[TaskID] = field(default_factory=set)
     # task_id -> (start_monotonic, retriable) for the OOM kill policy.
     task_meta: Dict[TaskID, Any] = field(default_factory=dict)
+    # Direct actor calls in flight (no running/task_meta entries): count +
+    # oldest-start, enough for the OOM victim policy to see the worker.
+    direct_inflight: int = 0
+    direct_since: float = 0.0
     reader: Optional[threading.Thread] = None
     ready: threading.Event = field(default_factory=threading.Event)
     send_lock: threading.Lock = field(default_factory=threading.Lock)
@@ -856,6 +860,20 @@ class NodeManager:
         self._outbox.append((handle, msg))
         self._out_ev.set()
 
+    def send_direct(self, worker_id: WorkerID, frame: tuple) -> bool:
+        """Ship a pre-encoded direct-call frame to a bound actor worker.
+        Returns False if the worker is unknown/dead (caller fails the
+        refs)."""
+        with self._lock:
+            handle = self._workers.get(worker_id)
+            if handle is None or handle.state == DEAD:
+                return False
+            if handle.direct_inflight == 0:
+                handle.direct_since = time.monotonic()
+            handle.direct_inflight += 1
+        self._send(handle, frame)
+        return True
+
     def send_to_worker(self, worker_id: WorkerID, msg) -> None:
         with self._lock:
             handle = self._workers.get(worker_id)
@@ -868,6 +886,13 @@ class NodeManager:
         rt = self.runtime
         if type(msg) is tuple:
             if msg[0] == wire.TASK_DONE:
+                # Direct actor calls (runtime.submit_actor_direct) never
+                # entered running/pin bookkeeping: route their replies
+                # straight to the caller-held refs.
+                if rt.on_direct_task_done(msg):
+                    if handle.direct_inflight > 0:
+                        handle.direct_inflight -= 1
+                    return
                 self._handle_msg(handle, wire.decode_task_done(msg))
                 return
             raise ValueError(f"unknown wire frame tag {msg[0]!r}")
@@ -1000,15 +1025,18 @@ class NodeManager:
                         return h
             candidates = []
             for h in self._workers.values():
-                if h.state != BUSY or not h.running:
+                if h.state != BUSY or not (h.running or h.direct_inflight):
                     continue
                 metas = [h.task_meta.get(t) for t in h.running]
                 metas = [m for m in metas if m is not None]
-                if not metas:
+                if not metas and not h.direct_inflight:
                     continue
-                retriable = all(m[1] for m in metas) and h.actor_id is None
-                earliest = min(m[0] for m in metas)
-                candidates.append((h, retriable, earliest))
+                retriable = bool(metas) and all(m[1] for m in metas) \
+                    and h.actor_id is None
+                starts = [m[0] for m in metas]
+                if h.direct_inflight:
+                    starts.append(h.direct_since)
+                candidates.append((h, retriable, min(starts)))
         return select_victim(candidates)
 
     def oom_kill_worker(self, handle: WorkerHandle, reason: str) -> None:

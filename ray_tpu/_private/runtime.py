@@ -275,6 +275,12 @@ class Runtime:
         self._node_views_lock = threading.Lock()
         self._actors: Dict[ActorID, _ActorRuntimeState] = {}
         self._actors_lock = threading.Lock()
+        # Direct actor calls in flight (fast path, see submit_actor_direct):
+        # task_id bytes -> (actor_id, return_ids, call_name).  These tasks
+        # bypass the running table / events / scheduler entirely.
+        self._direct_lock = threading.Lock()
+        self._direct_inflight: Dict[
+            bytes, Tuple[ActorID, List[ObjectID], str]] = {}
         self._put_index = 0
         self._put_lock = threading.Lock()
         self._shutdown = False
@@ -1184,6 +1190,104 @@ class Runtime:
                 self._running[spec.task_id] = _RunningTask(spec, node_id)
             node.dispatch_actor_task(spec, args, kwargs, worker_id)
 
+    def submit_actor_direct(self, actor_id: ActorID, task_id: TaskID,
+                            name: str, method_name: str,
+                            return_ids: List[ObjectID], args: list,
+                            kwargs: dict, max_concurrency: int) -> bool:
+        """Fast-path actor method call (reference: the direct caller->actor
+        submission stream, actor_task_submitter.h:68 — the driver pushes
+        the call straight onto the actor worker's connection).
+
+        Skips TaskSpec construction, task events, the running table and
+        on_task_done: the call frame goes directly to the bound worker and
+        the reply is routed by ``on_direct_task_done`` via
+        ``_direct_inflight``.  Falls back (returns False) whenever ordering
+        or placement needs the full path: worker unbound/restarting, queued
+        calls ahead (per-caller submission order must hold), remote actor
+        node, or a cluster data plane whose dispatches ride the transfer
+        queue.  Actor method results are not lineage-reconstructable either
+        way, so skipping lineage loses nothing."""
+        from . import wire as _wire
+        ast = self._actor_state(actor_id)
+        tb = task_id.binary()
+        with ast.lock:
+            if (ast.worker_id is None or ast.pending_bind
+                    or ast.next_dispatch != ast.next_seq):
+                return False
+            node = self.nodes.get(ast.node_id)
+            if node is None or getattr(node, "is_remote", False) \
+                    or self._xfer_q is not None:
+                return False
+            # Claim the sequence slot and ship while still holding
+            # ast.lock so a concurrently submitted call claiming seq N+1
+            # cannot reach the worker pipe before this frame (seq N).
+            ast.next_seq += 1
+            ast.next_dispatch += 1
+            if self._gc_enabled:
+                # Pending states must exist before a ref drop can arrive
+                # (see submit_spec's pre-create note).
+                self._states(return_ids)
+            with self._direct_lock:
+                self._direct_inflight[tb] = (actor_id, return_ids, name)
+            frame = (_wire.RUN_TASK, tb, name, None, None, method_name,
+                     tuple(r.binary() for r in return_ids),
+                     actor_id.binary(), False, max_concurrency, None,
+                     args, kwargs, None)
+            sent = node.send_direct(ast.worker_id, frame)
+        if not sent:
+            with self._direct_lock:
+                self._direct_inflight.pop(tb, None)
+            desc = ("err", serialization.pack_payload(ActorError(
+                actor_id, "actor worker died before the call was sent")))
+            for oid in return_ids:
+                self.mark_ready(oid, desc)
+        return True
+
+    def on_direct_task_done(self, t: tuple) -> bool:
+        """Route a wire TaskDone for a direct call (pre-decode): mark the
+        caller-held return refs ready.  Returns False for non-direct tasks
+        so the node runs the full TaskDone path."""
+        with self._direct_lock:
+            entry = self._direct_inflight.pop(t[1], None)
+        if entry is None:
+            return False
+        aid, return_ids, name = entry
+        error = t[4]
+        # One terminal event per direct call keeps the state API's task
+        # view complete; the intermediate states are intentionally skipped
+        # on this path.
+        if error is not None:
+            err_repr = None
+            try:
+                err_repr = repr(serialization.unpack_payload(error[1]))
+            except Exception:
+                pass
+            self.events.record(TaskID(t[1]).hex(), FAILED, name=name,
+                               task_type="ACTOR_TASK", actor_id=aid.hex(),
+                               error_message=err_repr)
+            for oid in return_ids:
+                self.mark_ready(oid, error)
+            return True
+        self.events.record(TaskID(t[1]).hex(), FINISHED, name=name,
+                           task_type="ACTOR_TASK", actor_id=aid.hex())
+        for ob, desc in t[3]:
+            self.mark_ready(ObjectID(ob), desc)
+        return True
+
+    def _fail_direct_inflight(self, actor_id: ActorID, reason: str) -> None:
+        with self._direct_lock:
+            failed = [(tb, rids) for tb, (aid, rids, _name)
+                      in self._direct_inflight.items() if aid == actor_id]
+            for tb, _ in failed:
+                self._direct_inflight.pop(tb, None)
+        if not failed:
+            return
+        desc = ("err", serialization.pack_payload(
+            ActorError(actor_id, reason)))
+        for _tb, rids in failed:
+            for oid in rids:
+                self.mark_ready(oid, desc)
+
     def bind_actor_worker(self, actor_id: ActorID, node_id: NodeID,
                           worker_id: WorkerID) -> None:
         ast = self._actor_state(actor_id)
@@ -1328,6 +1432,10 @@ class Runtime:
             task_id = TaskID(task_id_bytes)
         except ValueError:
             return
+        # A direct call whose frame never serialized: clear its in-flight
+        # entry so long-lived actors don't accumulate dead records.
+        with self._direct_lock:
+            self._direct_inflight.pop(task_id_bytes, None)
         with self._running_lock:
             running = self._running.pop(task_id, None)
         if running is not None:
@@ -1415,6 +1523,9 @@ class Runtime:
                     f"worker {worker_id} died while running {spec.name}"
                     + (f" ({reason})" if reason else "")))
         if actor_id is not None:
+            self._fail_direct_inflight(
+                actor_id, "worker died while running a direct actor call"
+                + (f" ({reason})" if reason else ""))
             self._on_actor_worker_death(actor_id, node_id)
 
     def _on_actor_worker_death(self, actor_id: ActorID, node_id: NodeID) -> None:
