@@ -11,6 +11,7 @@ the driver or over the worker pipe inside tasks.
 
 from __future__ import annotations
 
+import contextvars
 import functools
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
@@ -98,10 +99,18 @@ class ObjectRef:
 
     def __init__(self, object_id: ObjectID):
         self._id = object_id
+        wr = _rtmod._worker_runtime
         rt = _rtmod._global_runtime
-        self._owned = rt is not None and _rtmod._worker_runtime is None
+        self._owned = rt is not None and wr is None
         if self._owned:
             rt.add_local_ref(object_id)
+        elif wr is not None:
+            # Worker-local direct-call results are ref-counted in the
+            # worker's local table, and refs unpickled out of task args
+            # register as borrows (no-op for client runtimes).
+            note = getattr(wr, "note_new_ref", None)
+            if note is not None:
+                note(self)
 
     def __del__(self):
         # May run at arbitrary GC points: only a lock-free enqueue here
@@ -111,6 +120,14 @@ class ObjectRef:
             if rt is not None:
                 try:
                     rt.enqueue_ref_drop(self._id)
+                except Exception:
+                    pass
+        else:
+            wr = _rtmod._worker_runtime
+            drop = getattr(wr, "drop_local", None) if wr is not None else None
+            if drop is not None:
+                try:
+                    drop(self._id.binary())
                 except Exception:
                     pass
 
@@ -124,10 +141,29 @@ class ObjectRef:
         return self._id.binary()
 
     def __reduce__(self):
+        collector = _nested_collector.get()
         if getattr(self, "_owned", False):
-            rt = _rtmod._global_runtime
-            if rt is not None:
-                rt.mark_escaped(self._id)
+            if collector is not None:
+                # Pickling into task args: a tracked borrow (retained
+                # until the task completes), not an escaped-forever pin.
+                collector.append(self._id)
+            else:
+                rt = _rtmod._global_runtime
+                if rt is not None:
+                    rt.mark_escaped(self._id)
+        else:
+            wr = _rtmod._worker_runtime
+            promote = getattr(wr, "promote_local", None) \
+                if wr is not None else None
+            if promote is not None:
+                # A worker-local direct result leaving this process must
+                # register with the head regardless of borrow tracking.
+                try:
+                    promote(self._id)
+                except Exception:
+                    pass
+            if collector is not None:
+                collector.append(self._id)
         return (ObjectRef, (self._id,))
 
     def __eq__(self, other):
@@ -194,11 +230,25 @@ def _prepare_env(runtime_env):
     return out
 
 
-def _pack_arg(value: Any):
+# Active nested-ref collector: while packing task args, ObjectRefs pickled
+# inside argument values land here (borrow tracking) instead of being
+# marked escaped-forever (reference: reference_counter.h:44 borrows).
+_nested_collector: "contextvars.ContextVar[Optional[list]]" = \
+    contextvars.ContextVar("nested_ref_collector", default=None)
+
+
+def _pack_arg(value: Any, collect_nested: Optional[list] = None):
     """Convert one call argument into a TaskSpec descriptor."""
     if isinstance(value, ObjectRef):
         return ("ref", value.id())
-    payload = serialization.pack_payload(value)
+    if collect_nested is None:
+        payload = serialization.pack_payload(value)
+    else:
+        token = _nested_collector.set(collect_nested)
+        try:
+            payload = serialization.pack_payload(value)
+        finally:
+            _nested_collector.reset(token)
     if len(payload) > Config.get("max_inline_object_size"):
         # Large argument: promote to an object so it travels via shm once.
         return ("ref", _put_value(value))
@@ -285,12 +335,15 @@ class RemoteFunction:
         resources = task_resources(opts.get("num_cpus"), opts.get("num_tpus"),
                                    opts.get("memory"), opts.get("resources"),
                                    default_num_cpus=1.0)
+        nested: List[ObjectID] = []
         spec = TaskSpec(
             task_id=task_id,
             name=opts.get("name") or self._fn.__name__,
             fn_blob=self._fn_blob, method_name=None,
-            arg_descs=[_pack_arg(a) for a in args],
-            kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
+            arg_descs=[_pack_arg(a, nested) for a in args],
+            kwarg_descs={k: _pack_arg(v, nested)
+                         for k, v in kwargs.items()},
+            nested_refs=tuple(nested),
             return_ids=return_ids, resources=resources,
             max_retries=0 if streaming else opts.get(
                 "max_retries", Config.get("task_max_retries_default")),
@@ -347,10 +400,12 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
     task_id = TaskID.of(handle._actor_id)
     return_ids = [] if streaming else [
         ObjectID.of(task_id, i) for i in range(num_returns)]
-    arg_descs = [_pack_arg(a) for a in args]
-    kwarg_descs = {k: _pack_arg(v) for k, v in kwargs.items()}
-    if (not streaming and method_name is not None
-            and not (_tracing._enabled or _tracing.current() is not None)
+    nested: List[ObjectID] = []
+    arg_descs = [_pack_arg(a, nested) for a in args]
+    kwarg_descs = {k: _pack_arg(v, nested) for k, v in kwargs.items()}
+    tracing_on = _tracing._enabled or _tracing.current() is not None
+    if (not streaming and method_name is not None and not tracing_on
+            and not nested
             and isinstance(rt, _rtmod.Runtime)
             and all(d[0] == "val" for d in arg_descs)
             and all(d[0] == "val" for d in kwarg_descs.values())):
@@ -363,11 +418,36 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
                 handle._max_concurrency):
             refs = [ObjectRef(oid) for oid in return_ids]
             return refs[0] if num_returns == 1 else refs
+    elif ((method_name is not None or fn_blob is not None)
+          and not tracing_on and not nested
+          and all(d[0] == "val" for d in arg_descs)
+          and all(d[0] == "val" for d in kwarg_descs.values())
+          and _rtmod._worker_runtime is not None
+          and rt is _rtmod._worker_runtime
+          and hasattr(rt, "submit_actor_direct")):
+        # Worker caller: push over this process's direct channel to the
+        # actor's worker (direct.py) — the head never sees the call.
+        # Ref args fall back to the classic path: only the head's
+        # dep-retention keeps the argument objects alive for the task's
+        # lifetime (reference: task-arg pinning in reference_counter.h).
+        wire_args = [("inline", p) for _t, p in arg_descs]
+        wire_kwargs = {k: ("inline", p)
+                       for k, (_t, p) in kwarg_descs.items()}
+        if rt.submit_actor_direct(
+                handle._actor_id, task_id,
+                f"{handle._class_name}.{method_name or '__ray_call__'}",
+                method_name, return_ids, wire_args, wire_kwargs,
+                handle._max_concurrency, streaming, fn_blob=fn_blob):
+            if streaming:
+                return ObjectRefGenerator(task_id)
+            refs = [ObjectRef(oid) for oid in return_ids]
+            return refs[0] if num_returns == 1 else refs
     spec = TaskSpec(
         task_id=task_id,
         name=f"{handle._class_name}.{method_name or '__ray_call__'}",
         fn_blob=fn_blob, method_name=method_name,
         arg_descs=arg_descs, kwarg_descs=kwarg_descs,
+        nested_refs=tuple(nested),
         return_ids=return_ids, resources=ResourceSet(),
         actor_id=handle._actor_id,
         max_concurrency=handle._max_concurrency,
@@ -460,12 +540,15 @@ class ActorClass:
         resources = task_resources(opts.get("num_cpus"), opts.get("num_tpus"),
                                    opts.get("memory"), opts.get("resources"),
                                    default_num_cpus=0.0)
+        nested: List[ObjectID] = []
         spec = TaskSpec(
             task_id=TaskID.of(actor_id),
             name=f"{self._cls.__name__}.__init__",
             fn_blob=self._cls_blob, method_name=None,
-            arg_descs=[_pack_arg(a) for a in args],
-            kwarg_descs={k: _pack_arg(v) for k, v in kwargs.items()},
+            arg_descs=[_pack_arg(a, nested) for a in args],
+            kwarg_descs={k: _pack_arg(v, nested)
+                         for k, v in kwargs.items()},
+            nested_refs=tuple(nested),
             return_ids=[], resources=resources,
             create_actor_id=actor_id,
             placement_group=pg, bundle_index=bundle,

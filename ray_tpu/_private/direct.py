@@ -1,0 +1,311 @@
+"""Worker-to-worker direct actor-call channels.
+
+The reference's core worker pushes actor tasks caller->executor over a
+persistent per-worker gRPC stream once the GCS has resolved the actor's
+address (reference: src/ray/core_worker/task_submission/
+actor_task_submitter.h:68 PushActorTask; normal path
+normal_task_submitter.cc:516).  Here every worker process runs a small
+authenticated listener (``DirectServer``); a caller worker resolves the
+actor's address once through the head (``resolve_actor_direct``) and then
+pushes wire RUN_TASK frames straight to the actor's worker, getting wire
+TASK_DONE frames back on the same connection — the head sees none of it.
+
+Ordering: all of one caller's calls to a given actor ride one FIFO
+connection, and the callee enqueues frames to its executor in arrival
+order, preserving per-caller submission order (the guarantee the
+sequenced driver path provides).  A caller picks direct vs classic mode
+per actor at first use and sticks to it, so the two paths never
+interleave for the same (caller, actor) pair.
+
+Results: inline result descriptors complete locally at the caller (it
+owns the refs; the head learns about them only if they escape —
+``WorkerRuntime.promote_local``).  Non-inline (shm/arena) results and
+streaming calls are ALSO reported upstream as a normal TaskDone so the
+head registers/pins them; the caller then resolves via the classic get
+path.  Failure: a broken connection fails in-flight calls with
+ActorError and the channel re-resolves (actor restart) with calls
+buffered in order meanwhile.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import wire
+
+# Control frame: callee -> caller when the full TaskDone went upstream
+# instead (non-inline results): caller resolves via the classic get path.
+DIRECT_UPSTREAM = "du"
+
+
+class DirectServer:
+    """Per-worker listener executing pushed actor-call frames.
+
+    Frames arrive as wire RUN_TASK tuples (or lists of them); replies are
+    wire TASK_DONE tuples on the same connection.  Execution shares the
+    worker's task executor, so per-actor ordering and max_concurrency
+    behave exactly as for node-dispatched calls.
+    """
+
+    def __init__(self, loop, token: bytes, host: str = "127.0.0.1"):
+        from multiprocessing.connection import Listener
+        self._loop = loop
+        self._listener = Listener((host, 0), "AF_INET", authkey=token)
+        # The listener binds (host, 0); advertise the same host.
+        self.address: Tuple[str, int] = (
+            host, self._listener.address[1])
+        self._closed = False
+        t = threading.Thread(target=self._accept_loop,
+                             name="direct-accept", daemon=True)
+        t.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+            except Exception:  # auth failure / closed listener
+                if self._closed:
+                    return
+                continue
+            threading.Thread(target=self._serve, args=(conn,),
+                             name="direct-serve", daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        send_lock = threading.Lock()
+
+        def reply(frame: tuple, spec) -> None:
+            rt = self._loop.runtime
+            has_noninline = any(
+                isinstance(d, tuple) and d and d[0] in ("shm", "shma")
+                for _ob, d in frame[3])
+            if spec.streaming or has_noninline:
+                # Upstream registration: the head records/pins the results
+                # (and the stream end marker) so classic gets resolve.
+                rt.send(frame)
+            if spec.streaming:
+                return  # caller consumes the stream through the head
+            if has_noninline:
+                out = (DIRECT_UPSTREAM, frame[1])
+            else:
+                out = frame
+            try:
+                with send_lock:
+                    conn.send(out)
+            except (BrokenPipeError, OSError):
+                pass  # caller gone; results are either upstream or moot
+
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            frames = msg if type(msg) is list else [msg]
+            for m in frames:
+                try:
+                    if type(m) is tuple and m[0] == wire.RUN_TASK:
+                        spec, args, kwargs = wire.decode_run_task(m)
+                        if spec.max_concurrency > self._loop._executor.size:
+                            self._loop._executor.resize(spec.max_concurrency)
+                        from .protocol import RunTask
+                        self._loop._executor.submit(
+                            lambda item: self._loop._run_task(
+                                item[0], deliver=item[1]),
+                            (RunTask(spec, args, kwargs), reply))
+                except Exception:
+                    traceback.print_exc()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+
+
+class _LocalObject:
+    """Caller-owned result slot for a direct call."""
+
+    __slots__ = ("event", "desc", "refcount", "promote_on_ready")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.desc = None
+        self.refcount = 0
+        self.promote_on_ready = False
+
+    def set(self, desc) -> None:
+        self.desc = desc
+        self.event.set()
+
+
+class DirectChannel:
+    """Caller side: one FIFO connection to one actor's worker.
+
+    States: OPEN (conn live), RESOLVING (broken/unbound; calls buffer in
+    order while a resolver thread polls the head), DEAD (actor dead; all
+    calls fail fast)."""
+
+    def __init__(self, owner, actor_id):
+        self.owner = owner          # WorkerRuntime
+        self.actor_id = actor_id
+        self.lock = threading.Lock()
+        self.state = "RESOLVING"
+        self.conn = None
+        self.death_cause: Optional[str] = None
+        self.inflight: Dict[bytes, List] = {}   # task_id -> return_ids
+        self.buffered: List[tuple] = []         # frames awaiting resolve
+        self._resolver_running = False
+
+    # -- submission ------------------------------------------------------- #
+
+    def submit(self, frame: tuple, return_ids: List) -> None:
+        with self.lock:
+            if self.state == "DEAD":
+                self._fail_ids_locked(return_ids)
+                return
+            if self.state == "OPEN":
+                if return_ids:  # streaming tracks nothing (head-resolved)
+                    self.inflight[frame[1]] = return_ids
+                try:
+                    self.conn.send(frame)
+                    return
+                except (BrokenPipeError, OSError):
+                    # Never reached the worker: NOT in flight — it rides
+                    # the buffer to the next incarnation instead of
+                    # failing (only truly-sent calls fail on a break).
+                    self.inflight.pop(frame[1], None)
+                    self._broke_locked()
+            self.buffered.append((frame, return_ids))
+            self._ensure_resolver_locked()
+
+    def _fail_ids_locked(self, return_ids: List) -> None:
+        from . import serialization
+        from .exceptions import ActorError
+        desc = ("err", serialization.pack_payload(ActorError(
+            self.actor_id, self.death_cause or "actor died")))
+        for oid in return_ids:
+            self.owner.local_ready(oid.binary(), desc)
+
+    # -- connection lifecycle --------------------------------------------- #
+
+    def _broke_locked(self) -> None:
+        """Connection died: fail in-flight (their execution state is
+        unknown — matches actor-death semantics), keep buffered frames
+        (never sent) for the next incarnation."""
+        self.state = "RESOLVING"
+        try:
+            if self.conn is not None:
+                self.conn.close()
+        except Exception:
+            pass
+        self.conn = None
+        inflight, self.inflight = self.inflight, {}
+        from . import serialization
+        from .exceptions import ActorError
+        desc = ("err", serialization.pack_payload(ActorError(
+            self.actor_id,
+            "actor worker connection lost with the call in flight")))
+        for _tb, rids in inflight.items():
+            for oid in rids:
+                self.owner.local_ready(oid.binary(), desc)
+
+    def _ensure_resolver_locked(self) -> None:
+        if self._resolver_running:
+            return
+        self._resolver_running = True
+        threading.Thread(target=self._resolve_loop, name="direct-resolve",
+                         daemon=True).start()
+
+    def _resolve_loop(self) -> None:
+        from .exceptions import ActorError  # noqa: F401 (error path)
+        delay = 0.02
+        deadline = time.monotonic() + 120.0
+        while True:
+            try:
+                res = self.owner.control("resolve_actor_direct",
+                                         self.actor_id.binary())
+            except Exception:
+                res = None
+            state, addr, cause = res if res else ("unknown", None, None)
+            if state == "alive" and addr is not None:
+                conn = None
+                try:
+                    conn = self._connect(tuple(addr))
+                except Exception:
+                    conn = None
+                if conn is not None:
+                    with self.lock:
+                        self.conn = conn
+                        self.state = "OPEN"
+                        self._resolver_running = False
+                        buffered, self.buffered = self.buffered, []
+                        for i, (frame, rids) in enumerate(buffered):
+                            if rids:
+                                self.inflight[frame[1]] = rids
+                            try:
+                                self.conn.send(frame)
+                            except (BrokenPipeError, OSError):
+                                self.inflight.pop(frame[1], None)
+                                self._broke_locked()
+                                self.buffered = buffered[i:]
+                                self._ensure_resolver_locked()
+                                return
+                    threading.Thread(target=self._recv_loop, args=(conn,),
+                                     name="direct-recv",
+                                     daemon=True).start()
+                    return
+            elif state == "dead" or time.monotonic() > deadline:
+                with self.lock:
+                    self.state = "DEAD"
+                    self.death_cause = cause or "actor died"
+                    self._resolver_running = False
+                    buffered, self.buffered = self.buffered, []
+                    inflight, self.inflight = self.inflight, {}
+                    for _frame, rids in buffered:
+                        if rids:
+                            self._fail_ids_locked(rids)
+                    for rids in inflight.values():
+                        self._fail_ids_locked(rids)
+                return
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
+
+    def _connect(self, addr: Tuple[str, int]):
+        from multiprocessing.connection import Client
+        return Client(addr, authkey=self.owner.direct_token)
+
+    # -- replies ---------------------------------------------------------- #
+
+    def _recv_loop(self, conn) -> None:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                with self.lock:
+                    if self.conn is conn:
+                        self._broke_locked()
+                        if self.buffered or self.inflight:
+                            self._ensure_resolver_locked()
+                return
+            if type(msg) is not tuple:
+                continue
+            if msg[0] == wire.TASK_DONE:
+                with self.lock:
+                    rids = self.inflight.pop(msg[1], None)
+                error = msg[4]
+                if error is not None:
+                    # Error replies carry no result descs: fail the refs
+                    # the channel tracked for this call.
+                    for oid in rids or ():
+                        self.owner.local_ready(oid.binary(), error)
+                else:
+                    for ob, desc in msg[3]:
+                        self.owner.local_ready(ob, desc)
+            elif msg[0] == DIRECT_UPSTREAM:
+                with self.lock:
+                    rids = self.inflight.pop(msg[1], None)
+                for oid in rids or ():
+                    self.owner.local_ready(oid.binary(), ("upstream",))

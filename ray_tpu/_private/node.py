@@ -36,10 +36,11 @@ from .config import Config
 from .controller import NodeInfo
 from .ids import ActorID, NodeID, TaskID, WorkerID
 from .object_store import NativeArenaStore, create_store
-from .protocol import (ActorStateMsg, AllocReply, AllocRequest, GetRequest,
-                       KillWorker, PutFromWorker, ReadDone, RpcCall, RunTask,
-                       SealObject, SubmitFromWorker, TaskDone, TaskSpec,
-                       WaitRequest, WorkerReady)
+from .protocol import (ActorStateMsg, AllocReply, AllocRequest,
+                       BorrowRetained, GetRequest, KillWorker, PutFromWorker,
+                       ReadDone, RpcCall, RunTask, SealObject,
+                       SubmitFromWorker, TaskDone, TaskSpec, WaitRequest,
+                       WorkerReady)
 from .resources import ResourceSet, TPU
 
 IDLE = "idle"
@@ -117,6 +118,12 @@ class NodeManager:
         self._sock_path = os.path.join(
             tempfile.mkdtemp(prefix="ray_tpu_"), "node.sock")
         self._authkey = os.urandom(16)
+        # Direct worker->worker call channels (direct.py): the token all
+        # listeners/callers authenticate with, and the host workers bind
+        # their listeners on.  Cluster setups overwrite these with the
+        # cluster token + advertise host so channels work across nodes.
+        self.direct_token: bytes = self._authkey
+        self.direct_host: str = "127.0.0.1"
         self._listener = Listener(self._sock_path, "AF_UNIX",
                                   authkey=self._authkey)
         # One multiplexed poller over every worker connection instead of a
@@ -417,6 +424,8 @@ class NodeManager:
             "RAY_TPU_JOB_ID": self.runtime.job_id.hex(),
             "RAY_TPU_NODE_SOCK": self._sock_path,
             "RAY_TPU_AUTHKEY": self._authkey.hex(),
+            "RAY_TPU_DIRECT_TOKEN": self.direct_token.hex(),
+            "RAY_TPU_DIRECT_HOST": self.direct_host,
             "RAY_TPU_CONFIG_BLOB": Config.blob(),
             # Driver sys.path travels to workers so functions pickled
             # by reference (importable modules, incl. test files) resolve
@@ -966,6 +975,9 @@ class NodeManager:
             else:
                 for k in keys:
                     self.store.unpin_key(k)
+        elif isinstance(msg, BorrowRetained):
+            for oid in msg.object_ids:
+                rt.mark_escaped(oid)
         elif isinstance(msg, RpcCall):
             rt.on_rpc_call(self, msg)
 

@@ -62,6 +62,11 @@ class TaskSpec:
     # tracing_helper.py:34 — span context propagated in task metadata);
     # None unless tracing is enabled on the submitting process.
     trace_ctx: Optional[str] = None
+    # ObjectIDs pickled INSIDE argument values (nested refs): tracked as
+    # borrows — retained until this task completes, escalated to
+    # escaped-forever only if the worker still holds them afterwards
+    # (reference: reference_counter.h:44 borrower bookkeeping).
+    nested_refs: Tuple = ()
 
 
 @dataclass
@@ -133,10 +138,15 @@ class PutFromWorker:
 
 @dataclass
 class ActorStateMsg:
-    """worker -> node: actor constructor finished / actor died."""
+    """worker -> node: actor constructor finished / actor died.
+
+    ``direct_addr`` is the worker's direct-call listener (direct.py):
+    peers push actor calls straight to it after resolving through the
+    head (reference: actor_task_submitter.h:68 caller->actor stream)."""
     actor_id: ActorID
     state: str  # "alive" | "error"
     error: Optional[ValueDesc] = None
+    direct_addr: Optional[Tuple[str, int]] = None
 
 
 @dataclass
@@ -184,6 +194,14 @@ class AllocReply:
 class SealObject:
     """worker -> node: arena slot fully written; object now readable."""
     object_id: ObjectID
+
+
+@dataclass
+class BorrowRetained:
+    """worker -> node: these borrowed refs are still alive in the worker
+    after its task finished (e.g. stored in actor state): the owner must
+    stop auto-collecting them (escape fallback)."""
+    object_ids: List[ObjectID]
 
 
 @dataclass

@@ -136,6 +136,9 @@ class _ActorRuntimeState:
     ready_buffer: Dict[int, Tuple[TaskSpec, list, dict]] = field(default_factory=dict)
     pending_bind: List[Tuple[TaskSpec, list, dict]] = field(default_factory=list)
     lock: threading.RLock = field(default_factory=threading.RLock)
+    # Direct-call listener of the actor's worker (direct.py); set on the
+    # worker's "alive" report, cleared on worker death.
+    direct_addr: Optional[Tuple[str, int]] = None
 
 
 class Runtime:
@@ -307,6 +310,11 @@ class Runtime:
             # messages, so an unauthenticated join would be code execution.
             token = cluster_token or os.urandom(16)
             self.cluster_token = token
+            # Direct channels must work across nodes: all workers in the
+            # cluster share the cluster token and bind on routable hosts.
+            self.node.direct_token = token
+            self.node.direct_host = advertise_host or os.environ.get(
+                "RAY_TPU_ADVERTISE_HOST", "127.0.0.1")
             advertise = advertise_host or os.environ.get(
                 "RAY_TPU_ADVERTISE_HOST", "127.0.0.1")
             self.data_server = DataServer(self.node.store, token,
@@ -756,6 +764,11 @@ class Runtime:
             return
         deps = [a[1] for a in spec.arg_descs if a[0] == "ref"]
         deps += [d[1] for d in spec.kwarg_descs.values() if d[0] == "ref"]
+        # Nested refs (pickled inside arg values) are borrows: retained
+        # for the task's lifetime like positional ref args; the worker
+        # escalates to escaped via BorrowRetained if it keeps them
+        # (reference: reference_counter.h:44).
+        deps += list(getattr(spec, "nested_refs", ()) or ())
         if not deps:
             return
         with self._ref_lock:
@@ -1536,6 +1549,7 @@ class Runtime:
         with ast.lock:
             ast.worker_id = None
             ast.node_id = None
+            ast.direct_addr = None
         # Release the actor's held creation resources.
         if info.creation_spec is not None:
             cs = info.creation_spec
@@ -1604,6 +1618,11 @@ class Runtime:
     def on_actor_state(self, msg: ActorStateMsg, node_id: NodeID,
                        worker_id: WorkerID) -> None:
         if msg.state == "alive":
+            addr = getattr(msg, "direct_addr", None)
+            if addr is not None:
+                ast = self._actor_state(msg.actor_id)
+                with ast.lock:
+                    ast.direct_addr = tuple(addr)
             self.controller.set_actor_state(msg.actor_id, ALIVE, node_id)
         else:
             cause = "creation failed"
@@ -1880,6 +1899,29 @@ class Runtime:
 
     # -- state API feeds (reference: dashboard/modules/state/state_head.py
     #    backed by GcsTaskManager; here the buffers live in-process) ----- #
+
+    def ctl_resolve_actor_direct(self, actor_id_bytes: bytes):
+        """Resolve an actor's direct-call address for a caller worker
+        (reference: the GCS actor-table lookup the core worker does before
+        opening its caller->actor stream).  Returns (state, addr, cause):
+        state in {"alive", "pending", "restarting", "dead"}; addr is the
+        worker's direct listener when alive (None if the worker predates
+        direct serving or runs without a token)."""
+        try:
+            actor_id = ActorID(actor_id_bytes)
+        except ValueError:
+            return ("dead", None, "invalid actor id")
+        info = self.controller.get_actor(actor_id)
+        if info is None:
+            return ("dead", None, "unknown actor")
+        if info.state == DEAD:
+            return ("dead", None, info.death_cause or "actor died")
+        if info.state in (PENDING_CREATION, RESTARTING):
+            return ("pending" if info.state == PENDING_CREATION
+                    else "restarting", None, None)
+        ast = self._actor_state(actor_id)
+        with ast.lock:
+            return ("alive", ast.direct_addr, None)
 
     def ctl_list_tasks(self, filters=None, limit=10000):
         return self.events.snapshot(filters, limit)

@@ -24,14 +24,14 @@ from .config import Config
 from .exceptions import TaskError
 from .ids import ActorID, ObjectID, TaskID, WorkerID
 from .object_store import ArenaReader, RemoteObjectReader
-from .protocol import (ActorStateMsg, AllocReply, AllocRequest, GetReply,
-                       GetRequest, KillWorker, PutFromWorker, ReadDone,
-                       RpcCall, RpcReply, RunTask, SealObject,
-                       SubmitFromWorker, TaskDone, WaitReply, WaitRequest,
-                       WorkerReady)
+from .protocol import (ActorStateMsg, AllocReply, AllocRequest,
+                       BorrowRetained, GetReply, GetRequest, KillWorker,
+                       PutFromWorker, ReadDone, RpcCall, RpcReply, RunTask,
+                       SealObject, SubmitFromWorker, TaskDone, WaitReply,
+                       WaitRequest, WorkerReady)
 
 
-def _materialize(desc, keepalives: List) -> Any:
+def _materialize(desc, keepalives: List, rt=None) -> Any:
     kind = desc[0]
     if kind == "inline":
         return serialization.unpack_payload(desc[1])
@@ -45,6 +45,10 @@ def _materialize(desc, keepalives: List) -> Any:
         return value
     if kind == "err":
         raise serialization.unpack_payload(desc[1])
+    if kind == "ref" and rt is not None:
+        # Unresolved dependency (direct worker->worker call frames carry
+        # raw refs; the callee resolves): blocks until the value lands.
+        return rt.get([ObjectID(desc[1])])[0]
     raise ValueError(f"unknown value descriptor {kind!r}")
 
 
@@ -104,6 +108,146 @@ class WorkerRuntime:
         # when the task that materialized them finishes (its zero-copy views
         # die with it). Thread-local so concurrent tasks don't cross-release.
         self._tls = threading.local()
+        # -- direct worker->worker actor calls (see direct.py) ------------ #
+        # Caller-owned results of direct calls live here (oid bytes ->
+        # _LocalObject); the head only learns about them on escape.
+        tok = os.environ.get("RAY_TPU_DIRECT_TOKEN")
+        self.direct_token = bytes.fromhex(tok) if tok else None
+        self._local_lock = threading.Lock()
+        self._local_objects: Dict[bytes, Any] = {}
+        self._channels: Dict[bytes, Any] = {}   # actor_id bytes -> channel
+        self._direct_mode: Dict[bytes, str] = {}  # "direct" | "classic"
+
+    # -- direct-call plumbing (caller side) -------------------------------- #
+
+    def local_ready(self, oid_bytes: bytes, desc) -> None:
+        with self._local_lock:
+            lo = self._local_objects.get(oid_bytes)
+            if lo is None:
+                return
+            promote = lo.promote_on_ready and desc[0] in ("inline", "err")
+            lo.set(desc)
+            lo.promote_on_ready = False
+            if lo.refcount <= 0 and not promote:
+                # Fire-and-forget call whose ref already dropped: nothing
+                # will ever read this result — don't accumulate it.
+                self._local_objects.pop(oid_bytes, None)
+        if promote:
+            self.send(PutFromWorker(ObjectID(oid_bytes), desc))
+
+    def promote_local(self, object_id) -> None:
+        """A direct-call result ref escapes this process (pickled into a
+        task arg / user payload): register it with the head so classic
+        resolution works anywhere (reference: borrow registration,
+        reference_counter.h:44).  Pending results promote on arrival."""
+        ob = object_id.binary() if not isinstance(object_id, bytes) \
+            else object_id
+        with self._local_lock:
+            lo = self._local_objects.get(ob)
+            if lo is None:
+                return
+            if not lo.event.is_set():
+                lo.promote_on_ready = True
+                return
+        if lo.desc[0] in ("inline", "err"):
+            self.send(PutFromWorker(ObjectID(ob), lo.desc))
+
+    def drop_local(self, oid_bytes: bytes) -> None:
+        with self._local_lock:
+            lo = self._local_objects.get(oid_bytes)
+            if lo is None:
+                return
+            lo.refcount -= 1
+            if lo.refcount <= 0 and lo.event.is_set() \
+                    and not lo.promote_on_ready:
+                # Pending entries (event unset) are cleaned by
+                # local_ready when the reply lands and refcount is 0.
+                self._local_objects.pop(oid_bytes, None)
+
+    def note_local_ref(self, oid_bytes: bytes) -> None:
+        with self._local_lock:
+            lo = self._local_objects.get(oid_bytes)
+            if lo is not None:
+                lo.refcount += 1
+
+    def note_new_ref(self, ref) -> None:
+        """Every ObjectRef constructed in this worker passes through here:
+        local-table refcounting plus borrow tracking while task args are
+        being materialized (reference: reference_counter.h:44 borrower
+        registration on deserialization)."""
+        self.note_local_ref(ref._id.binary())
+        borrows = getattr(self._tls, "arg_borrows", None)
+        if borrows is not None:
+            import weakref
+            try:
+                borrows.append((weakref.ref(ref), ref._id))
+            except TypeError:
+                pass
+
+    def begin_arg_borrows(self) -> None:
+        self._tls.arg_borrows = []
+
+    def end_arg_borrows(self) -> list:
+        borrows = getattr(self._tls, "arg_borrows", None)
+        self._tls.arg_borrows = None
+        return borrows or []
+
+    def report_retained_borrows(self, borrows: list) -> None:
+        """After the task: any arg-borrowed ref still alive (stored in
+        actor state, a module global, ...) escalates to owner-side
+        escaped pinning — the bounded fallback."""
+        survivors = [oid for (wref, oid) in borrows
+                     if wref() is not None]
+        if survivors:
+            self.send(BorrowRetained(survivors))
+
+    def submit_actor_direct(self, actor_id, task_id, name: str,
+                            method_name: Optional[str], return_ids: List,
+                            args: list, kwargs: dict,
+                            max_concurrency: int, streaming: bool,
+                            fn_blob: Optional[bytes] = None) -> bool:
+        """Push an actor call straight to the actor's worker over this
+        process's channel.  Mode (direct vs classic) is sticky per actor
+        so the two paths never interleave for one caller (ordering)."""
+        if self.direct_token is None:
+            return False
+        ab = actor_id.binary()
+        mode = self._direct_mode.get(ab)
+        if mode is None:
+            try:
+                res = self.control("resolve_actor_direct", ab)
+            except Exception:
+                res = None
+            state = res[0] if res else "unknown"
+            if state == "alive" and res[1] is not None:
+                mode = "direct"
+            else:
+                # Classic is STICKY: once any call from this process rode
+                # the head's dispatch queue, later direct pushes could
+                # overtake it on a separate socket and break per-caller
+                # ordering — so this caller stays classic for this actor.
+                mode = "classic"
+            self._direct_mode[ab] = mode
+        if mode != "direct":
+            return False
+        from .direct import DirectChannel
+        ch = self._channels.get(ab)
+        if ch is None:
+            ch = self._channels.setdefault(
+                ab, DirectChannel(self, actor_id))
+            with ch.lock:
+                ch._ensure_resolver_locked()
+        tb = task_id.binary()
+        if not streaming:
+            with self._local_lock:
+                from .direct import _LocalObject
+                for oid in return_ids:
+                    self._local_objects[oid.binary()] = _LocalObject()
+        frame = (wire.RUN_TASK, tb, name, fn_blob, None, method_name,
+                 tuple(r.binary() for r in return_ids), ab,
+                 streaming, max_concurrency, None, args, kwargs, None)
+        ch.submit(frame, return_ids)
+        return True
 
     # -- plumbing -----------------------------------------------------------
 
@@ -172,9 +316,18 @@ class WorkerRuntime:
     # -- API surface --------------------------------------------------------
 
     def submit_spec(self, spec) -> None:
+        # Caller-local direct-call results used as args must be
+        # registered with the head before it resolves this spec's deps.
+        for kind, p in list(spec.arg_descs) + list(spec.kwarg_descs.values()):
+            if kind == "ref":
+                self.promote_local(p)
         self.send(SubmitFromWorker(spec))
 
     def get(self, object_ids: List[ObjectID], timeout: Optional[float] = None):
+        if self._local_objects:
+            local = self._split_local(object_ids, timeout)
+            if local is not None:
+                return local
         reply: GetReply = self._call(
             lambda rid: GetRequest(rid, self.worker_id, object_ids, timeout),
             timeout=None)
@@ -200,6 +353,53 @@ class WorkerRuntime:
                                     if isinstance(d, tuple) and d
                                     and d[0] == "shma"]
                 self._note_arena_read(reply.request_id, arena_values)
+
+    def _split_local(self, object_ids: List[ObjectID],
+                     timeout: Optional[float] = None):
+        """Resolve ids that are local direct-call results without a head
+        round-trip; the rest go through the classic get.  Returns ordered
+        values, or None when nothing is local."""
+        with self._local_lock:
+            entries = [self._local_objects.get(o.binary())
+                       for o in object_ids]
+        if not any(e is not None for e in entries):
+            return None
+        values: List[Any] = [None] * len(object_ids)
+        classic_ids: List[ObjectID] = []
+        classic_pos: List[int] = []
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        for i, (oid, lo) in enumerate(zip(object_ids, entries)):
+            if lo is None:
+                classic_ids.append(oid)
+                classic_pos.append(i)
+                continue
+            remaining = None if deadline is None \
+                else deadline - _time.monotonic()
+            if not lo.event.wait(remaining):
+                from .exceptions import GetTimeoutError
+                raise GetTimeoutError(f"get timed out on {oid}")
+            desc = lo.desc
+            if desc[0] == "err":
+                raise serialization.unpack_payload(desc[1])
+            if desc[0] == "inline":
+                values[i] = serialization.unpack_payload(desc[1])
+            else:
+                # Result registered upstream (non-inline): the head owns
+                # it now — drop the local entry (else the classic get
+                # below would re-enter this path forever) and resolve
+                # through the head.
+                with self._local_lock:
+                    self._local_objects.pop(oid.binary(), None)
+                classic_ids.append(oid)
+                classic_pos.append(i)
+        if classic_ids:
+            remaining = None if deadline is None \
+                else max(deadline - _time.monotonic(), 0.0)
+            rest = self.get(classic_ids, remaining)
+            for pos, v in zip(classic_pos, rest):
+                values[pos] = v
+        return values
 
     def _send_read_done(self, request_id: int, retain: bool) -> None:
         try:
@@ -266,13 +466,59 @@ class WorkerRuntime:
 
     def wait(self, object_ids: List[ObjectID], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True):
-        reply: WaitReply = self._call(
-            lambda rid: WaitRequest(rid, self.worker_id, object_ids,
-                                    num_returns, timeout, fetch_local))
-        ready_set = set(reply.ready)
-        ready = [o for o in object_ids if o in ready_set]
-        not_ready = [o for o in object_ids if o not in ready_set]
-        return ready, not_ready
+        local_map = {}
+        if self._local_objects:
+            with self._local_lock:
+                for o in object_ids:
+                    lo = self._local_objects.get(o.binary())
+                    if lo is not None:
+                        local_map[o] = lo
+        if not local_map:
+            reply: WaitReply = self._call(
+                lambda rid: WaitRequest(rid, self.worker_id, object_ids,
+                                        num_returns, timeout, fetch_local))
+            ready_set = set(reply.ready)
+            ready = [o for o in object_ids if o in ready_set]
+            not_ready = [o for o in object_ids if o not in ready_set]
+            return ready, not_ready
+        # Mixed local/classic: poll in slices — local results complete via
+        # channel replies, the rest via short head waits.
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        classic = [o for o in object_ids if o not in local_map]
+        while True:
+            ready = []
+            for o in object_ids:
+                lo = local_map.get(o)
+                if lo is not None and lo.event.is_set():
+                    ready.append(o)
+            classic_ready: set = set()
+            if classic:
+                # 0.5s slices bound the polling load on the head while
+                # local channel replies keep landing concurrently.
+                reply = self._call(
+                    lambda rid: WaitRequest(
+                        rid, self.worker_id, classic,
+                        len(classic), 0.5, fetch_local))
+                classic_ready = set(reply.ready)
+                ready.extend(o for o in object_ids if o in classic_ready)
+            if len(ready) >= num_returns or (
+                    deadline is not None
+                    and _time.monotonic() >= deadline):
+                ready = ready[:max(num_returns, 0)] \
+                    if len(ready) > num_returns else ready
+                rset = set(ready)
+                return ready, [o for o in object_ids if o not in rset]
+            if not classic:
+                remaining = None if deadline is None \
+                    else deadline - _time.monotonic()
+                # Pure local: block on the first unready event in slices.
+                pending = [lo for o, lo in local_map.items()
+                           if not lo.event.is_set()]
+                if pending:
+                    pending[0].event.wait(
+                        0.05 if remaining is None
+                        else min(0.05, max(remaining, 0.0)))
 
     def put(self, value: Any) -> ObjectID:
         task_id = self.current_task_id or TaskID.for_driver(self.job_id)
@@ -350,6 +596,24 @@ class WorkerLoop:
         # Shm segments backing zero-copy views that an actor may retain in
         # its state must outlive the task that mapped them.
         self._actor_keepalives: List = []
+        self._direct_server: Any = None
+
+    def _direct_addr(self) -> Optional[Tuple[str, int]]:
+        """Start (once) and advertise this worker's direct-call listener —
+        peers push actor calls straight here (direct.py)."""
+        if self.runtime.direct_token is None:
+            return None
+        if self._direct_server is None:
+            try:
+                from .direct import DirectServer
+                self._direct_server = DirectServer(
+                    self, self.runtime.direct_token,
+                    host=os.environ.get("RAY_TPU_DIRECT_HOST",
+                                        "127.0.0.1"))
+            except Exception:
+                traceback.print_exc()
+                return None
+        return self._direct_server.address
 
     def _load_fn(self, spec) -> Any:
         """Resolve the task's callable: cached by fn_id, blob from the
@@ -372,7 +636,7 @@ class WorkerLoop:
 
     # -- task execution -----------------------------------------------------
 
-    def _run_task(self, msg: RunTask) -> None:
+    def _run_task(self, msg: RunTask, deliver=None) -> None:
         spec = msg.spec
         trace_ctx = getattr(spec, "trace_ctx", None)
         if trace_ctx is not None:
@@ -381,11 +645,11 @@ class WorkerLoop:
             from ray_tpu.util import tracing
             with tracing.task_span(trace_ctx, spec.name,
                                    spec.task_id.hex()):
-                self._run_task_inner(msg)
+                self._run_task_inner(msg, deliver)
         else:
-            self._run_task_inner(msg)
+            self._run_task_inner(msg, deliver)
 
-    def _run_task_inner(self, msg: RunTask) -> None:
+    def _run_task_inner(self, msg: RunTask, deliver=None) -> None:
         spec = msg.spec
         rt = self.runtime
         rt.current_task_id = spec.task_id
@@ -407,12 +671,20 @@ class WorkerLoop:
         is_app_error = False
         import time as _time
         t0 = _time.monotonic()
+        borrows: list = []
         try:
             if spec.runtime_env and spec.runtime_env.get("env_vars"):
                 os.environ.update(spec.runtime_env["env_vars"])
-            args = [_materialize(d, keepalives) for d in msg.resolved_args]
-            kwargs = {k: _materialize(d, keepalives)
-                      for k, d in msg.resolved_kwargs.items()}
+            # Refs unpickled out of the args are borrows: tracked so
+            # still-alive ones escalate to owner pinning at task end.
+            rt.begin_arg_borrows()
+            try:
+                args = [_materialize(d, keepalives, rt)
+                        for d in msg.resolved_args]
+                kwargs = {k: _materialize(d, keepalives, rt)
+                          for k, d in msg.resolved_kwargs.items()}
+            finally:
+                borrows = rt.end_arg_borrows()
             if spec.create_actor_id is not None:
                 try:
                     cls = self._load_fn(spec)
@@ -424,7 +696,8 @@ class WorkerLoop:
                     self._actor_ready.set()
                 self.actor_id = spec.create_actor_id
                 rt.current_actor_id = spec.create_actor_id
-                rt.send(ActorStateMsg(spec.create_actor_id, "alive"))
+                rt.send(ActorStateMsg(spec.create_actor_id, "alive",
+                                      direct_addr=self._direct_addr()))
                 value_list = [None] * len(spec.return_ids)
             elif spec.actor_id is not None:
                 if self.actor_instance is None:
@@ -451,6 +724,7 @@ class WorkerLoop:
                     value_list = []
                 else:
                     value_list = self._split_returns(call(), spec)
+                call = None
             elif spec.streaming:
                 fn = self._load_fn(spec)
                 self._run_stream(lambda: fn(*args, **kwargs), spec, rt,
@@ -458,10 +732,24 @@ class WorkerLoop:
                 value_list = []
             else:
                 fn = self._load_fn(spec)
-                out = fn(*args, **kwargs)
-                value_list = self._split_returns(out, spec)
-            for oid, value in zip(spec.return_ids, value_list):
-                results.append((oid, _serialize_result(rt, oid, value)))
+                value_list = self._split_returns(fn(*args, **kwargs), spec)
+            # A borrowed ref serialized into the RESULTS outlives the
+            # task at its consumer: escalate it like a retained borrow.
+            from .api import _nested_collector
+            in_results: list = []
+            token = _nested_collector.set(in_results)
+            try:
+                for i, oid in enumerate(spec.return_ids):
+                    results.append(
+                        (oid, _serialize_result(rt, oid, value_list[i])))
+            finally:
+                _nested_collector.reset(token)
+            if in_results:
+                rt.send(BorrowRetained(list(in_results)))
+            # Release the arg/result locals so the borrow survivor check
+            # in the finally sees only refs the USER kept (actor state,
+            # globals) — not this frame's own temporaries.
+            args = kwargs = value_list = None
         except BaseException as exc:  # noqa: BLE001 - forwarded to caller
             is_app_error = True
             wrapped = TaskError(exc, spec.name, traceback.format_exc())
@@ -473,19 +761,33 @@ class WorkerLoop:
                               traceback.format_exc())))
             if spec.create_actor_id is not None:
                 rt.send(ActorStateMsg(spec.create_actor_id, "error", error))
+            # Release the frame's own references (locals + the exception's
+            # traceback chain) so failed tasks don't spuriously escalate
+            # their arg borrows to escaped-forever.
+            args = kwargs = value_list = wrapped = None  # noqa: F841
         finally:
             rt.current_task_id = None
             if not is_actor_task:
                 # Results are serialized (copied) by now; arg/get views are
                 # dead, so release their arena pins before TaskDone.
                 rt.flush_task_reads()
+            if borrows:
+                # Borrowed refs kept beyond the task (actor state etc.)
+                # escalate to owner-side pinning; must hit the wire
+                # BEFORE TaskDone or the owner could free first (FIFO
+                # outbox preserves the order).
+                rt.report_retained_borrows(borrows)
         aid = spec.actor_id or spec.create_actor_id
-        rt.send(wire.encode_task_done(
+        frame = wire.encode_task_done(
             spec.task_id.binary(), rt.worker_id.binary(),
             [(oid.binary(), desc) for oid, desc in results],
             error, is_app_error,
             aid.binary() if aid is not None else None,
-            _time.monotonic() - t0))
+            _time.monotonic() - t0)
+        if deliver is not None:
+            deliver(frame, spec)
+        else:
+            rt.send(frame)
 
     @staticmethod
     def _run_stream(produce, spec, rt, results) -> None:

@@ -1,0 +1,187 @@
+"""Direct actor-call paths: driver fast path + worker->worker channels.
+
+Reference analog: the caller->actor submission stream tests around
+src/ray/core_worker/task_submission/actor_task_submitter.h:68 and
+python/ray/tests/test_actor.py ordering/failure semantics — here the
+driver pushes pre-encoded frames to the bound worker
+(runtime.submit_actor_direct) and worker callers push over authenticated
+per-process channels (_private/direct.py), with the head only involved
+for resolution, restarts, and escaped results.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt(ray_start_isolated):
+    yield ray_start_isolated
+
+
+@ray_tpu.remote
+class Sink:
+    def __init__(self):
+        self.log = []
+
+    def push(self, caller, i):
+        self.log.append((caller, i))
+        return len(self.log)
+
+    def get_log(self):
+        return list(self.log)
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return x * 2
+
+
+class TestDriverDirectPath:
+    def test_ordered_results(self, rt):
+        s = Sink.remote()
+        refs = [s.push.remote("d", i) for i in range(100)]
+        assert ray_tpu.get(refs) == list(range(1, 101))
+        assert [i for _, i in ray_tpu.get(s.get_log.remote())] == \
+            list(range(100))
+
+    def test_uses_direct_inflight_registry(self, rt):
+        s = Sink.remote()
+        ray_tpu.get(s.push.remote("d", 0))
+        # After a call completes the registry must be drained (no leak).
+        assert not rt._direct_inflight
+
+    def test_error_propagates_with_message(self, rt):
+        @ray_tpu.remote
+        class Bad:
+            def boom(self):
+                raise ValueError("intentional-direct")
+
+        b = Bad.remote()
+        with pytest.raises(Exception, match="intentional-direct"):
+            ray_tpu.get(b.boom.remote())
+        from ray_tpu.util import state as state_api
+        time.sleep(0.1)
+        failed = state_api.list_tasks(filters=[("state", "=", "FAILED")])
+        assert any("intentional-direct" in (t["error_message"] or "")
+                   for t in failed)
+
+    def test_state_api_sees_direct_calls(self, rt):
+        s = Sink.remote()
+        ray_tpu.get([s.push.remote("d", i) for i in range(10)])
+        from ray_tpu.util import state as state_api
+        time.sleep(0.1)
+        rows = [t for t in state_api.list_tasks()
+                if t.get("type") == "ACTOR_TASK"
+                and t["state"] == "FINISHED"
+                and t["name"].startswith("Sink.push")]
+        assert len(rows) >= 10
+
+    def test_inflight_fails_on_worker_death(self, rt):
+        @ray_tpu.remote
+        class Mortal:
+            def die(self):
+                import os
+                os._exit(1)
+
+        m = Mortal.remote()
+        ref = m.die.remote()
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=20)
+        assert not rt._direct_inflight
+
+
+class TestWorkerChannels:
+    def test_per_caller_order_across_concurrent_callers(self, rt):
+        s = Sink.remote()
+        ray_tpu.get(s.get_log.remote())
+
+        @ray_tpu.remote
+        def caller(s, name, n):
+            return ray_tpu.get([s.push.remote(name, i) for i in range(n)])
+
+        ray_tpu.get([caller.remote(s, f"w{j}", 40) for j in range(3)])
+        log = ray_tpu.get(s.get_log.remote())
+        assert len(log) == 120
+        for j in range(3):
+            assert [i for c, i in log if c == f"w{j}"] == list(range(40))
+
+    def test_channel_actually_used(self, rt):
+        s = Sink.remote()
+        ray_tpu.get(s.get_log.remote())
+
+        @ray_tpu.remote
+        def probe(s):
+            from ray_tpu._private.runtime import current_runtime
+            wr = current_runtime()
+            ray_tpu.get(s.push.remote("p", 0))
+            chans = getattr(wr, "_channels", {})
+            return [c.state for c in chans.values()]
+
+        assert ray_tpu.get(probe.remote(s)) == ["OPEN"]
+
+    def test_escaped_result_resolves_anywhere(self, rt):
+        s = Sink.remote()
+        d = Doubler.remote()
+        ray_tpu.get([s.get_log.remote(), d.double.remote(1)])
+
+        @ray_tpu.remote
+        def chained(s, d):
+            r1 = s.push.remote("c", 1)       # direct; caller-local result
+            r2 = d.double.remote(r1)         # escapes -> promoted upstream
+            return ray_tpu.get(r2)
+
+        assert ray_tpu.get(chained.remote(s, d)) == 2
+
+    def test_crash_then_restart_recovers(self, rt):
+        @ray_tpu.remote
+        class Fragile:
+            def ping(self):
+                return "ok"
+
+            def die(self):
+                import os
+                os._exit(1)
+
+        f = Fragile.options(max_restarts=1).remote()
+        ray_tpu.get(f.ping.remote())
+
+        @ray_tpu.remote
+        def crash_caller(f):
+            try:
+                ray_tpu.get(f.die.remote(), timeout=10)
+                return "no-error"
+            except Exception as e:
+                err = type(e).__name__
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    return err + ":" + ray_tpu.get(f.ping.remote(),
+                                                   timeout=5)
+                except Exception:
+                    time.sleep(0.3)
+            return err + ":no-recovery"
+
+        res = ray_tpu.get(crash_caller.remote(f))
+        assert res == "ActorError:ok", res
+
+    def test_large_result_via_upstream_registration(self, rt):
+        import numpy as np
+
+        @ray_tpu.remote
+        class Big:
+            def blob(self):
+                return np.ones((512, 512), np.float64)  # > inline cutoff
+
+        b = Big.remote()
+        ray_tpu.get(b.blob.remote())
+
+        @ray_tpu.remote
+        def reader(b):
+            arr = ray_tpu.get(b.blob.remote())
+            return float(arr.sum())
+
+        assert ray_tpu.get(reader.remote(b)) == 512.0 * 512.0

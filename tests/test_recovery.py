@@ -74,6 +74,64 @@ class TestOwnershipGC:
         assert ray_tpu.get(got[0])[-1] == 49_999
         assert inner_id in rt._escaped
 
+    def test_nested_ref_borrow_released_after_two_hops(self, rt):
+        """A ref pickled INSIDE task args is a tracked borrow, not an
+        escaped-forever pin: after it travels through two worker hops and
+        every handle drops, the object is freed and its arena slot is
+        reusable (reference: reference_counter.h:44 borrow chain
+        draining)."""
+        @ray_tpu.remote
+        def hop2(wrapped):
+            return float(ray_tpu.get(wrapped[0]).sum())
+
+        @ray_tpu.remote
+        def hop1(wrapped):
+            return ray_tpu.get(hop2.remote([wrapped[0]]), timeout=60)
+
+        ref = ray_tpu.put(np.ones(300_000))  # arena-resident
+        oid = ref.id()
+        assert ray_tpu.get(hop1.remote([ref]), timeout=60) == 300_000.0
+        assert oid not in rt._escaped
+        stats_before = rt.node.store.stats()["num_objects"]
+        del ref
+        gc.collect()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with rt._dir_lock:
+                gone = oid not in rt.directory
+            if gone:
+                break
+            time.sleep(0.05)
+        assert gone, "borrowed object not freed after handles dropped"
+        assert rt.node.store.stats()["num_objects"] <= stats_before
+
+    def test_borrow_retained_by_actor_escalates_to_escape(self, rt):
+        """The bounded fallback: a worker that KEEPS a borrowed ref past
+        its task (actor state) reports it, and the owner pins the object
+        so later reads still work."""
+        @ray_tpu.remote
+        class Keeper:
+            def __init__(self):
+                self.held = None
+
+            def keep(self, wrapped):
+                self.held = wrapped[0]
+                return "kept"
+
+            def read(self):
+                return float(ray_tpu.get(self.held).sum())
+
+        k = Keeper.remote()
+        ref = ray_tpu.put(np.ones(300_000))
+        oid = ref.id()
+        assert ray_tpu.get(k.keep.remote([ref]), timeout=60) == "kept"
+        del ref
+        gc.collect()
+        time.sleep(0.5)
+        # Escalated: not collected, still readable through the actor.
+        assert oid in rt._escaped
+        assert ray_tpu.get(k.read.remote(), timeout=60) == 300_000.0
+
 
 class TestLineageReconstruction:
     def test_reconstruct_lost_object_on_get(self, rt):
