@@ -30,6 +30,7 @@ import os
 import socket
 import threading
 import time
+from collections import deque as _deque
 from dataclasses import dataclass, field
 from multiprocessing.connection import Client, Listener
 from typing import Any, Dict, List, Optional, Tuple
@@ -37,8 +38,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from .config import Config
 from .controller import NodeInfo
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
-from .protocol import (ActorStateMsg, GetReply, GetRequest, PutFromWorker,
-                       RpcCall, RpcReply, TaskDone, TaskSpec, WaitRequest)
+from .protocol import (ActorStateMsg, BorrowRetained, GetReply, GetRequest,
+                       PutFromWorker, RpcCall, RpcReply, TaskDone, TaskSpec,
+                       WaitRequest)
 from .resources import ResourceSet
 
 # NOTE: the control/data listeners authenticate with an HMAC token and then
@@ -59,6 +61,14 @@ class RegisterNode:
     num_tpu_chips: int
     data_address: Tuple[str, int]
     os_pid: int = 0
+    # Set on reconnect after a dropped control connection: the node asks
+    # to re-attach under its existing identity, keeping workers/tasks
+    # alive (reference: raylets re-attaching after GCS failover;
+    # retryable_grpc_client.h reconnect semantics).  last_down_seq tells
+    # the head which down-messages arrived so it resends exactly the lost
+    # tail (sequence-numbered redelivery).
+    rejoin_node_id: Optional[bytes] = None
+    last_down_seq: int = 0
 
 
 @dataclass
@@ -84,6 +94,9 @@ class RegisterAck:
     config_blob: str
     head_data_address: Tuple[str, int]
     head_node_id_bytes: bytes
+    # Highest up-message sequence the head processed from this node (the
+    # node resends everything after it on re-attach).
+    last_up_seq: int = 0
 
 
 @dataclass
@@ -477,13 +490,70 @@ class RemoteNodeProxy:
         self._send_lock = threading.Lock()
         self.alive = True
         self.last_seen = time.monotonic()
+        # Sequence-numbered redelivery (reference:
+        # rpc/retryable_grpc_client.h): every down-message carries
+        # (seq, ack-of-up); unacked messages stay in the ring and are
+        # resent after a re-attach, so a message written into a dying
+        # socket is never silently lost.
+        self._down_seq = 0
+        self._ring: "deque" = _deque(maxlen=100_000)
+        self._ring_overflow = False   # an unacked frame was evicted
+        self.last_up_seq = 0          # highest up-seq processed
+        self._up_seq_lock = threading.Lock()
 
     def send(self, msg) -> None:
+        with self._send_lock:
+            self._down_seq += 1
+            frame = ("dseq", self._down_seq, self.last_up_seq, msg)
+            if len(self._ring) == self._ring.maxlen:
+                # Eviction would silently lose an unacked frame: refuse
+                # future re-attach instead (the node rejoins fresh, which
+                # is lossy but LOUD — node-death fan-out reruns the work).
+                self._ring_overflow = True
+            self._ring.append(frame)
+            try:
+                self.conn.send(frame)
+            except (BrokenPipeError, OSError):
+                pass  # stays in the ring; resent on re-attach
+
+    def note_up_seq(self, seq: int) -> bool:
+        """Atomically claim an up-sequence number; False = duplicate.
+        Serialized so an old reader and the re-attached reader can never
+        both process the same resent frame."""
+        with self._up_seq_lock:
+            if seq <= self.last_up_seq:
+                return False
+            self.last_up_seq = seq
+            return True
+
+    def note_up_acked(self, acked_down_seq: int) -> None:
+        """The node reports the highest down-seq it received: drop acked
+        entries from the resend ring."""
+        with self._send_lock:
+            while self._ring and self._ring[0][1] <= acked_down_seq:
+                self._ring.popleft()
+
+    def reattach(self, conn, last_down_seq: int, ack_msg) -> None:
+        """Atomically swap in a fresh control connection, send the raw
+        RegisterAck handshake, and replay the unacked tail — all under the
+        send lock so concurrent dispatches cannot interleave ahead of the
+        redelivered (ordered) frames."""
+        with self._send_lock:
+            old = self.conn
+            self.conn = conn
+            while self._ring and self._ring[0][1] <= last_down_seq:
+                self._ring.popleft()
+            try:
+                conn.send(ack_msg)
+                for frame in list(self._ring):
+                    conn.send(frame)
+            except (BrokenPipeError, OSError):
+                pass  # node retries the whole rejoin
+        self.last_seen = time.monotonic()
         try:
-            with self._send_lock:
-                self.conn.send(msg)
-        except (BrokenPipeError, OSError):
-            pass  # reader loop handles the death
+            old.close()
+        except Exception:
+            pass
 
     # -- NodeManager surface -------------------------------------------------
 
@@ -602,6 +672,8 @@ class HeadServer:
         if not isinstance(msg, RegisterNode):
             conn.close()
             return
+        if msg.rejoin_node_id is not None and self._reattach(msg, conn):
+            return
         node_id = NodeID.from_random()
         info = NodeInfo(node_id, msg.hostname, ResourceSet(msg.resources),
                         labels={"os_pid": str(msg.os_pid)}, is_head=False)
@@ -611,15 +683,49 @@ class HeadServer:
             self.proxies[node_id] = proxy
         rt.controller.register_node(info)
         rt.nodes[node_id] = proxy
-        proxy.send(RegisterAck(
-            node_id.binary(), rt.job_id.binary(), Config.blob(),
-            rt.data_server.address, rt.node_id.binary()))
+        # Raw handshake reply (the seq framing starts after registration).
+        try:
+            conn.send(RegisterAck(
+                node_id.binary(), rt.job_id.binary(), Config.blob(),
+                rt.data_server.address, rt.node_id.binary()))
+        except (BrokenPipeError, OSError):
+            pass
         # Register with the scheduler only after the ack is on the wire so
         # the first dispatch can't race the node's own setup.
         rt.scheduler.add_node(info)
         threading.Thread(target=self._reader_loop, args=(proxy,),
                          name=f"head-node-{node_id.hex()[:8]}",
                          daemon=True).start()
+
+    def _reattach(self, msg: RegisterNode, conn) -> bool:
+        """A node reconnecting within the grace window re-attaches under
+        its existing identity: workers, running tasks and actors survive
+        the control-plane blip (reference: raylet reconnect after GCS
+        failover; retryable_grpc_client.h)."""
+        try:
+            nid = NodeID(msg.rejoin_node_id)
+        except ValueError:
+            return False
+        rt = self.runtime
+        with self._lock:
+            proxy = self.proxies.get(nid)
+            if proxy is None or not proxy.alive:
+                return False  # grace expired (death fan-out already ran)
+            if proxy._ring_overflow:
+                # The redelivery ring evicted unacked frames: a silent
+                # gap is worse than a loud fresh join.
+                return False
+            # Swap under the head lock: the grace timer's death check
+            # reads proxy.conn under the same lock, so a re-attach and a
+            # death declaration can never interleave (no task runs twice).
+            proxy.reattach(conn, msg.last_down_seq, RegisterAck(
+                nid.binary(), rt.job_id.binary(), Config.blob(),
+                rt.data_server.address, rt.node_id.binary(),
+                last_up_seq=proxy.last_up_seq))
+        threading.Thread(target=self._reader_loop, args=(proxy,),
+                         name=f"head-node-{nid.hex()[:8]}",
+                         daemon=True).start()
+        return True
 
     def _register_client(self, conn) -> None:
         rt = self.runtime
@@ -725,19 +831,42 @@ class HeadServer:
         while True:
             try:
                 msg = conn.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError, ValueError):
+                # TypeError/ValueError: the connection was close()d by a
+                # re-attach while this thread sat in recv (cpython's
+                # Connection raises TypeError on a None handle).
                 break
             try:
                 self._handle(proxy, msg)
             except Exception:
                 import traceback
                 traceback.print_exc()
-        self._on_node_death(proxy)
+        with self._lock:
+            if proxy.conn is not conn:
+                return  # superseded by a re-attach; nothing died
+        # Grace window before declaring death: a transient control-plane
+        # drop (head hiccup, network blip) re-attaches without failing a
+        # single task (reference: gcs reconnect grace in the raylet).
+        grace = float(Config.get("node_reconnect_grace_s"))
+        if grace > 0:
+            # The conn identity check happens inside _on_node_death's
+            # locked section, where _reattach also swaps — so a re-attach
+            # and a death declaration can never both win.
+            t = threading.Timer(
+                grace, self._on_node_death, args=(proxy,),
+                kwargs={"expect_conn": conn})
+            t.daemon = True
+            t.start()
+        else:
+            self._on_node_death(proxy)
 
-    def _on_node_death(self, proxy: RemoteNodeProxy) -> None:
+    def _on_node_death(self, proxy: RemoteNodeProxy,
+                       expect_conn=None) -> None:
         if self._closed:
             return
         with self._lock:
+            if expect_conn is not None and proxy.conn is not expect_conn:
+                return  # re-attached while the timer was firing
             if not proxy.alive:
                 return
             proxy.alive = False
@@ -750,6 +879,11 @@ class HeadServer:
         rt = self.runtime
         nid = proxy.info.node_id
         proxy.last_seen = time.monotonic()
+        if type(msg) is tuple and msg and msg[0] == "useq":
+            _tag, seq, ack_down, msg = msg
+            proxy.note_up_acked(ack_down)
+            if not proxy.note_up_seq(seq):
+                return  # duplicate from a resend overlap
         if isinstance(msg, UpTaskDone):
             rt.on_task_done(msg.msg, nid)
         elif isinstance(msg, UpNoteTaskRunning):
@@ -759,6 +893,9 @@ class HeadServer:
                               reason=msg.reason)
         elif isinstance(msg, UpSyncView):
             rt.on_node_view(nid, msg.version, msg.view)
+        elif isinstance(msg, BorrowRetained):
+            for oid in msg.object_ids:
+                rt.mark_escaped(oid)
         elif isinstance(msg, UpDispatchFailed):
             rt.on_dispatch_failed(msg.spec, msg.reason,
                                   lost_object_bytes=msg.lost_object_bytes)
@@ -904,6 +1041,11 @@ class _NodeServerRuntime:
     def on_rpc_call(self, node, msg: RpcCall) -> None:
         self._server.send_up(msg)
 
+    def mark_escaped(self, oid) -> None:
+        # Borrow escalation from a worker on this node: the owner (head)
+        # must pin the object.
+        self._server.send_up(BorrowRetained([oid]))
+
 
 class NodeServer:
     """A joined cluster node: local NodeManager worker pool + data server,
@@ -919,6 +1061,15 @@ class NodeServer:
                  advertise_host: str = "127.0.0.1"):
         self.conn = Client(tuple(head_address), authkey=token)
         self._send_lock = threading.Lock()
+        self._head_address = tuple(head_address)
+        self._token = token
+        # Sequence-numbered redelivery, mirror of RemoteNodeProxy: every
+        # up-message carries (seq, ack-of-down); unacked entries resend
+        # after a same-identity rejoin.
+        self._up_seq = 0
+        self._up_ring: "deque" = _deque(maxlen=100_000)
+        self._up_ring_overflow = False
+        self._last_down = 0
 
         if num_tpus is None:
             from ..accelerators.tpu import TPUAcceleratorManager
@@ -940,6 +1091,7 @@ class NodeServer:
 
         from .node import NodeManager
 
+        self._reg_args = (node_resources, int(num_tpus or 0))
         self.conn.send(RegisterNode(socket.gethostname(), node_resources,
                                     int(num_tpus or 0), ("pending", 0),
                                     os_pid=os.getpid()))
@@ -966,6 +1118,10 @@ class NodeServer:
         self._log_monitor.start()
         self.node = NodeManager(info, self._rt,
                                 num_tpu_chips=int(num_tpus or 0))
+        # Cross-node direct channels: this node's workers authenticate
+        # with the cluster token and advertise a routable host.
+        self.node.direct_token = token
+        self.node.direct_host = advertise_host or "127.0.0.1"
         self.data_server = DataServer(self.node.store, token,
                                       advertise_host=advertise_host)
         self.data_address = self.data_server.address
@@ -1034,11 +1190,63 @@ class NodeServer:
     # -- control plumbing ----------------------------------------------------
 
     def send_up(self, msg) -> None:
-        try:
+        with self._send_lock:
+            self._up_seq += 1
+            frame = ("useq", self._up_seq, self._last_down, msg)
+            if len(self._up_ring) == self._up_ring.maxlen:
+                self._up_ring_overflow = True  # see _try_rejoin
+            self._up_ring.append(frame)
+            try:
+                self.conn.send(frame)
+            except (BrokenPipeError, OSError):
+                pass  # stays in the ring; resent after rejoin
+
+    def _try_rejoin(self) -> bool:
+        """Reconnect to the head under our existing node identity, keeping
+        the local plane (workers, running tasks, actors) alive.  Returns
+        False when the head refused (grace expired / head restarted) — the
+        caller tears down and rejoins fresh."""
+        if self._up_ring_overflow:
+            # Unacked up-frames were evicted: a same-identity rejoin
+            # would silently skip them — rejoin fresh instead.
+            return False
+        grace = max(float(Config.get("node_reconnect_grace_s")), 1.0)
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline and not self.stop_requested:
+            try:
+                conn = Client(self._head_address, authkey=self._token)
+                node_resources, num_tpus = self._reg_args
+                conn.send(RegisterNode(
+                    socket.gethostname(), node_resources, num_tpus,
+                    self.data_address, os_pid=os.getpid(),
+                    rejoin_node_id=self.node_id.binary(),
+                    last_down_seq=self._last_down))
+                ack = conn.recv()
+            except (ConnectionRefusedError, OSError, EOFError):
+                time.sleep(0.2)
+                continue
+            if not isinstance(ack, RegisterAck) or \
+                    ack.node_id_bytes != self.node_id.binary():
+                # Head forgot us (grace expired or restart): a fresh
+                # identity means a fresh local plane — reject here.
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                return False
             with self._send_lock:
-                self.conn.send(msg)
-        except (BrokenPipeError, OSError):
-            pass
+                self.conn = conn
+                # Drop what the head already processed; resend the tail.
+                while self._up_ring and \
+                        self._up_ring[0][1] <= ack.last_up_seq:
+                    self._up_ring.popleft()
+                for frame in list(self._up_ring):
+                    try:
+                        conn.send(frame)
+                    except (BrokenPipeError, OSError):
+                        break
+            return True
+        return False
 
     def node_rpc(self, method: str, *args, **kwargs):
         import queue
@@ -1063,9 +1271,17 @@ class NodeServer:
 
     def serve_forever(self) -> None:
         while not self._closed:
+            conn = self.conn
             try:
-                msg = self.conn.recv()
-            except (EOFError, OSError):
+                msg = conn.recv()
+            except (EOFError, OSError, TypeError, ValueError):
+                if self.stop_requested or self._closed:
+                    break
+                # Transient head drop: re-attach under the same identity
+                # so running work survives (retryable client semantics,
+                # reference: rpc/retryable_grpc_client.h).
+                if self._try_rejoin():
+                    continue
                 break
             try:
                 self._handle(msg)
@@ -1097,6 +1313,14 @@ class NodeServer:
         self.node.send_to_worker(msg.worker_id, inner)
 
     def _handle(self, msg) -> None:
+        if type(msg) is tuple and msg and msg[0] == "dseq":
+            _tag, seq, ack_up, msg = msg
+            with self._send_lock:
+                while self._up_ring and self._up_ring[0][1] <= ack_up:
+                    self._up_ring.popleft()
+            if seq <= self._last_down:
+                return  # duplicate from a resend overlap
+            self._last_down = seq
         if isinstance(msg, DispatchTask):
             self._dispatch_q.put(msg)
         elif isinstance(msg, ToWorker):

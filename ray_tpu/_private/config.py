@@ -153,6 +153,10 @@ D("health_check_period_s", float, 1.0,
   "gcs_health_check_manager.h timeouts).")
 D("health_check_failure_threshold", int, 5,
   "Consecutive missed probes before a node is declared dead.")
+D("node_reconnect_grace_s", float, 5.0,
+  "After a node's control connection drops, how long the head waits for "
+  "it to re-attach (same identity, tasks/actors kept) before running the "
+  "node-death fan-out (reference: raylet reconnect after GCS failover).")
 D("task_max_retries_default", int, 3, "Default retries for idempotent tasks.")
 D("actor_max_restarts_default", int, 0, "Default actor restarts.")
 D("enable_object_gc", bool, True,

@@ -48,8 +48,13 @@ def make_train_fn(total_steps: int, step_time: float):
 
 
 @pytest.fixture()
-def cluster():
-    c = Cluster(head_num_cpus=0)
+def cluster(monkeypatch):
+    # Elastic failover tests assert on PROMPT node-death handling; the
+    # reconnect grace window (node_reconnect_grace_s, test_reconnect.py)
+    # would let the collective-free toy train fn run to completion before
+    # the death fan-out fires, changing what the assertions measure.
+    monkeypatch.setenv("RAY_TPU_NODE_RECONNECT_GRACE_S", "0")
+    c = Cluster(head_num_cpus=0)  # init re-resolves Config from env
     yield c
     c.shutdown()
 
