@@ -128,6 +128,21 @@ def main() -> None:
         put_refs.extend(ray_tpu.put(big) for _ in range(n_big))
     timeit("single_client_put_gigabytes", big_puts, multiplier=gib)
 
+    # Context for the line above: a put is ONE memcpy into the arena, so
+    # the machine's single-thread copy bandwidth is the physical ceiling.
+    # Print it so vs_baseline (measured on different hardware) can be
+    # read honestly.
+    dst = np.empty_like(big)
+    np.copyto(dst, big)
+    t0 = time.perf_counter()
+    for _ in range(4):
+        np.copyto(dst, big)
+    ceiling = 4 * big.nbytes / (1 << 30) / (time.perf_counter() - t0)
+    print(json.dumps({
+        "benchmark": "hw_memcpy_ceiling", "value": round(ceiling, 2),
+        "unit": "GiB/s", "baseline": None, "vs_baseline": None,
+    }), flush=True)
+
     @ray_tpu.remote
     def slowish(i):
         return i

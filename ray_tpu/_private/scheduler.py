@@ -559,10 +559,15 @@ class ClusterScheduler:
             return self._ready_count + sum(
                 len(v) for v in self._waiting.values())
 
-    def pending_demand(self) -> List[Dict[str, float]]:
+    def pending_demand(self, include_pg_bundles: bool = True
+                       ) -> List[Dict[str, float]]:
         """Unplaced resource shapes (one entry per queued task) — the
         autoscaler's demand feed (reference: GcsAutoscalerStateManager
-        resource demand -> v2/scheduler.py bin-packing)."""
+        resource demand -> v2/scheduler.py bin-packing).
+
+        ``include_pg_bundles=False`` leaves pending placement-group
+        bundles out — gang-aware consumers take them atomically through
+        ``pending_gang_demand`` instead."""
         with self._lock:
             out: List[Dict[str, float]] = []
             for bucket in self._ready.values():
@@ -570,9 +575,37 @@ class ClusterScheduler:
                     out.append(t.spec.resources.to_dict())
             for t in self._infeasible:
                 out.append(t.spec.resources.to_dict())
+            if not include_pg_bundles:
+                return out
             pending_pg_shapes = []
             for pg in self._pending_pgs:
                 for b in pg.bundles:
                     if b.node_id is None:
                         pending_pg_shapes.append(b.resources.to_dict())
             return out + pending_pg_shapes
+
+    def pending_gang_demand(self) -> List[Tuple[str, List[Dict[str, float]],
+                                                List]]:
+        """Pending placement groups as atomic gangs: (strategy, [unplaced
+        bundle shapes], [node_ids already holding this PG's bundles]) per
+        pending PG.  A TPU slice reservation (SlicePlacementGroup ->
+        STRICT_SPREAD PG) is exactly such a gang: the autoscaler must
+        launch the whole multi-host node group or nothing, and spread
+        bundles can never land on nodes the PG already occupies
+        (reference: v2/scheduler.py:822 gang requests)."""
+        with self._lock:
+            out = []
+            for pg in self._pending_pgs:
+                shapes = [b.resources.to_dict() for b in pg.bundles
+                          if b.node_id is None]
+                placed = [b.node_id for b in pg.bundles
+                          if b.node_id is not None]
+                if shapes:
+                    out.append((pg.strategy, shapes, placed))
+            return out
+
+    def per_node_available(self) -> Dict[NodeID, Dict[str, float]]:
+        """Free resources per node (gang placement feasibility checks)."""
+        with self._lock:
+            return {nid: ns.available.to_dict()
+                    for nid, ns in self._nodes.items()}

@@ -82,9 +82,89 @@ class Autoscaler:
         pid = self.provider.create_node(name, ntc.resources)
         self._launched[pid] = (name, time.monotonic())
 
+    def _gang_launches(self, counts: Dict[str, int]) -> Dict[str, int]:
+        """Atomic multi-host gangs (pending slice/STRICT_SPREAD placement
+        groups): launch the WHOLE node group or nothing (reference:
+        v2/scheduler.py:822 gang resource requests).  Returns per-type
+        launch counts; partial gangs are never launched."""
+        gangs = self.runtime.scheduler.pending_gang_demand()
+        if not gangs:
+            return {}
+        # Launches in flight (created but not yet joined): wait for them
+        # to land before judging gang feasibility, or every tick would
+        # launch another full gang.
+        alive_nonhead = sum(
+            1 for n in self.runtime.controller.alive_nodes()
+            if not n.is_head)
+        if len(set(self.provider.non_terminated_nodes())
+               & set(self._launched)) > alive_nonhead:
+            return {}
+        per_node = self.runtime.scheduler.per_node_available()
+        to_launch: Dict[str, int] = {}
+        for strategy, shapes, placed_nodes in gangs:
+            if strategy == "STRICT_PACK":
+                # One node must hold every bundle: treat as a single
+                # summed shape.
+                total: Dict[str, float] = {}
+                for s in shapes:
+                    for k, v in s.items():
+                        total[k] = total.get(k, 0.0) + v
+                shapes = [total]
+                distinct = False
+            else:
+                # STRICT_SPREAD (the TPU-slice gang) and SPREAD want
+                # bundle-per-node; PACK tolerates co-location but a
+                # node-per-bundle launch always satisfies it.
+                distinct = strategy in ("STRICT_SPREAD", "SPREAD")
+            # Nodes already holding this PG's bundles can't take more of
+            # its spread bundles (mirrors the scheduler's used_nodes
+            # exclusion) — judging them free would deadlock a partially
+            # placed gang after a node loss.
+            occupied = set(placed_nodes)
+            free_nodes = [dict(v) for nid, v in per_node.items()
+                          if not distinct or nid not in occupied]
+            needed: List[Dict[str, float]] = []
+            for shape in shapes:
+                placed = False
+                for fn in free_nodes:
+                    if all(fn.get(k, 0.0) >= v for k, v in shape.items()):
+                        if distinct:
+                            free_nodes.remove(fn)
+                        else:
+                            for k, v in shape.items():
+                                fn[k] = fn.get(k, 0.0) - v
+                        placed = True
+                        break
+                if not placed:
+                    needed.append(shape)
+            if not needed:
+                continue  # scheduler will commit on its next retry
+            # All-or-nothing: find one type fitting every missing bundle
+            # with enough max_workers headroom for the full gang.
+            gang_type = None
+            for name, ntc in self.config.node_types.items():
+                if all(all(ntc.resources.get(k, 0.0) >= v
+                           for k, v in shape.items()) for shape in needed):
+                    have = counts.get(name, 0) + to_launch.get(name, 0)
+                    if have + len(needed) <= ntc.max_workers:
+                        gang_type = name
+                        break
+            if gang_type is None:
+                continue  # unplaceable gang stays pending (status surfaces)
+            to_launch[gang_type] = to_launch.get(gang_type, 0) + len(needed)
+        return to_launch
+
     def _reconcile(self) -> None:
-        demand = self.runtime.scheduler.pending_demand()
         counts = self._count_by_type()
+        # Gangs first: a pending slice reservation launches its whole
+        # node group atomically, before flat demand claims headroom.
+        gang_launch = self._gang_launches(counts)
+        for name, n in gang_launch.items():
+            counts[name] = counts.get(name, 0) + n
+            for _ in range(n):
+                self._launch(name, self.config.node_types[name])
+        demand = self.runtime.scheduler.pending_demand(
+            include_pg_bundles=False)
 
         # -- upscale: first-fit-decreasing bin-pack of unmet demand onto
         # node types (reference: v2/scheduler.py bin-packing). Capacity
@@ -149,6 +229,17 @@ class Autoscaler:
             for ast in rt._actors.values():
                 if ast.node_id is not None:
                     busy_nodes.add(ast.node_id)
+        # Nodes holding committed placement-group bundles are reserved
+        # capacity (a TPU slice), not idle: they only become terminable
+        # when the PG is removed — at which point the whole slice's nodes
+        # go idle together and drain as a unit.
+        from .._private.controller import PG_REMOVED
+        for pg in rt.controller.placement_groups.values():
+            if pg.state == PG_REMOVED:
+                continue
+            for b in pg.bundles:
+                if b.node_id is not None:
+                    busy_nodes.add(b.node_id)
 
         # Match provider nodes to runtime nodes by recency of launch: the
         # provider only knows pids; the runtime only knows node ids.  Idle

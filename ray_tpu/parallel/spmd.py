@@ -32,11 +32,15 @@ def batch_pspec(mesh, rules: Optional[ShardingRules] = None):
 
 def make_lm_train_step(cfg, mesh, *, rules: Optional[ShardingRules] = None,
                        optimizer=None, learning_rate: float = 3e-4,
-                       donate: bool = True):
+                       donate: bool = True, param_dtype=None):
     """Build (init_fn, step_fn) for a models.llama LM on ``mesh``.
 
     init_fn(key) -> (params, opt_state) already sharded.
     step_fn(params, opt_state, batch) -> (params, opt_state, metrics).
+
+    ``param_dtype`` overrides parameter (and hence optimizer-state)
+    storage: bfloat16 halves the adamw footprint so ~1.5B params fit one
+    v5e chip with remat (HBM budget: params+m+v at 2 bytes each).
     """
     import jax
     import optax
@@ -63,7 +67,8 @@ def make_lm_train_step(cfg, mesh, *, rules: Optional[ShardingRules] = None,
     bsharding = NamedSharding(mesh, bspec)
 
     def init_all(key):
-        params = L.init_params(cfg, key)
+        params = L.init_params(cfg, key) if param_dtype is None else \
+            L.init_params(cfg, key, param_dtype=param_dtype)
         opt_state = optimizer.init(params)
         return params, opt_state
 

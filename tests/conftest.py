@@ -56,9 +56,10 @@ import pytest  # noqa: E402
 _SLOW_MODULES = {
     "test_7b_shapes", "test_models", "test_ops", "test_pipeline",
     "test_llm", "test_rl", "test_rl_breadth", "test_train",
-    "test_train_elastic", "test_collective", "test_dag", "test_tune",
-    "test_chaos", "test_recovery", "test_oom", "test_serve_ha",
-    "test_runtime_env", "test_autoscaler", "test_head_ft",
+    "test_train_elastic", "test_train_multislice", "test_collective",
+    "test_dag", "test_tune", "test_chaos", "test_recovery", "test_oom",
+    "test_serve_ha", "test_runtime_env", "test_autoscaler", "test_head_ft",
+    "test_reconnect",
 }
 
 # Fast representatives inside slow modules so the quick tier still touches

@@ -108,3 +108,33 @@ class TestAutoscaler:
         finally:
             asc.stop()
             provider.shutdown()
+
+    def test_slice_gang_scales_whole_group_atomically(self, head):
+        """A pending 2-host slice reservation (STRICT_SPREAD PG) launches
+        exactly its node group — whole gang, nothing partial — and the PG
+        commits once both join (reference: v2/scheduler.py:822 gang
+        resource requests for multi-host TPU slices)."""
+        provider, asc = _make(head, {
+            "slice-host": NodeTypeConfig(
+                resources={"CPU": 2, "slice_host": 1}, max_workers=4)})
+        try:
+            pg = ray_tpu.placement_group(
+                [{"CPU": 2, "slice_host": 1},
+                 {"CPU": 2, "slice_host": 1}],
+                strategy="STRICT_SPREAD")
+            assert pg.ready(timeout=120)
+            # Exactly the gang size was launched: no partial fills, no
+            # per-tick relaunch storm while the two nodes were joining.
+            assert len(provider.non_terminated_nodes()) == 2
+            # The reserved (but idle) slice is protected from idle
+            # downscale until the reservation is dropped.
+            asc.config.idle_timeout_s = 0.5
+            time.sleep(2.0)
+            assert len(provider.non_terminated_nodes()) == 2
+            ray_tpu.remove_placement_group(pg)
+            assert _wait(
+                lambda: len(provider.non_terminated_nodes()) == 0,
+                timeout=60)
+        finally:
+            asc.stop()
+            provider.shutdown()
