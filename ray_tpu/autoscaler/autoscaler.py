@@ -90,15 +90,26 @@ class Autoscaler:
         gangs = self.runtime.scheduler.pending_gang_demand()
         if not gangs:
             return {}
-        # Launches in flight (created but not yet joined): wait for them
-        # to land before judging gang feasibility, or every tick would
-        # launch another full gang.
-        alive_nonhead = sum(
-            1 for n in self.runtime.controller.alive_nodes()
-            if not n.is_head)
-        if len(set(self.provider.non_terminated_nodes())
-               & set(self._launched)) > alive_nonhead:
-            return {}
+        # Launches in flight (created by US but not yet registered with
+        # the runtime, matched by OS pid): wait for them to land before
+        # judging gang feasibility, or every tick would launch another
+        # full gang.  Nodes that never join stop blocking after a
+        # timeout (spawn failure), and foreign/manual nodes are ignored.
+        joined_os_pids = set()
+        for n in self.runtime.controller.alive_nodes():
+            try:
+                joined_os_pids.add(int(n.labels.get("os_pid", 0)))
+            except (TypeError, ValueError):
+                pass
+        get_pid = getattr(self.provider, "node_os_pid", None)
+        live = set(self.provider.non_terminated_nodes())
+        now = time.monotonic()
+        for pid, (_ntype, ts) in self._launched.items():
+            if pid not in live or now - ts > 120.0:
+                continue
+            os_pid = get_pid(pid) if get_pid else None
+            if os_pid is not None and os_pid not in joined_os_pids:
+                return {}  # a launch is still joining; don't double-buy
         per_node = self.runtime.scheduler.per_node_available()
         to_launch: Dict[str, int] = {}
         for strategy, shapes, placed_nodes in gangs:

@@ -97,6 +97,13 @@ class InferenceEngine:
         self._decode = jax.jit(
             partial(_model.decode_step, cfg=cfg, page_size=page_size),
             donate_argnums=(1, 2))
+        self._decode_chunk = None
+        self._chunk_cache: Dict = {}        # (steps, temp, top_k) -> jit fn
+        self._chunk_key = jax.random.key(0)
+        # Device-resident (tokens, positions) between chunks: valid while
+        # no admission/finish mutated the host mirrors, so back-to-back
+        # chunks skip the host->device upload round-trips entirely.
+        self._dev_state = None
         self._prefills = {
             b: jax.jit(partial(_model.prefill, cfg=cfg),
                        static_argnums=())
@@ -122,15 +129,22 @@ class InferenceEngine:
     # -- scheduling ---------------------------------------------------------
 
     def _admit(self) -> None:
-        """Move waiting requests into free slots (prefill + page alloc)."""
-        jnp = self._jnp
-        from . import _model
+        """Move waiting requests into free slots (prefill + page alloc).
 
+        Host work is batched: every admitted request's last-position
+        logits stay on device through the loop and transfer in ONE
+        device->host sync at the end — per-request readbacks would pay
+        the full host<->device latency once per admission (reference
+        analog: batched prefill scheduling)."""
+        jnp = self._jnp
+        from . import _model  # noqa: F401  (prefill fns built in __init__)
+
+        staged: List = []  # (req, slot, device_logits)
         while self.waiting:
             free_slots = [i for i in range(self.max_slots)
                           if not self.slot_active[i]]
             if not free_slots:
-                return
+                break
             req = self.waiting[0]
             n = len(req.prompt_tokens)
             total = n + req.params.max_tokens
@@ -161,7 +175,7 @@ class InferenceEngine:
                 continue
             pages = self.pool.alloc(n_pages)
             if pages is None:
-                return  # no KV memory; stay queued (backpressure)
+                break  # no KV memory; stay queued (backpressure)
             self.waiting.pop(0)
             slot = free_slots[0]
 
@@ -183,17 +197,27 @@ class InferenceEngine:
             self.v_pages = self.v_pages.at[:, :, page_ids, offs, :].set(
                 vv_val.astype(self.v_pages.dtype))
 
-            first_tok = self._sample_host(np.asarray(logits), req.params)
-            req.output_tokens.append(int(first_tok))
+            # Mark the slot taken now; the first token lands after the
+            # batched sync below.
             req.slot = slot
             req.pages = pages
             self.slot_req[slot] = req
             self.slot_active[slot] = True
-            self.slot_tokens[slot] = first_tok
             self.slot_pos[slot] = n
             bt = np.zeros((self.pages_per_seq,), np.int32)
             bt[:n_pages] = pages
             self.block_tables[slot] = bt
+            staged.append((req, slot, logits))
+
+        if not staged:
+            return
+        self._dev_state = None  # new slots: host mirrors are authoritative
+        all_logits = np.asarray(self._jax.numpy.stack(
+            [lg for _r, _s, lg in staged]))       # ONE host sync
+        for (req, slot, _lg), logits in zip(staged, all_logits):
+            first_tok = self._sample_host(logits, req.params)
+            req.output_tokens.append(int(first_tok))
+            self.slot_tokens[slot] = first_tok
             self._maybe_finish(req, int(first_tok))
             if req.finished:
                 self._admission_finished.append(req)
@@ -260,6 +284,7 @@ class InferenceEngine:
             self._admission_finished.clear()
             if not any(self.slot_active):
                 return finished
+            self._dev_state = None  # per-token path mutates host mirrors
             logits, self.k_pages, self.v_pages = self._decode(
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(self.slot_tokens), jnp.asarray(self.slot_pos),
@@ -277,6 +302,90 @@ class InferenceEngine:
                 self._maybe_finish(req, tok)
                 if req.finished:
                     finished.append(req)
+            return finished
+
+    def step_chunk(self, max_steps: int = 32) -> List[Request]:
+        """Admit + up to ``max_steps`` decode iterations in ONE device
+        program with on-device sampling (_model.decode_chunk): the host
+        syncs once per chunk instead of once per token, which keeps
+        decode compute-bound even when host<->device latency is large
+        (reference analog: vLLM multi-step scheduling).
+
+        Used when every active request shares compatible sampling params
+        (the common serving case); falls back to per-token step()
+        otherwise.  Stop tokens/budgets are enforced host-side after the
+        chunk — the bounded overgeneration is the price of the batching.
+        """
+        jnp = self._jnp
+        from . import _model
+
+        with self._lock:
+            self._admit()
+            finished = list(self._admission_finished)
+            self._admission_finished.clear()
+            active_reqs = [self.slot_req[s] for s in range(self.max_slots)
+                           if self.slot_active[s]]
+            if not active_reqs:
+                return finished
+            sp0 = active_reqs[0].params
+            if any(r.params.temperature != sp0.temperature
+                   or r.params.top_k != sp0.top_k for r in active_reqs):
+                return finished + self.step()
+            # Cap the chunk so no request overruns its token budget or
+            # page allocation, then round DOWN to a power of two: the
+            # compiled-program set stays tiny (log2(max_steps) shapes,
+            # dict-cached) instead of recompiling the scanned model for
+            # every distinct remaining-budget value.
+            steps = min([max_steps] + [
+                r.params.max_tokens - len(r.output_tokens)
+                for r in active_reqs])
+            if steps <= 0:
+                return finished + self.step()
+            steps = 1 << (steps.bit_length() - 1)
+            shape_key = (steps, sp0.temperature, sp0.top_k)
+            fn = self._chunk_cache.get(shape_key)
+            if fn is None:
+                from functools import partial
+                fn = self._jax.jit(
+                    partial(_model.decode_chunk, cfg=self.cfg,
+                            page_size=self.page_size, steps=steps,
+                            temperature=sp0.temperature, top_k=sp0.top_k),
+                    donate_argnums=(1, 2))
+                self._chunk_cache[shape_key] = fn
+            self._decode_chunk = fn
+            self._chunk_key, key = self._jax.random.split(self._chunk_key)
+            if self._dev_state is not None:
+                toks_dev, pos_dev = self._dev_state
+            else:
+                toks_dev = jnp.asarray(self.slot_tokens)
+                pos_dev = jnp.asarray(self.slot_pos)
+            out, new_pos, self.k_pages, self.v_pages = self._decode_chunk(
+                self.params, self.k_pages, self.v_pages,
+                toks_dev, pos_dev, jnp.asarray(self.block_tables),
+                jnp.asarray(self.slot_active), key)
+            # Next chunk can resume from device state (last sampled token
+            # per slot + advanced positions) with no host upload.
+            self._dev_state = (out[-1], new_pos)
+            out = np.asarray(out)                       # ONE host sync
+            any_finished = False
+            for slot in range(self.max_slots):
+                if not self.slot_active[slot]:
+                    continue
+                req = self.slot_req[slot]
+                for i in range(steps):
+                    tok = int(out[i, slot])
+                    req.output_tokens.append(tok)
+                    self.slot_pos[slot] += 1
+                    self.slot_tokens[slot] = tok
+                    self._maybe_finish(req, tok)
+                    if req.finished:
+                        # Overgenerated tail beyond a stop token is
+                        # dropped with the request.
+                        finished.append(req)
+                        any_finished = True
+                        break
+            if any_finished:
+                self._dev_state = None  # host mirrors changed
             return finished
 
     # -- offline batch API --------------------------------------------------
