@@ -613,9 +613,16 @@ class NodeManager:
                 t.start()
                 return
             # Always overwrite: a retried task must see its fresh grant,
-            # not the first attempt's chips.
-            env_vars[Config.get("visible_accelerator_env")] = \
-                ",".join(str(c) for c in grant)
+            # not the first attempt's chips.  The pinning env comes from
+            # the accelerator plugin (accelerators/accelerator.py); the
+            # config override supports tests faking the env name.
+            env_name = Config.get("visible_accelerator_env")
+            from ..accelerators.accelerator import get_accelerator
+            mgr = get_accelerator("TPU")
+            if mgr is not None and env_name == "TPU_VISIBLE_CHIPS":
+                env_vars.update(mgr.visibility_env(grant))
+            else:
+                env_vars[env_name] = ",".join(str(c) for c in grant)
         if target_worker is not None:
             with self._lock:
                 handle = self._workers.get(target_worker)

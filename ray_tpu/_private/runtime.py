@@ -193,6 +193,19 @@ class Runtime:
             marker = TPUAcceleratorManager.slice_head_resource_name()
             if marker:
                 node_resources[marker] = 1.0
+        # Other registered accelerator plugins advertise their chips too
+        # (reference: the per-vendor manager loop in
+        # _private/accelerators/__init__.py).
+        from ..accelerators.accelerator import all_accelerators
+        for mgr in all_accelerators():
+            if mgr.resource_name in node_resources:
+                continue
+            try:
+                n = mgr.detect_num_chips()
+            except Exception:
+                n = 0
+            if n:
+                node_resources[mgr.resource_name] = float(n)
         if resources:
             node_resources.update(resources)
 

@@ -20,6 +20,7 @@ import re
 from typing import Dict, List, Optional
 
 from .._private.config import Config
+from .accelerator import AcceleratorManager, register_accelerator
 
 # Generation -> default chips per host for common slices (reference:
 # tpu.py:237 per-generation logic).
@@ -34,8 +35,12 @@ MEGASCALE_NUM_SLICES_ENV = "MEGASCALE_NUM_SLICES"
 MEGASCALE_SLICE_ID_ENV = "MEGASCALE_SLICE_ID"
 
 
-class TPUAcceleratorManager:
+class TPUAcceleratorManager(AcceleratorManager):
     resource_name = "TPU"
+
+    @staticmethod
+    def visibility_env(chip_ids: List[int]) -> Dict[str, str]:
+        return {TPU_VISIBLE_CHIPS_ENV: ",".join(str(c) for c in chip_ids)}
 
     @staticmethod
     def detect_num_chips() -> int:
@@ -103,7 +108,7 @@ class TPUAcceleratorManager:
 
     @staticmethod
     def set_visible_chips(chip_ids: List[int]) -> None:
-        os.environ[TPU_VISIBLE_CHIPS_ENV] = ",".join(str(c) for c in chip_ids)
+        os.environ.update(TPUAcceleratorManager.visibility_env(chip_ids))
 
     @staticmethod
     def get_current_process_visible_chips() -> Optional[List[int]]:
@@ -125,3 +130,6 @@ def get_tpu_coordinator_env_vars(slice_id: int, num_slices: int,
         MEGASCALE_NUM_SLICES_ENV: str(num_slices),
         MEGASCALE_SLICE_ID_ENV: str(slice_id),
     }
+
+
+register_accelerator(TPUAcceleratorManager)

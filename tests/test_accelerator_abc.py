@@ -1,0 +1,58 @@
+"""Accelerator plugin ABC (reference analog:
+python/ray/tests/accelerators/ over accelerators/accelerator.py:16)."""
+
+import pytest
+
+
+class TestAcceleratorRegistry:
+    def test_tpu_registered_and_conforms(self):
+        from ray_tpu.accelerators.accelerator import (AcceleratorManager,
+                                                      all_accelerators,
+                                                      get_accelerator)
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+        assert get_accelerator("TPU") is TPUAcceleratorManager
+        assert TPUAcceleratorManager in all_accelerators()
+        assert issubclass(TPUAcceleratorManager, AcceleratorManager)
+        env = TPUAcceleratorManager.visibility_env([0, 2])
+        assert env["TPU_VISIBLE_CHIPS"] == "0,2"
+        assert isinstance(TPUAcceleratorManager.detect_num_chips(), int)
+
+    def test_custom_accelerator_plugs_in(self):
+        from ray_tpu.accelerators.accelerator import (AcceleratorManager,
+                                                      get_accelerator,
+                                                      register_accelerator)
+
+        class FakeNPU(AcceleratorManager):
+            resource_name = "NPU"
+
+            @staticmethod
+            def detect_num_chips() -> int:
+                return 2
+
+            @staticmethod
+            def visibility_env(chip_ids):
+                return {"NPU_VISIBLE": ",".join(map(str, chip_ids))}
+
+        register_accelerator(FakeNPU)
+        try:
+            assert get_accelerator("NPU") is FakeNPU
+            assert FakeNPU.detect_num_chips() == 2
+        finally:
+            from ray_tpu.accelerators import accelerator as mod
+            mod._REGISTRY.pop("NPU", None)
+
+    def test_unnamed_manager_rejected(self):
+        from ray_tpu.accelerators.accelerator import (AcceleratorManager,
+                                                      register_accelerator)
+
+        class Bad(AcceleratorManager):
+            @staticmethod
+            def detect_num_chips() -> int:
+                return 0
+
+            @staticmethod
+            def visibility_env(chip_ids):
+                return {}
+
+        with pytest.raises(ValueError):
+            register_accelerator(Bad)
