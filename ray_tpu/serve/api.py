@@ -625,23 +625,16 @@ def shutdown() -> None:
 # HTTP ingress (reference: _private/proxy.py; aiohttp instead of uvicorn)
 # --------------------------------------------------------------------- #
 
-class _HttpServer:
-    def __init__(self, port: int):
-        self.port = port
-        self._thread = threading.Thread(target=self._serve, daemon=True)
-        self._started = threading.Event()
-        self._runner = None
-        self._loop = None
-        self._thread.start()
-        if not self._started.wait(10):
-            raise RuntimeError("serve http ingress failed to start")
+def build_ingress_app():
+    """The ingress aiohttp application: POST /{deployment} routes the
+    JSON body through a deployment handle (chunked ndjson when
+    ``stream`` is set).  Shared by the in-process _HttpServer and the
+    per-node ProxyActor (serve/proxy.py)."""
+    import asyncio
 
-    def _serve(self):
-        import asyncio
+    from aiohttp import web
 
-        from aiohttp import web
-
-        async def handle(request: "web.Request"):
+    async def handle(request: "web.Request"):
             import json as _json
             name = request.match_info["deployment"]
             try:
@@ -691,15 +684,41 @@ class _HttpServer:
             except Exception as e:  # noqa: BLE001
                 return web.json_response({"error": repr(e)}, status=500)
 
+    app = web.Application()
+    app.router.add_post("/{deployment}", handle)
+    app.router.add_get("/-/healthz",
+                       lambda r: web.Response(text="ok"))
+    return app
+
+
+class _HttpServer:
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self.port = port
+        self.host = host
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._started = threading.Event()
+        self._runner = None
+        self._loop = None
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("serve http ingress failed to start")
+
+    def _serve(self):
+        import asyncio
+
+        from aiohttp import web
+
         async def main():
-            app = web.Application()
-            app.router.add_post("/{deployment}", handle)
-            app.router.add_get("/-/healthz",
-                               lambda r: web.Response(text="ok"))
+            app = build_ingress_app()
             runner = web.AppRunner(app)
             await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", self.port)
+            site = web.TCPSite(runner, self.host, self.port)
             await site.start()
+            try:
+                # Ephemeral bind (port 0): record the real port.
+                self.port = site._server.sockets[0].getsockname()[1]
+            except Exception:
+                pass
             self._runner = runner
             self._started.set()
             while True:

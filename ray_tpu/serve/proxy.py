@@ -1,0 +1,182 @@
+"""Per-node Serve proxies: HTTP + gRPC ingress actors.
+
+The reference runs an HTTP/gRPC ProxyActor on every node so ingress
+scales with the cluster and survives any single serving process
+(reference: python/ray/serve/_private/proxy.py:601 HTTPProxy, :1084
+gRPCProxy, :1633 per-node actor startup).  Here ``start_node_proxies``
+places one ProxyActor per alive node (node-affinity scheduling); each
+serves:
+
+- HTTP: the shared ingress aiohttp app (api.build_ingress_app) — POST
+  /{deployment} with a JSON body, chunked ndjson when streaming.
+- gRPC: a proto-free generic service: call method
+  ``/ray_tpu.serve/<deployment>`` with a JSON-encoded request message;
+  the reply is JSON bytes.  A server-streaming variant
+  ``/ray_tpu.serve.stream/<deployment>`` yields one JSON message per
+  generator item.  (Schema-free by design: the pickle-native framework
+  has no proto layer to hang typed stubs from; the reference's typed
+  gRPC ingress is driven by user-supplied protos.)
+
+Requests route through the same pow-2 deployment routers every process
+uses, riding the direct worker->worker actor channels to replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import ray_tpu
+
+PROXY_NAME_PREFIX = "SERVE_PROXY"
+NAMESPACE = "serve"
+
+
+class _ProxyImpl:
+    """Runs inside the proxy actor's worker process."""
+
+    def __init__(self, http_port: int, grpc_port: int):
+        from . import api as serve_api
+
+        self._http = serve_api._HttpServer(http_port, host="0.0.0.0") \
+            if http_port >= 0 else None
+        self.http_port = self._http.port if self._http else None
+        self.grpc_port: Optional[int] = None
+        self._grpc = None
+        if grpc_port >= 0:
+            self._grpc = self._start_grpc(grpc_port)
+
+    def _start_grpc(self, port: int):
+        import grpc
+
+        from . import api as serve_api
+
+        class GenericIngress(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                method = call_details.method  # /ray_tpu.serve/<name>
+                parts = method.strip("/").split("/", 1)
+                if len(parts) != 2 or not parts[0].startswith(
+                        "ray_tpu.serve"):
+                    return None
+                service_name, deployment = parts
+                streaming = service_name.endswith(".stream")
+
+                def unary(request: bytes, ctx):
+                    try:
+                        body = json.loads(request or b"{}")
+                        h = serve_api.get_deployment_handle(deployment)
+                        result = ray_tpu.get(h.remote(body), timeout=300)
+                        return json.dumps({"result": result}).encode()
+                    except Exception as e:  # noqa: BLE001
+                        ctx.set_code(grpc.StatusCode.INTERNAL)
+                        ctx.set_details(repr(e))
+                        return b"{}"
+
+                def stream(request: bytes, ctx):
+                    try:
+                        body = json.loads(request or b"{}")
+                        h = serve_api.get_deployment_handle(
+                            deployment).options(stream=True)
+                        for item_ref in h.remote(body):
+                            item = ray_tpu.get(item_ref, timeout=300)
+                            yield json.dumps({"result": item}).encode()
+                    except Exception as e:  # noqa: BLE001
+                        ctx.set_code(grpc.StatusCode.INTERNAL)
+                        ctx.set_details(repr(e))
+
+                if streaming:
+                    return grpc.stream_stream_rpc_method_handler(
+                        lambda req_iter, ctx: stream(next(req_iter), ctx))
+                return grpc.unary_unary_rpc_method_handler(unary)
+
+        from concurrent.futures import ThreadPoolExecutor
+        server = grpc.server(ThreadPoolExecutor(max_workers=8))
+        server.add_generic_rpc_handlers((GenericIngress(),))
+        bound = server.add_insecure_port(f"0.0.0.0:{port}")
+        if bound == 0:
+            raise RuntimeError(f"grpc ingress failed to bind port {port}")
+        self.grpc_port = bound
+        server.start()
+        return server
+
+    def addresses(self) -> Dict[str, Optional[int]]:
+        return {"http_port": self.http_port, "grpc_port": self.grpc_port}
+
+    def shutdown(self) -> None:
+        if self._http is not None:
+            self._http.stop()
+        if self._grpc is not None:
+            self._grpc.stop(grace=1.0)
+
+
+@ray_tpu.remote
+class ProxyActor:
+    """One ingress endpoint, pinned to its node (reference:
+    proxy.py:1633 — a proxy actor per node, named per node id)."""
+
+    def __init__(self, http_port: int = 0, grpc_port: int = 0):
+        self._impl = _ProxyImpl(http_port, grpc_port)
+
+    def addresses(self) -> Dict[str, Optional[int]]:
+        return self._impl.addresses()
+
+    def ping(self) -> str:
+        return "ok"
+
+    def shutdown(self) -> None:
+        self._impl.shutdown()
+
+
+def start_node_proxies(http_port: int = 0, grpc_port: int = 0,
+                       ) -> Dict[str, Dict[str, Optional[int]]]:
+    """Start (idempotently) one ProxyActor per alive node; returns
+    {node_id_hex: {"http_port": ..., "grpc_port": ...}}.  Ports of 0 bind
+    ephemerally (per node); -1 disables that protocol."""
+    from .._private.api import _control
+    from ray_tpu import NodeAffinitySchedulingStrategy
+
+    out: Dict[str, Dict[str, Optional[int]]] = {}
+    for node in _control("nodes"):
+        if not node.get("alive", True):
+            continue
+        hexid = node["node_id"] if isinstance(node["node_id"], str) \
+            else node["node_id"].hex()
+        name = f"{PROXY_NAME_PREFIX}:{hexid}"
+        existing = _control("get_named_actor", name, NAMESPACE)
+        if existing is not None:
+            from .._private.api import ActorHandle
+            from .._private.ids import ActorID
+            h = ActorHandle(ActorID(existing[0]), existing[2])
+        else:
+            h = ProxyActor.options(
+                name=name, namespace=NAMESPACE,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    _node_id_from_hex(hexid), soft=False),
+            ).remote(http_port, grpc_port)
+        out[hexid] = ray_tpu.get(h.addresses.remote(), timeout=120)
+    return out
+
+
+def _node_id_from_hex(hexid: str):
+    from .._private.ids import NodeID
+    return NodeID(bytes.fromhex(hexid))
+
+
+def stop_node_proxies() -> None:
+    from .._private.api import _control
+    for node in _control("nodes"):
+        hexid = node["node_id"] if isinstance(node["node_id"], str) \
+            else node["node_id"].hex()
+        existing = _control("get_named_actor",
+                            f"{PROXY_NAME_PREFIX}:{hexid}", NAMESPACE)
+        if existing is None:
+            continue
+        from .._private.api import ActorHandle
+        from .._private.ids import ActorID
+        h = ActorHandle(ActorID(existing[0]), existing[2])
+        try:
+            ray_tpu.get(h.shutdown.remote(), timeout=30)
+            ray_tpu.kill(h)
+        except Exception:
+            pass
