@@ -2,14 +2,16 @@
 equivalent)."""
 
 from .block import Block, BlockAccessor
-from .dataset import (Dataset, GroupedDataset, from_items, from_numpy,
-                      from_pandas, range, read_binary_files, read_csv,
-                      read_images, read_json, read_parquet, read_tfrecord)
+from .dataset import (Dataset, GroupedDataset, from_arrow, from_items,
+                      from_numpy, from_pandas, range, read_binary_files,
+                      read_csv, read_images, read_json, read_parquet,
+                      read_tfrecord)
 from .iterator import device_put_iterator, iter_batches
 
 __all__ = [
     "Dataset", "GroupedDataset", "Block", "BlockAccessor", "range",
-    "from_items", "from_numpy", "from_pandas", "read_parquet", "read_csv",
+    "from_arrow", "from_items", "from_numpy", "from_pandas",
+    "read_parquet", "read_csv",
     "read_binary_files", "read_images", "read_tfrecord",
     "read_json", "iter_batches", "device_put_iterator",
 ]

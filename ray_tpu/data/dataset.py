@@ -76,6 +76,18 @@ class Dataset:
             {c: df[c].to_numpy() for c in df.columns}, parallelism)
 
     @staticmethod
+    def from_arrow(tables, parallelism: int = 8) -> "Dataset":
+        """One or more pyarrow Tables -> Dataset (reference:
+        ray.data.from_arrow / from_arrow_refs).  A single table splits
+        into ``parallelism`` blocks; a list maps table-per-block —
+        numeric columns convert zero-copy."""
+        if not isinstance(tables, (list, tuple)):
+            return Dataset.from_numpy(
+                BlockAccessor.from_arrow(tables), parallelism)
+        blocks = [BlockAccessor.from_arrow(t) for t in tables]
+        return Dataset(blocks, [], max(1, len(blocks)))
+
+    @staticmethod
     def read_parquet(paths: Union[str, List[str]],
                      parallelism: int = 8) -> "Dataset":
         import glob as g
@@ -356,17 +368,52 @@ class Dataset:
         return BlockAccessor(
             BlockAccessor.concat(self._blocks())).to_pandas()
 
+    def to_arrow_refs(self) -> List[Any]:
+        """Execute the plan and return ObjectRefs of pyarrow Tables —
+        the zero-copy hand-off to Arrow-native host pipelines (reference:
+        Dataset.to_arrow_refs)."""
+        import ray_tpu
+
+        from . import executor
+
+        def to_table(block_or_read):
+            block = executor._apply_chain([], block_or_read)
+            return BlockAccessor(block).to_arrow()
+
+        if not ray_tpu.is_initialized():
+            # Driver-local fallback, like every other consumption path.
+            return [to_table(b) for b in executor.execute_streaming(self)]
+        conv = ray_tpu.remote(to_table)
+        out = []
+        for b in executor.execute_streaming(self):
+            if isinstance(b, ray_tpu.ObjectRef) \
+                    or executor._is_read_marker(b):
+                out.append(conv.remote(b))
+            else:
+                out.append(ray_tpu.put(BlockAccessor(b).to_arrow()))
+        return out
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for b in self._blocks():
             yield from BlockAccessor(b).iter_rows()
 
     def iter_batches(self, *, batch_size: int = 256,
                      drop_last: bool = False,
-                     shuffle_seed: Optional[int] = None
-                     ) -> Iterator[Block]:
+                     shuffle_seed: Optional[int] = None,
+                     batch_format: str = "numpy") -> Iterator[Block]:
+        """``batch_format``: "numpy" (dict of ndarrays, the device-feed
+        format), "pyarrow" (Tables), or "pandas" (DataFrames) —
+        reference: iter_batches batch_format."""
         from .iterator import iter_batches
-        return iter_batches(self, batch_size=batch_size,
-                            drop_last=drop_last, shuffle_seed=shuffle_seed)
+        it = iter_batches(self, batch_size=batch_size,
+                          drop_last=drop_last, shuffle_seed=shuffle_seed)
+        if batch_format == "numpy":
+            return it
+        if batch_format == "pyarrow":
+            return (BlockAccessor(b).to_arrow() for b in it)
+        if batch_format == "pandas":
+            return (BlockAccessor(b).to_pandas() for b in it)
+        raise ValueError(f"unknown batch_format {batch_format!r}")
 
     def split(self, n: int) -> List["Dataset"]:
         """Split into n datasets by row count (for per-worker shards;
@@ -413,6 +460,10 @@ def from_numpy(arrays, parallelism: int = 8) -> Dataset:
 
 def from_pandas(df, parallelism: int = 8) -> Dataset:
     return Dataset.from_pandas(df, parallelism)
+
+
+def from_arrow(tables, parallelism: int = 8) -> Dataset:
+    return Dataset.from_arrow(tables, parallelism)
 
 
 def read_parquet(paths, parallelism: int = 8) -> Dataset:

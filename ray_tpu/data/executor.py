@@ -163,9 +163,12 @@ def execute_streaming(ds, ordered: bool = True) -> Iterator[Any]:
     yield from blocks
 
 
+def _is_read_marker(b) -> bool:
+    return isinstance(b, tuple) and len(b) == 3 and b[0] == "__read__"
+
+
 def _has_read_markers(blocks: List[Any]) -> bool:
-    return any(isinstance(b, tuple) and len(b) == 3 and b[0] == "__read__"
-               for b in blocks)
+    return any(_is_read_marker(b) for b in blocks)
 
 
 def _stream_fused(blocks: List[Any], fns: List[Callable],
