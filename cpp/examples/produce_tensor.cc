@@ -1,0 +1,37 @@
+// Native data producer: writes two tensors into a shm segment that
+// Python maps zero-copy (ray_tpu.util.cpp_io.import_tensors) and feeds
+// to jax.device_put — the native-loader half of the IO path.
+//
+//   g++ -std=c++17 -O2 -Icpp/include cpp/examples/produce_tensor.cc \
+//       -o produce_tensor -lrt
+//   ./produce_tensor /my_batch 8
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "ray_tpu/tensor_writer.hpp"
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <segment> <batch>\n", argv[0]);
+    return 2;
+  }
+  const std::string segment = argv[1];
+  const uint64_t batch = std::strtoull(argv[2], nullptr, 10);
+
+  ray_tpu::TensorWriter w(segment);
+  size_t x = w.add(ray_tpu::F32, {batch, 16});
+  size_t y = w.add(ray_tpu::I32, {batch});
+
+  auto *xs = reinterpret_cast<float *>(w.data(x));
+  for (uint64_t i = 0; i < batch * 16; ++i) {
+    xs[i] = static_cast<float>(i) * 0.5f;
+  }
+  auto *ys = reinterpret_cast<int32_t *>(w.data(y));
+  for (uint64_t i = 0; i < batch; ++i) {
+    ys[i] = static_cast<int32_t>(i * i);
+  }
+  w.finish();
+  std::printf("wrote %s\n", segment.c_str());
+  return 0;
+}

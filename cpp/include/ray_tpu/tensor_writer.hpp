@@ -1,0 +1,163 @@
+// ray_tpu C++ user API: zero-copy tensor hand-off INTO Python.
+//
+// Reference analog: the C++ user API's ray::Put over the plasma client —
+// the producer side of the data plane.  A C++ program (a native data
+// loader, a feature pipeline) writes tensors into a POSIX shared-memory
+// segment with a small typed header; Python maps them with
+// `ray_tpu.util.cpp_io.import_tensors(name)` as zero-copy numpy views
+// ready for `jax.device_put` (or `ray_tpu.put` to register them in the
+// object store).
+//
+// Segment layout (all little endian; see util/cpp_io.py, the other end):
+//
+//   u32 magic = 0x52545054 ("RTPT")
+//   u32 n_tensors
+//   n_tensors x {
+//     u32 dtype_code        (0=f32 1=f64 2=i32 3=i64 4=u8 5=i8 6=u16
+//                            7=i16 8=u32 9=u64 10=f16 11=bf16 12=bool)
+//     u32 ndim
+//     u64 shape[ndim]
+//     u64 nbytes
+//     u64 data_offset       (absolute, 64-byte aligned)
+//   }
+//   ... tensor bytes at their offsets ...
+//
+// Usage:
+//   ray_tpu::TensorWriter w("/my_batch");       // shm segment name
+//   w.add(ray_tpu::F32, {batch, 224, 224, 3});  // returns writable ptr
+//   std::memcpy(w.data(0), pixels, w.nbytes(0));
+//   w.finish();                                  // header + msync
+//
+// Compile: C++17, -lrt on Linux.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <stdexcept>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace ray_tpu {
+
+enum DType : uint32_t {
+  F32 = 0, F64 = 1, I32 = 2, I64 = 3, U8 = 4, I8 = 5, U16 = 6,
+  I16 = 7, U32 = 8, U64 = 9, F16 = 10, BF16 = 11, BOOL = 12,
+};
+
+inline uint64_t dtype_size(DType d) {
+  switch (d) {
+    case F64: case I64: case U64: return 8;
+    case F32: case I32: case U32: return 4;
+    case U16: case I16: case F16: case BF16: return 2;
+    default: return 1;
+  }
+}
+
+constexpr uint32_t kTensorMagic = 0x52545054;  // "RTPT"
+
+class TensorWriter {
+ public:
+  struct Spec {
+    DType dtype;
+    std::vector<uint64_t> shape;
+    uint64_t nbytes;
+    uint64_t offset;
+  };
+
+  // Declares tensors first (add), then create() maps the segment sized to
+  // fit; or use the one-shot constructor + add()+data() pattern below,
+  // which lazily maps on the first data() call.
+  explicit TensorWriter(std::string name) : name_(std::move(name)) {}
+  ~TensorWriter() { release(); }
+  TensorWriter(const TensorWriter &) = delete;
+  TensorWriter &operator=(const TensorWriter &) = delete;
+
+  size_t add(DType dtype, std::vector<uint64_t> shape) {
+    if (base_) throw std::runtime_error("add() after mapping");
+    uint64_t n = dtype_size(dtype);
+    for (uint64_t s : shape) n *= s;
+    specs_.push_back(Spec{dtype, std::move(shape), n, 0});
+    return specs_.size() - 1;
+  }
+
+  // Maps the segment and lays out offsets; add() is frozen after this.
+  void create() {
+    uint64_t off = 8;  // magic + count
+    for (const auto &s : specs_) off += 8 + 8 * s.shape.size() + 16;
+    for (auto &s : specs_) {
+      off = (off + 63) & ~uint64_t(63);  // 64-byte align tensor data
+      s.offset = off;
+      off += s.nbytes;
+    }
+    total_ = off;
+    int fd = shm_open(name_.c_str(), O_CREAT | O_RDWR | O_EXCL, 0600);
+    if (fd < 0) throw std::runtime_error("shm_open failed: " + name_);
+    if (ftruncate(fd, static_cast<off_t>(total_)) != 0) {
+      close(fd);
+      shm_unlink(name_.c_str());
+      throw std::runtime_error("ftruncate failed");
+    }
+    base_ = static_cast<uint8_t *>(mmap(nullptr, total_,
+                                        PROT_READ | PROT_WRITE,
+                                        MAP_SHARED, fd, 0));
+    close(fd);
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      shm_unlink(name_.c_str());
+      throw std::runtime_error("mmap failed");
+    }
+  }
+
+  uint8_t *data(size_t i) {
+    if (!base_) create();
+    return base_ + specs_.at(i).offset;
+  }
+  uint64_t nbytes(size_t i) const { return specs_.at(i).nbytes; }
+
+  // Writes the header LAST (consumers treat a valid magic as "sealed").
+  void finish() {
+    if (!base_) create();
+    uint8_t *p = base_;
+    put32(p, kTensorMagic);
+    put32(p, static_cast<uint32_t>(specs_.size()));
+    for (const auto &s : specs_) {
+      put32(p, s.dtype);
+      put32(p, static_cast<uint32_t>(s.shape.size()));
+      for (uint64_t d : s.shape) put64(p, d);
+      put64(p, s.nbytes);
+      put64(p, s.offset);
+    }
+    msync(base_, total_, MS_SYNC);
+  }
+
+  const std::string &name() const { return name_; }
+
+  void release() {
+    if (base_) {
+      munmap(base_, total_);
+      base_ = nullptr;
+    }
+  }
+
+ private:
+  static void put32(uint8_t *&p, uint32_t v) {
+    std::memcpy(p, &v, 4);
+    p += 4;
+  }
+  static void put64(uint8_t *&p, uint64_t v) {
+    std::memcpy(p, &v, 8);
+    p += 8;
+  }
+
+  std::string name_;
+  std::vector<Spec> specs_;
+  uint8_t *base_ = nullptr;
+  uint64_t total_ = 0;
+};
+
+}  // namespace ray_tpu

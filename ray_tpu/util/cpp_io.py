@@ -1,0 +1,116 @@
+"""Python side of the C++ tensor hand-off (cpp/include/ray_tpu/
+tensor_writer.hpp).
+
+A native producer (data loader, feature pipeline) writes tensors into a
+POSIX shm segment with a small typed header; ``import_tensors`` maps
+them as ZERO-COPY numpy views ready for ``jax.device_put`` — the
+native-IO feed path (reference analog: the C++ user API's object
+hand-off through plasma).  ``export_tensors`` writes the same layout for
+C++ consumers (the inverse of cpp/include/ray_tpu/object_reader.hpp,
+which reads store payload framing directly).
+
+Layout (little endian): u32 magic "RTPT", u32 n_tensors, then per tensor
+{u32 dtype_code, u32 ndim, u64 shape[ndim], u64 nbytes, u64 abs_offset}
+with tensor bytes 64-byte aligned at their offsets.
+"""
+
+from __future__ import annotations
+
+import struct
+from multiprocessing import shared_memory
+from typing import List, Tuple
+
+import numpy as np
+
+_MAGIC = 0x52545054
+_DTYPES = ["float32", "float64", "int32", "int64", "uint8", "int8",
+           "uint16", "int16", "uint32", "uint64", "float16", "bfloat16",
+           "bool"]
+
+
+def _np_dtype(code: int):
+    name = _DTYPES[code]
+    if name == "bfloat16":
+        try:
+            import ml_dtypes
+            return np.dtype(ml_dtypes.bfloat16)
+        except ImportError:
+            return np.dtype(np.uint16)  # raw bits view
+    return np.dtype(name)
+
+
+def import_tensors(segment_name: str) -> Tuple[List[np.ndarray], object]:
+    """Map a C++-written tensor segment; returns (views, keepalive).
+
+    The arrays alias the shared memory (zero copies); hold ``keepalive``
+    as long as any view is in use.  Unlink the segment via
+    ``keepalive.unlink()`` when the hand-off is consumed."""
+    shm = shared_memory.SharedMemory(name=segment_name.lstrip("/"))
+    buf = shm.buf
+    magic, n = struct.unpack_from("<II", buf, 0)
+    if magic != _MAGIC:
+        shm.close()
+        raise ValueError(
+            f"segment {segment_name!r} is not a sealed tensor segment")
+    off = 8
+    views: List[np.ndarray] = []
+    for _ in range(n):
+        code, ndim = struct.unpack_from("<II", buf, off)
+        off += 8
+        shape = struct.unpack_from(f"<{ndim}Q", buf, off)
+        off += 8 * ndim
+        nbytes, data_off = struct.unpack_from("<QQ", buf, off)
+        off += 16
+        dt = _np_dtype(code)
+        arr = np.frombuffer(buf, dtype=dt,
+                            count=nbytes // dt.itemsize,
+                            offset=data_off).reshape(shape)
+        views.append(arr)
+    return views, shm
+
+
+def export_tensors(segment_name: str, arrays: List[np.ndarray]) -> str:
+    """Write arrays into a tensor segment a C++ consumer can map."""
+    header = 8
+    for a in arrays:
+        header += 8 + 8 * a.ndim + 16
+    offsets = []
+    off = header
+    for a in arrays:
+        off = (off + 63) & ~63
+        offsets.append(off)
+        off += a.nbytes
+    shm = shared_memory.SharedMemory(name=segment_name.lstrip("/"),
+                                     create=True, size=max(off, 1))
+    buf = shm.buf
+    dst = None
+    try:
+        pos = 8
+        for a, data_off in zip(arrays, offsets):
+            code = _DTYPES.index(_dtype_name(a.dtype))
+            struct.pack_into("<II", buf, pos, code, a.ndim)
+            pos += 8
+            struct.pack_into(f"<{a.ndim}Q", buf, pos, *a.shape)
+            pos += 8 * a.ndim
+            struct.pack_into("<QQ", buf, pos, a.nbytes, data_off)
+            pos += 16
+            dst = np.frombuffer(buf, dtype=np.uint8, count=a.nbytes,
+                                offset=data_off)
+            np.copyto(dst, np.ascontiguousarray(a).view(np.uint8).ravel())
+        # Magic last: a valid header means "sealed".
+        struct.pack_into("<II", buf, 0, _MAGIC, len(arrays))
+    finally:
+        # Every view into shm.buf must die before close() (BufferError
+        # on exported pointers otherwise).
+        del dst, buf
+        shm.close()
+    return segment_name
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    name = dt.name
+    if name == "bfloat16":
+        return "bfloat16"
+    if name not in _DTYPES:
+        raise TypeError(f"unsupported dtype for C++ hand-off: {dt}")
+    return name

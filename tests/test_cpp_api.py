@@ -62,3 +62,62 @@ class TestCppObjectReader:
             capture_output=True, text=True, timeout=60)
         assert out.returncode != 0
         assert "error" in out.stderr or "segment" in out.stderr
+
+
+@pytest.fixture(scope="module")
+def produce_tensor_bin(tmp_path_factory):
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("g++ not available")
+    out = str(tmp_path_factory.mktemp("cppbin") / "produce_tensor")
+    subprocess.run(
+        [gxx, "-std=c++17", "-O2", "-I", os.path.join(REPO, "cpp", "include"),
+         os.path.join(REPO, "cpp", "examples", "produce_tensor.cc"),
+         "-o", out, "-lrt"],
+        check=True, capture_output=True, timeout=300)
+    return out
+
+
+class TestCppTensorWriter:
+    def test_cpp_writes_python_reads_zero_copy(self, produce_tensor_bin):
+        """The producer half of the native data plane: a C++ loader
+        writes typed tensors, Python maps them zero-copy
+        (cpp/include/ray_tpu/tensor_writer.hpp <-> util/cpp_io.py)."""
+        from ray_tpu.util import cpp_io
+        seg = f"/rt_test_cpp_{os.getpid()}"
+        subprocess.run([produce_tensor_bin, seg, "8"], check=True,
+                       capture_output=True, timeout=60)
+        try:
+            views, keep = cpp_io.import_tensors(seg)
+            x, y = views
+            assert x.shape == (8, 16) and x.dtype == np.float32
+            np.testing.assert_allclose(
+                x.ravel(), np.arange(128, dtype=np.float32) * 0.5)
+            np.testing.assert_array_equal(
+                y, (np.arange(8) ** 2).astype(np.int32))
+            # Zero-copy: the view aliases the shm mapping.
+            assert not x.flags["OWNDATA"]
+            del views, x, y
+            keep.close()
+        finally:
+            try:
+                from multiprocessing import shared_memory
+                shared_memory.SharedMemory(name=seg.lstrip("/")).unlink()
+            except FileNotFoundError:
+                pass
+
+    def test_python_export_roundtrip(self):
+        from ray_tpu.util import cpp_io
+        seg = f"/rt_test_pio_{os.getpid()}"
+        a = np.arange(24, dtype=np.float64).reshape(4, 6)
+        b = np.array([True, False, True])
+        cpp_io.export_tensors(seg, [a, b])
+        try:
+            views, keep = cpp_io.import_tensors(seg)
+            np.testing.assert_array_equal(views[0], a)
+            np.testing.assert_array_equal(views[1], b)
+            del views
+            keep.close()
+        finally:
+            from multiprocessing import shared_memory
+            shared_memory.SharedMemory(name=seg.lstrip("/")).unlink()
