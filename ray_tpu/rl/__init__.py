@@ -20,6 +20,7 @@ Quick start::
 
 from .algorithm import Algorithm, AlgorithmConfig
 from .connectors import (ClipActions, Connector, ConnectorPipeline,
+                         ObsFlatten, RewardClip,
                          FrameStack, LambdaConnector, MeanStdFilter)
 from .dqn import DQN, DQNConfig
 from .env import (CartPole, Env, Pendulum, StatelessGuess, TargetReach,
@@ -27,7 +28,10 @@ from .env import (CartPole, Env, Pendulum, StatelessGuess, TargetReach,
 from .env_runner import EnvRunner, EnvRunnerGroup
 from .impala import (APPO, APPOConfig, IMPALA, IMPALAConfig,
                      vtrace)
+from .jax_env import JaxCartPoleVector
 from .learner import JaxLearner, LearnerGroup
+from .models import (CNNPolicyModule, CNNPolicySpec, GRUPolicyModule,
+                     RecurrentPolicySpec)
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
                           MultiAgentPPOConfig, MultiGuess)
 from .iql import IQL, IQLConfig
@@ -52,9 +56,11 @@ __all__ = [
     "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
     "MultiAgentPPOConfig", "MultiGuess",
     "Connector", "ConnectorPipeline", "MeanStdFilter", "FrameStack",
-    "LambdaConnector", "ClipActions",
+    "LambdaConnector", "ClipActions", "RewardClip", "ObsFlatten",
     "Env", "CartPole", "StatelessGuess", "Pendulum", "TargetReach",
-    "VectorEnv", "make_env",
+    "VectorEnv", "JaxCartPoleVector", "make_env",
+    "CNNPolicyModule", "CNNPolicySpec", "GRUPolicyModule",
+    "RecurrentPolicySpec",
     "register_env", "EnvRunner", "EnvRunnerGroup", "JaxLearner",
     "LearnerGroup", "ReplayBuffer", "PrioritizedReplayBuffer",
     "DiscretePolicyModule", "GaussianPolicyModule", "TwinQModule",

@@ -252,3 +252,28 @@ class ClipActions(Connector):
 
     def __call__(self, batch: np.ndarray) -> np.ndarray:
         return np.clip(batch, self.low, self.high)
+
+
+class RewardClip(Connector):
+    """Clip (or sign-compress) rewards before learning — the standard
+    Atari-style stabilizer (reference: rllib clip_rewards config: True ->
+    sign, float -> symmetric clip)."""
+
+    def __init__(self, bound: float = 1.0, sign: bool = False):
+        self.bound = bound
+        self.sign = sign
+
+    def __call__(self, rewards: np.ndarray) -> np.ndarray:
+        r = np.asarray(rewards)
+        if self.sign:
+            return np.sign(r)
+        return np.clip(r, -self.bound, self.bound)
+
+
+class ObsFlatten(Connector):
+    """Flatten structured observations to 1-D feature vectors
+    (env-to-module; reference: rllib's flatten_observations preprocessor)."""
+
+    def __call__(self, batch: np.ndarray) -> np.ndarray:
+        b = np.asarray(batch)
+        return b.reshape(b.shape[0], -1) if b.ndim > 1 else b

@@ -23,10 +23,14 @@ class EnvRunner:
     def __init__(self, env_creator: Callable, *, num_envs: int = 4,
                  module_spec: Optional[RLModuleSpec] = None,
                  seed: int = 0, explore: bool = True,
-                 env_to_module=None):
+                 env_to_module=None, module=None,
+                 reward_connector=None):
         import jax
 
         self.vec = VectorEnv(env_creator, num_envs, seed=seed)
+        # Reward-path connector (reference: rllib clip_rewards): applied
+        # to the per-step reward vector before it enters the batch.
+        self.reward_connector = reward_connector
         # Env-to-module connector pipeline (reference: rllib ConnectorV2):
         # observations pass through it before every forward; its state
         # syncs with the weights via get_state/set_state.
@@ -42,7 +46,10 @@ class EnvRunner:
             obs_dim *= env_to_module.output_dim_factor
         self.spec = module_spec or RLModuleSpec(
             obs_dim, self.vec.num_actions)
-        self.module = DiscretePolicyModule(self.spec)
+        # Custom module hook (e.g. models.CNNPolicyModule): anything with
+        # the init/forward_train-dict/forward_exploration surface.
+        self.module = module if module is not None \
+            else DiscretePolicyModule(self.spec)
         self.explore = explore
         self._key = jax.random.key(seed)
         self.params = self.module.init(jax.random.key(seed + 1))
@@ -132,7 +139,8 @@ class EnvRunner:
                 # keeping connectors must not leak old frames into it.
                 self.env_to_module.on_episode_boundaries(dones)
             self._obs = self._connect(raw_obs)
-            rew_buf[t] = rewards
+            rew_buf[t] = rewards if self.reward_connector is None \
+                else self.reward_connector(rewards)
             done_buf[t] = dones
             term_buf[t] = terms
             truncs = dones & ~terms

@@ -401,3 +401,158 @@ class TestIMPALA:
             r = algo.train()
         assert r["env_runners"]["episode_return_mean"] > 0.85
         algo.stop()
+
+
+class TestModelZoo:
+    """CNN + recurrent policies (reference analog: rllib/models vision
+    and recurrent networks)."""
+
+    def test_cnn_policy_shapes_and_learns_pattern(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from ray_tpu.rl import CNNPolicyModule, CNNPolicySpec
+
+        spec = CNNPolicySpec(obs_shape=(8, 8, 1), num_actions=2,
+                             channels=(8, 16), hidden=32)
+        mod = CNNPolicyModule(spec)
+        params = mod.init(jax.random.key(0))
+        # Pixel pattern: class = whether the bright quadrant is top-left.
+        rng = np.random.default_rng(0)
+        imgs = np.zeros((64, 8, 8, 1), np.float32)
+        labels = rng.integers(0, 2, 64)
+        for i, y in enumerate(labels):
+            if y == 0:
+                imgs[i, :4, :4, 0] = 1.0
+            else:
+                imgs[i, 4:, 4:, 0] = 1.0
+        obs = jnp.asarray(imgs)
+        lab = jnp.asarray(labels)
+        out = mod.forward_train(params, obs)
+        assert out["action_logits"].shape == (64, 2)
+        assert out["value"].shape == (64,)
+
+        def loss(p):
+            lg = mod.forward_train(p, obs)["action_logits"]
+            return -jnp.mean(jax.nn.log_softmax(lg)[jnp.arange(64), lab])
+
+        step = jax.jit(jax.grad(loss))
+        l0 = float(loss(params))
+        for _ in range(60):
+            g = step(params)
+            params = jax.tree.map(lambda p, gg: p - 0.5 * gg, params, g)
+        assert float(loss(params)) < l0 * 0.2
+        acc = float(jnp.mean(mod.forward_inference(params, obs) == lab))
+        assert acc > 0.95
+
+    def test_gru_train_matches_stepwise(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from ray_tpu.rl import GRUPolicyModule, RecurrentPolicySpec
+
+        spec = RecurrentPolicySpec(obs_dim=3, num_actions=4, hidden=8)
+        mod = GRUPolicyModule(spec)
+        params = mod.init(jax.random.key(1))
+        rng = np.random.default_rng(1)
+        obs_seq = jnp.asarray(rng.normal(size=(2, 5, 3)).astype(np.float32))
+        h0 = mod.initial_state(2)
+        out = mod.forward_train(params, obs_seq, h0)
+        logits_tr, values_tr = out["action_logits"], out["value"]
+        assert logits_tr.shape == (2, 5, 4) and values_tr.shape == (2, 5)
+        # Step-by-step unroll must agree with the scanned training pass.
+        h = h0
+        for t in range(5):
+            lg, v, h = mod.forward_step(params, obs_seq[:, t], h)
+            np.testing.assert_allclose(np.asarray(lg),
+                                       np.asarray(logits_tr[:, t]),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_gru_uses_memory(self):
+        """The recurrent core must beat a memoryless readout on a task
+        where the answer is the FIRST observation of the sequence."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from ray_tpu.rl import GRUPolicyModule, RecurrentPolicySpec
+
+        spec = RecurrentPolicySpec(obs_dim=2, num_actions=2, hidden=16)
+        mod = GRUPolicyModule(spec)
+        params = mod.init(jax.random.key(2))
+        rng = np.random.default_rng(2)
+        first = rng.integers(0, 2, 64)
+        seqs = np.zeros((64, 6, 2), np.float32)
+        seqs[np.arange(64), 0, first] = 1.0  # signal only at t=0
+        obs = jnp.asarray(seqs)
+        lab = jnp.asarray(first)
+
+        def loss(p):
+            lg = mod.forward_train(p, obs,
+                                   mod.initial_state(64))["action_logits"]
+            return -jnp.mean(
+                jax.nn.log_softmax(lg[:, -1])[jnp.arange(64), lab])
+
+        step = jax.jit(jax.grad(loss))
+        for _ in range(150):
+            g = step(params)
+            params = jax.tree.map(lambda p, gg: p - 0.5 * gg, params, g)
+        # Predicting t=0's signal at t=5 requires carrying state.
+        assert float(loss(params)) < 0.1
+
+
+class TestJaxVectorEnv:
+    def test_dynamics_match_python_env(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from ray_tpu.rl import CartPole
+        from ray_tpu.rl.jax_env import JaxCartPoleVector
+
+        vec = JaxCartPoleVector(num_envs=4, seed=3)
+        obs = np.asarray(vec.reset())
+        py = CartPole()
+        py._state = obs[1].astype(np.float64)
+        py._t = 0
+        actions = np.array([0, 1, 0, 1])
+        nxt, rew, term, trunc = vec.step(jnp.asarray(actions))
+        want, r, term, trunc, _ = py.step(int(actions[1]))
+        np.testing.assert_allclose(np.asarray(nxt)[1], want, rtol=1e-5,
+                                   atol=1e-6)
+        assert float(rew[1]) == r
+
+    def test_fused_rollout_collects_batches(self):
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.rl.jax_env import JaxCartPoleVector
+
+        n, steps = 256, 50
+        vec = JaxCartPoleVector(num_envs=n, seed=4)
+        vec.reset()
+
+        def random_policy(_params, obs, key):
+            return jax.random.randint(key, (obs.shape[0],), 0, 2)
+
+        obs, actions, rewards, terms, truncs = vec.rollout(
+            None, random_policy, steps, jax.random.key(0))
+        assert obs.shape == (steps, n, 4)
+        assert actions.shape == (steps, n)
+        assert float(rewards.sum()) == steps * n  # +1 every step
+        # Random policy on cartpole terminates episodes within 50 steps.
+        assert bool(terms.any())
+        assert not bool(truncs.any())  # max_steps=500 never hit in 50
+
+
+class TestEnvRunnerHooks:
+    def test_custom_module_and_reward_connector(self):
+        import numpy as np
+        from ray_tpu.rl import (CartPole, DiscretePolicyModule,
+                                EnvRunner, RewardClip, RLModuleSpec)
+
+        spec = RLModuleSpec(4, 2, hidden=(8,))
+        runner = EnvRunner(lambda: CartPole(max_steps=20), num_envs=2,
+                           module_spec=spec,
+                           module=DiscretePolicyModule(spec),
+                           reward_connector=RewardClip(0.5))
+        batch = runner.sample(num_steps=10)
+        assert batch["rewards"].shape == (10, 2)
+        # CartPole rewards are +1; the reward-path connector clipped them.
+        assert np.all(batch["rewards"] == 0.5)
