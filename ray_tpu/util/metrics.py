@@ -317,3 +317,81 @@ def _reset_for_tests() -> None:
     with _registry_lock:
         _registry.clear()
     _flusher_started = False
+
+
+def export_otlp_json(path: str) -> str:
+    """Write the cluster-merged metrics in the OTLP/JSON resourceMetrics
+    shape (reference: the OpenTelemetry metrics exporter behind
+    open_telemetry_metric_recorder.h — here the file-based OTLP/JSON
+    flavor, importable by any OTLP-compatible backend).  Counters land as
+    monotonic sums, gauges as gauges, histograms as explicit-bucket
+    histogram points."""
+    import json
+
+    now_ns = int(time.time() * 1e9)
+
+    def attrs(tags: Dict[str, str]):
+        return [{"key": k, "value": {"stringValue": str(v)}}
+                for k, v in sorted(tags.items())]
+
+    otlp_metrics = []
+    for snap in _merged_snapshots():
+        base = {"name": snap["name"],
+                "description": snap.get("description", "")}
+        mtype = snap["type"]
+        if mtype == "histogram":
+            # Samples carry per-bucket counts plus _sum/_count rows;
+            # regroup them into histogram data points per tag set.
+            by_tags: Dict[tuple, Dict[str, Any]] = {}
+            for name, tags, value in snap["samples"]:
+                le = tags.get("le")
+                key = tuple(sorted((k, v) for k, v in tags.items()
+                                   if k != "le"))
+                p = by_tags.setdefault(key, {
+                    "bounds": [], "counts": [], "sum": 0.0, "count": 0,
+                    "tags": {k: v for k, v in key}})
+                if name.endswith("_sum"):
+                    p["sum"] = value
+                elif name.endswith("_count"):
+                    p["count"] = int(value)
+                elif le is not None:
+                    p["bounds"].append(le)
+                    p["counts"].append(int(value))
+            points = []
+            for p in by_tags.values():
+                finite = [float(b) for b in p["bounds"] if b != "+Inf"]
+                # Cumulative bucket counts -> per-bucket (OTLP shape).
+                cum = p["counts"]
+                per = [cum[0]] + [cum[i] - cum[i - 1]
+                                  for i in range(1, len(cum))] if cum \
+                    else []
+                points.append({
+                    "attributes": attrs(p["tags"]),
+                    "timeUnixNano": str(now_ns),
+                    "count": str(p["count"]), "sum": p["sum"],
+                    "explicitBounds": finite, "bucketCounts":
+                        [str(c) for c in per]})
+            base["histogram"] = {"dataPoints": points,
+                                 "aggregationTemporality": 2}
+        else:
+            points = [{"attributes": attrs(tags),
+                       "timeUnixNano": str(now_ns),
+                       "asDouble": float(value)}
+                      for _n, tags, value in snap["samples"]]
+            if mtype == "counter":
+                base["sum"] = {"dataPoints": points, "isMonotonic": True,
+                               "aggregationTemporality": 2}
+            else:
+                base["gauge"] = {"dataPoints": points}
+        otlp_metrics.append(base)
+
+    doc = {"resourceMetrics": [{
+        "resource": {"attributes": [{
+            "key": "service.name",
+            "value": {"stringValue": "ray_tpu"}}]},
+        "scopeMetrics": [{"scope": {"name": "ray_tpu.util.metrics"},
+                          "metrics": otlp_metrics}],
+    }]}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

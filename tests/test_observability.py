@@ -267,3 +267,33 @@ class TestTracing:
         assert not tracing.is_enabled()
         ray_tpu.get(f.remote(), timeout=60)
         assert tracing.list_traces() == []
+
+
+class TestOtlpMetricsExport:
+    def test_export_shape(self, ray_start, tmp_path):
+        """OTLP/JSON resourceMetrics export (reference: the OTel metrics
+        exporter behind open_telemetry_metric_recorder.h)."""
+        import json
+
+        from ray_tpu.util import metrics as m
+        c = m.Counter("otlp_test_total", "d", tag_keys=("k",))
+        c.inc(3, tags={"k": "a"})
+        g = m.Gauge("otlp_test_gauge")
+        g.set(7.5)
+        h = m.Histogram("otlp_test_hist", boundaries=[1.0, 10.0])
+        h.observe(0.5)
+        h.observe(5.0)
+        h.observe(50.0)
+
+        path = m.export_otlp_json(str(tmp_path / "metrics.json"))
+        doc = json.load(open(path))
+        scope = doc["resourceMetrics"][0]["scopeMetrics"][0]
+        by_name = {mm["name"]: mm for mm in scope["metrics"]}
+        s = by_name["otlp_test_total"]["sum"]
+        assert s["isMonotonic"] and s["dataPoints"][0]["asDouble"] == 3.0
+        assert by_name["otlp_test_gauge"]["gauge"]["dataPoints"][0][
+            "asDouble"] == 7.5
+        hist = by_name["otlp_test_hist"]["histogram"]["dataPoints"][0]
+        assert hist["count"] == "3" and hist["sum"] == 55.5
+        assert hist["explicitBounds"] == [1.0, 10.0]
+        assert hist["bucketCounts"] == ["1", "1", "1"]
