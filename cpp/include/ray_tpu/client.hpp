@@ -1,0 +1,252 @@
+// ray_tpu C++ user API: task and actor calls from native code.
+//
+// Reference analog: cpp/src/ray/api.cc (ray::Task / ray::Actor over the
+// core-worker ABI).  This client speaks the gateway protocol of
+// ray_tpu/cpp_gateway.py — 4-byte little-endian length-prefixed JSON
+// frames over TCP, token handshake first — and exposes:
+//
+//   ray_tpu::Client c(host, port, token);
+//   std::string ref = c.submit("add", "[2, 40]");        // args as JSON
+//   ray_tpu::Result r = c.get(ref);                      // r.result JSON
+//   std::string ref2 = c.call_actor("counter", "", "bump", "[1]");
+//
+// Tensor results arrive as a typed shm segment (r.tensor_segment) mapped
+// zero-copy with tensor_reader below (layout: tensor_writer.hpp).
+// Argument/result payloads are JSON strings: the client does NOT bundle a
+// general JSON library; the envelope fields it needs are extracted from
+// the gateway's fixed emission format (json.dumps of a flat dict).
+//
+// Compile: C++17; no dependencies beyond POSIX sockets (-lrt for the
+// tensor reader).
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <cstdint>
+#include <cstring>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdexcept>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace ray_tpu {
+
+struct Result {
+  bool ok = false;
+  std::string error;           // set when !ok
+  std::string result;          // raw JSON value (plain results)
+  std::string tensor_segment;  // shm name (ndarray results)
+};
+
+namespace detail {
+
+// Extract the value of "key" from the gateway's fixed-format JSON
+// envelope (json.dumps: {"k": v, ...} with double-quoted keys).  Returns
+// the raw JSON token/value; strings are unescaped for the simple cases
+// the gateway emits.
+inline bool extract(const std::string &doc, const std::string &key,
+                    std::string *out, bool *is_string) {
+  const std::string needle = "\"" + key + "\":";
+  size_t p = doc.find(needle);
+  if (p == std::string::npos) return false;
+  p += needle.size();
+  while (p < doc.size() && doc[p] == ' ') ++p;
+  if (p >= doc.size()) return false;
+  if (doc[p] == '"') {
+    ++p;
+    std::string s;
+    while (p < doc.size() && doc[p] != '"') {
+      char c = doc[p];
+      if (c == '\\' && p + 1 < doc.size()) {
+        char e = doc[++p];
+        switch (e) {
+          case 'n': s += '\n'; break;
+          case 't': s += '\t'; break;
+          case 'r': s += '\r'; break;
+          case 'b': s += '\b'; break;
+          case 'f': s += '\f'; break;
+          case '"': case '\\': case '/': s += e; break;
+          case 'u': {
+            // \uXXXX -> UTF-8 (json.dumps default is ensure_ascii, so
+            // any non-ASCII result arrives this way).
+            if (p + 4 >= doc.size()) { s += 'u'; break; }
+            unsigned cp = 0;
+            for (int k = 1; k <= 4; ++k) {
+              char h = doc[p + k];
+              cp <<= 4;
+              if (h >= '0' && h <= '9') cp |= h - '0';
+              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+            }
+            p += 4;
+            if (cp < 0x80) {
+              s += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              s += static_cast<char>(0xC0 | (cp >> 6));
+              s += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              s += static_cast<char>(0xE0 | (cp >> 12));
+              s += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              s += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: s += e;
+        }
+        ++p;
+      } else {
+        s += c;
+        ++p;
+      }
+    }
+    *out = s;
+    *is_string = true;
+    return true;
+  }
+  // Non-string value: scan to the matching end at depth 0.
+  int depth = 0;
+  size_t start = p;
+  for (; p < doc.size(); ++p) {
+    char c = doc[p];
+    if (c == '{' || c == '[') ++depth;
+    else if (c == '}' || c == ']') {
+      if (depth == 0) break;
+      --depth;
+    } else if (c == ',' && depth == 0) {
+      break;
+    } else if (c == '"') {
+      ++p;
+      while (p < doc.size() && doc[p] != '"') {
+        if (doc[p] == '\\') ++p;
+        ++p;
+      }
+    }
+  }
+  *out = doc.substr(start, p - start);
+  *is_string = false;
+  return true;
+}
+
+inline std::string escape(const std::string &s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out += '\\';
+    out += c;
+  }
+  return out;
+}
+
+}  // namespace detail
+
+class Client {
+ public:
+  Client(const std::string &host, int port, const std::string &token) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host: " + host);
+    if (connect(fd_, reinterpret_cast<sockaddr *>(&addr),
+                sizeof(addr)) != 0)
+      throw std::runtime_error("connect failed");
+    send_json("{\"op\": \"auth\", \"token\": \"" +
+              detail::escape(token) + "\"}");
+    Result r = recv_result();
+    if (!r.ok) throw std::runtime_error("gateway auth rejected");
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  Client(const Client &) = delete;
+  Client &operator=(const Client &) = delete;
+
+  // args_json: a JSON array string, e.g. "[2, 40]".
+  std::string submit(const std::string &fn, const std::string &args_json) {
+    send_json("{\"op\": \"submit\", \"fn\": \"" + detail::escape(fn) +
+              "\", \"args\": " + args_json + "}");
+    return expect_ref();
+  }
+
+  std::string call_actor(const std::string &actor, const std::string &ns,
+                         const std::string &method,
+                         const std::string &args_json) {
+    std::string nsjson =
+        ns.empty() ? "null" : "\"" + detail::escape(ns) + "\"";
+    send_json("{\"op\": \"call_actor\", \"actor\": \"" +
+              detail::escape(actor) + "\", \"namespace\": " + nsjson +
+              ", \"method\": \"" + detail::escape(method) +
+              "\", \"args\": " + args_json + "}");
+    return expect_ref();
+  }
+
+  Result get(const std::string &ref, double timeout_s = 300.0) {
+    send_json("{\"op\": \"get\", \"ref\": \"" + detail::escape(ref) +
+              "\", \"timeout\": " + std::to_string(timeout_s) + "}");
+    return recv_result();
+  }
+
+ private:
+  std::string expect_ref() {
+    Result r = recv_result();
+    if (!r.ok) throw std::runtime_error("gateway error: " + r.error);
+    return r.result;  // the ref hex (extracted below as "ref")
+  }
+
+  void send_json(const std::string &body) {
+    uint32_t n = static_cast<uint32_t>(body.size());
+    char hdr[4];
+    std::memcpy(hdr, &n, 4);  // little-endian hosts (x86/arm64 LE)
+    send_all(hdr, 4);
+    send_all(body.data(), body.size());
+  }
+
+  void send_all(const char *p, size_t n) {
+    while (n > 0) {
+      ssize_t w = ::send(fd_, p, n, 0);
+      if (w <= 0) throw std::runtime_error("send failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+
+  void recv_all(char *p, size_t n) {
+    while (n > 0) {
+      ssize_t r = ::recv(fd_, p, n, 0);
+      if (r <= 0) throw std::runtime_error("recv failed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+  Result recv_result() {
+    char hdr[4];
+    recv_all(hdr, 4);
+    uint32_t n;
+    std::memcpy(&n, hdr, 4);
+    std::string body(n, '\0');
+    recv_all(body.data(), n);
+    Result r;
+    std::string v;
+    bool is_str = false;
+    if (detail::extract(body, "ok", &v, &is_str)) r.ok = (v == "true");
+    if (detail::extract(body, "error", &v, &is_str)) r.error = v;
+    // "result" before "ref": a user result VALUE may contain a nested
+    // "ref" key, but the top-level "result" key always precedes it.
+    if (detail::extract(body, "result", &v, &is_str)) r.result = v;
+    else if (detail::extract(body, "ref", &v, &is_str)) r.result = v;
+    if (detail::extract(body, "tensor_segment", &v, &is_str))
+      r.tensor_segment = v;
+    return r;
+  }
+
+  int fd_ = -1;
+};
+
+}  // namespace ray_tpu

@@ -160,4 +160,87 @@ class TensorWriter {
   uint64_t total_ = 0;
 };
 
+// Read side of the same layout (segments written by util/cpp_io.py
+// export_tensors or another TensorWriter).
+class TensorReader {
+ public:
+  struct View {
+    DType dtype;
+    std::vector<uint64_t> shape;
+    const uint8_t *data;
+    uint64_t nbytes;
+  };
+
+  explicit TensorReader(const std::string &name) {
+    int fd = shm_open(name.c_str(), O_RDONLY, 0);
+    if (fd < 0) throw std::runtime_error("shm_open failed: " + name);
+    struct stat st {};
+    if (fstat(fd, &st) != 0) {
+      close(fd);
+      throw std::runtime_error("fstat failed");
+    }
+    len_ = static_cast<size_t>(st.st_size);
+    base_ = static_cast<const uint8_t *>(
+        mmap(nullptr, len_, PROT_READ, MAP_SHARED, fd, 0));
+    close(fd);
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      throw std::runtime_error("mmap failed");
+    }
+    // Bounds-checked parse; a throwing constructor must not leak the
+    // mapping (the destructor never runs for it).
+    try {
+      const uint8_t *p = base_;
+      const uint8_t *end = base_ + len_;
+      uint32_t magic = get32(p, end), n = get32(p, end);
+      if (magic != kTensorMagic)
+        throw std::runtime_error("segment not sealed (bad magic)");
+      for (uint32_t i = 0; i < n; ++i) {
+        View v;
+        v.dtype = static_cast<DType>(get32(p, end));
+        uint32_t ndim = get32(p, end);
+        if (ndim > 64) throw std::runtime_error("corrupt header (ndim)");
+        for (uint32_t d = 0; d < ndim; ++d)
+          v.shape.push_back(get64(p, end));
+        v.nbytes = get64(p, end);
+        uint64_t off = get64(p, end);
+        if (off > len_ || v.nbytes > len_ - off)
+          throw std::runtime_error("corrupt header (tensor range)");
+        v.data = base_ + off;
+        tensors.push_back(std::move(v));
+      }
+    } catch (...) {
+      munmap(const_cast<uint8_t *>(base_), len_);
+      base_ = nullptr;
+      throw;
+    }
+  }
+  ~TensorReader() {
+    if (base_) munmap(const_cast<uint8_t *>(base_), len_);
+  }
+  TensorReader(const TensorReader &) = delete;
+  TensorReader &operator=(const TensorReader &) = delete;
+
+  std::vector<View> tensors;
+
+ private:
+  static uint32_t get32(const uint8_t *&p, const uint8_t *end) {
+    if (end - p < 4) throw std::runtime_error("truncated header");
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  static uint64_t get64(const uint8_t *&p, const uint8_t *end) {
+    if (end - p < 8) throw std::runtime_error("truncated header");
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+
+  const uint8_t *base_ = nullptr;
+  size_t len_ = 0;
+};
+
 }  // namespace ray_tpu

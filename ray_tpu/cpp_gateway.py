@@ -1,0 +1,208 @@
+"""C++ task/actor gateway: a schema'd TCP protocol native clients speak.
+
+The reference's C++ user API (`cpp/src/ray/api.cc`) rides the protobuf
+core-worker ABI; this framework's internal wire is pickled dataclasses,
+which non-Python clients cannot (and must not) speak.  The gateway is the
+bridge: a documented, fixed-schema JSON-over-TCP protocol
+(``cpp/include/ray_tpu/client.hpp`` is the header-only C++ client) that
+exposes task submission, actor method calls, and object gets to native
+code — large tensors hand off zero-copy through the typed shm segments of
+``util/cpp_io.py`` instead of JSON.
+
+Frames: 4-byte little-endian length + UTF-8 JSON object.  First frame
+must be {"op": "auth", "token": "<hex>"}.  Then:
+
+  {"op": "submit", "fn": <registered name>, "args": [...]}
+      -> {"ok": true, "ref": "<hex>"}
+  {"op": "call_actor", "actor": <name>, "namespace": <ns|null>,
+   "method": <name>, "args": [...]}
+      -> {"ok": true, "ref": "<hex>"}
+  {"op": "get", "ref": "<hex>", "timeout": <seconds>}
+      -> {"ok": true, "result": <json>}                       (plain)
+      -> {"ok": true, "tensor_segment": "<shm name>"}         (ndarray
+         results: map with cpp/include/ray_tpu/tensor_writer.hpp layout)
+  {"op": "ping"} -> {"ok": true}
+
+Functions are explicitly registered server-side (``register_function``) —
+the gateway never unpickles or eval's anything a native client sends, so
+a client can only invoke what the owner exported (reference analog: the
+function-descriptor allowlists of cross-language calls).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+
+_registry: Dict[str, Any] = {}
+
+
+def register_function(name: str, fn: Callable) -> None:
+    """Export ``fn`` to native clients under ``name``.  The RemoteFunction
+    wrapper is built once here so per-submit calls reuse the pickled
+    function blob (fn_id caching downstream)."""
+    _registry[name] = ray_tpu.remote(fn)
+
+
+class CppGateway:
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 token: Optional[str] = None):
+        self.token = token or os.urandom(12).hex()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.address = self._sock.getsockname()
+        self._closed = False
+        # hex -> ObjectRef, insertion-ordered and bounded: fire-and-forget
+        # clients must not pin results forever — beyond the cap the oldest
+        # unfetched ref drops (normal GC frees the object).
+        from collections import OrderedDict
+        self._refs: "OrderedDict[str, Any]" = OrderedDict()
+        self._refs_cap = 10_000
+        self._refs_lock = threading.Lock()
+        # Tensor hand-off segments whose replies may never be consumed
+        # (client crash): unlinked at stop() unless the client already did.
+        self._segments: set = set()
+        threading.Thread(target=self._accept_loop, name="cpp-gateway",
+                         daemon=True).start()
+
+    # -- framing ----------------------------------------------------------- #
+
+    @staticmethod
+    def _recv_frame(conn) -> Optional[dict]:
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = conn.recv(4 - len(hdr))
+            if not chunk:
+                return None
+            hdr += chunk
+        (n,) = struct.unpack("<I", hdr)
+        if n > 64 << 20:
+            return None
+        body = b""
+        while len(body) < n:
+            chunk = conn.recv(min(1 << 16, n - len(body)))
+            if not chunk:
+                return None
+            body += chunk
+        try:
+            return json.loads(body)
+        except ValueError:
+            return None
+
+    @staticmethod
+    def _send_frame(conn, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        conn.sendall(struct.pack("<I", len(body)) + body)
+
+    # -- serving ----------------------------------------------------------- #
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        try:
+            hello = self._recv_frame(conn)
+            if not hello or hello.get("op") != "auth" or \
+                    hello.get("token") != self.token:
+                self._send_frame(conn, {"ok": False, "error": "auth"})
+                return
+            self._send_frame(conn, {"ok": True})
+            while True:
+                msg = self._recv_frame(conn)
+                if msg is None:
+                    return
+                try:
+                    self._send_frame(conn, self._handle(msg))
+                except Exception as e:  # noqa: BLE001
+                    self._send_frame(conn, {"ok": False,
+                                            "error": repr(e)})
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _track(self, ref) -> str:
+        hexid = ref.hex()
+        with self._refs_lock:
+            self._refs[hexid] = ref
+            while len(self._refs) > self._refs_cap:
+                self._refs.popitem(last=False)
+        return hexid
+
+    def _handle(self, msg: dict) -> dict:
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True}
+        if op == "submit":
+            remote = _registry.get(msg.get("fn", ""))
+            if remote is None:
+                return {"ok": False,
+                        "error": f"unknown function {msg.get('fn')!r}"}
+            ref = remote.remote(*msg.get("args", []))
+            return {"ok": True, "ref": self._track(ref)}
+        if op == "call_actor":
+            info = ray_tpu.get_actor(msg["actor"],
+                                     namespace=msg.get("namespace"))
+            method = getattr(info, msg["method"])
+            ref = method.remote(*msg.get("args", []))
+            return {"ok": True, "ref": self._track(ref)}
+        if op == "get":
+            hexid = msg.get("ref", "")
+            with self._refs_lock:
+                ref = self._refs.get(hexid)
+            if ref is None:
+                return {"ok": False, "error": f"unknown ref {hexid!r}"}
+            value = ray_tpu.get(ref, timeout=msg.get("timeout", 300))
+            with self._refs_lock:
+                self._refs.pop(hexid, None)
+            import numpy as np
+            if isinstance(value, np.ndarray):
+                from ray_tpu.util import cpp_io
+                seg = f"/rtgw_{os.getpid()}_{os.urandom(4).hex()}"
+                cpp_io.export_tensors(seg, [value])
+                self._segments.add(seg)
+                return {"ok": True, "tensor_segment": seg}
+            return {"ok": True, "result": value}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def stop(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+        # Sweep hand-off segments whose clients never consumed/unlinked
+        # them (the consumer owns cleanup in the happy path).
+        from multiprocessing import shared_memory
+        for seg in list(self._segments):
+            try:
+                sm = shared_memory.SharedMemory(name=seg.lstrip("/"))
+                sm.close()
+                sm.unlink()
+            except FileNotFoundError:
+                pass
+            except Exception:
+                pass
+            self._segments.discard(seg)
+
+
+def start(port: int = 0, host: str = "127.0.0.1",
+          token: Optional[str] = None) -> CppGateway:
+    """Start the native-client gateway; returns the server (``.address``,
+    ``.token`` go to the C++ side, e.g. via argv or env)."""
+    return CppGateway(port=port, host=host, token=token)
