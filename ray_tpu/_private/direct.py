@@ -128,12 +128,18 @@ class DirectServer:
 class _LocalObject:
     """Caller-owned result slot for a direct call."""
 
-    __slots__ = ("event", "desc", "refcount", "promote_on_ready")
+    __slots__ = ("event", "desc", "refcount", "promote_on_ready", "ref_seen")
 
     def __init__(self):
         self.event = threading.Event()
         self.desc = None
         self.refcount = 0
+        # The entry is created BEFORE the caller constructs its ObjectRef
+        # (which bumps refcount via note_local_ref).  Until that bump has
+        # been observed, refcount==0 means "ref not built yet", NOT
+        # "fire-and-forget ref already dropped" — pruning then would
+        # silently discard the inline result and wedge the later get().
+        self.ref_seen = False
         self.promote_on_ready = False
 
     def set(self, desc) -> None:

@@ -128,9 +128,11 @@ class WorkerRuntime:
             promote = lo.promote_on_ready and desc[0] in ("inline", "err")
             lo.set(desc)
             lo.promote_on_ready = False
-            if lo.refcount <= 0 and not promote:
-                # Fire-and-forget call whose ref already dropped: nothing
-                # will ever read this result — don't accumulate it.
+            if lo.refcount <= 0 and lo.ref_seen and not promote:
+                # Fire-and-forget call whose ref was created AND dropped:
+                # nothing will ever read this result — don't accumulate
+                # it.  ref_seen guards the submit window where the reply
+                # can land before the caller has built its ObjectRef.
                 self._local_objects.pop(oid_bytes, None)
         if promote:
             self.send(PutFromWorker(ObjectID(oid_bytes), desc))
@@ -169,6 +171,7 @@ class WorkerRuntime:
             lo = self._local_objects.get(oid_bytes)
             if lo is not None:
                 lo.refcount += 1
+                lo.ref_seen = True
 
     def note_new_ref(self, ref) -> None:
         """Every ObjectRef constructed in this worker passes through here:

@@ -43,6 +43,9 @@ class Autoscaler:
         self.config = config
         # provider_id -> (node_type, launch_ts)
         self._launched: Dict[str, tuple] = {}
+        # provider_id -> expected alive-worker count once this launch
+        # joins (pid-less providers only; see _gang_launches fallback).
+        self._expected_alive: Dict[str, int] = {}
         # node_id (runtime) -> first-seen-idle timestamp
         self._idle_since: Dict = {}
         self._stop = threading.Event()
@@ -76,10 +79,22 @@ class Autoscaler:
                 counts[ntype] = counts.get(ntype, 0) + 1
             else:
                 self._launched.pop(pid, None)
+                self._expected_alive.pop(pid, None)
         return counts
+
+    def _alive_workers(self) -> int:
+        return sum(1 for n in self.runtime.controller.alive_nodes()
+                   if not n.is_head)
 
     def _launch(self, name: str, ntc: NodeTypeConfig) -> None:
         pid = self.provider.create_node(name, ntc.resources)
+        # Join expectation: the worker count this launch should bring the
+        # cluster to.  Base = max(current count, any still-unmet earlier
+        # expectation) so concurrent launches stack (+1 each) and foreign
+        # or pre-existing nodes — counted in the base — never satisfy it.
+        base = max([self._alive_workers()]
+                   + list(self._expected_alive.values()))
+        self._expected_alive[pid] = base + 1
         self._launched[pid] = (name, time.monotonic())
 
     def _gang_launches(self, counts: Dict[str, int]) -> Dict[str, int]:
@@ -104,12 +119,27 @@ class Autoscaler:
         get_pid = getattr(self.provider, "node_os_pid", None)
         live = set(self.provider.non_terminated_nodes())
         now = time.monotonic()
+        n_alive = self._alive_workers()
         for pid, (_ntype, ts) in self._launched.items():
-            if pid not in live or now - ts > 120.0:
+            if pid not in live:
                 continue
+            if self._expected_alive.get(pid, 0) <= n_alive:
+                # Met (or pid-matched provider): stop tracking so later
+                # downscales don't inflate future launch baselines.
+                self._expected_alive.pop(pid, None)
+            if now - ts > 120.0:
+                continue  # never joined: spawn failure — stop blocking
             os_pid = get_pid(pid) if get_pid else None
-            if os_pid is not None and os_pid not in joined_os_pids:
-                return {}  # a launch is still joining; don't double-buy
+            if os_pid is not None:
+                if os_pid not in joined_os_pids:
+                    return {}  # still joining; don't double-buy
+            elif pid in self._expected_alive:
+                # Pid-less provider (cloud/TPU-pod): the worker count
+                # hasn't reached this launch's expectation yet, so the
+                # node is still booting (a multi-host slice takes
+                # minutes) — launching another full gang each tick would
+                # over-provision entire TPU slices.
+                return {}
         per_node = self.runtime.scheduler.per_node_available()
         to_launch: Dict[str, int] = {}
         for strategy, shapes, placed_nodes in gangs:

@@ -23,10 +23,12 @@ must be {"op": "auth", "token": "<hex>"}.  Then:
          results: map with cpp/include/ray_tpu/tensor_writer.hpp layout)
   {"op": "ping"} -> {"ok": true}
 
-Functions are explicitly registered server-side (``register_function``) —
-the gateway never unpickles or eval's anything a native client sends, so
-a client can only invoke what the owner exported (reference analog: the
-function-descriptor allowlists of cross-language calls).
+Functions and actors are explicitly registered server-side
+(``register_function`` / ``export_actor``) — the gateway never unpickles
+or eval's anything a native client sends, so a client can only invoke
+what the owner exported (reference analog: the function-descriptor
+allowlists of cross-language calls).  Exported actor handles are resolved
+once and cached; a restart-proof client re-exports.
 """
 
 from __future__ import annotations
@@ -48,6 +50,22 @@ def register_function(name: str, fn: Callable) -> None:
     wrapper is built once here so per-submit calls reuse the pickled
     function blob (fn_id caching downstream)."""
     _registry[name] = ray_tpu.remote(fn)
+
+
+# (actor name, namespace) -> (allowed method names | None=all public,
+# cached handle).  Mirrors register_function: native clients can only
+# drive actors the owner exported, and the handle resolves once instead
+# of a get_actor round-trip per call.
+_actor_exports: Dict[tuple, list] = {}
+
+
+def export_actor(name: str, namespace: Optional[str] = None,
+                 methods: Optional[list] = None) -> None:
+    """Export the named actor to native clients.  ``methods`` restricts
+    the callable surface; None allows every public (non-underscore)
+    method."""
+    _actor_exports[(name, namespace)] = [
+        None if methods is None else list(methods), None]
 
 
 class CppGateway:
@@ -156,10 +174,23 @@ class CppGateway:
             ref = remote.remote(*msg.get("args", []))
             return {"ok": True, "ref": self._track(ref)}
         if op == "call_actor":
-            info = ray_tpu.get_actor(msg["actor"],
-                                     namespace=msg.get("namespace"))
-            method = getattr(info, msg["method"])
-            ref = method.remote(*msg.get("args", []))
+            key = (msg["actor"], msg.get("namespace"))
+            export = _actor_exports.get(key)
+            if export is None:
+                return {"ok": False,
+                        "error": f"actor {key[0]!r} not exported"}
+            mname = msg["method"]
+            allowed = export[0]
+            if mname.startswith("_") or \
+                    (allowed is not None and mname not in allowed):
+                return {"ok": False,
+                        "error": f"method {mname!r} not exported"}
+            if export[1] is None:
+                export[1] = ray_tpu.get_actor(key[0], namespace=key[1])
+            # Submission never fails synchronously here — a stale handle
+            # (actor re-created under the name) surfaces as ActorError at
+            # get, which invalidates the cache (see the get op below).
+            ref = getattr(export[1], mname).remote(*msg.get("args", []))
             return {"ok": True, "ref": self._track(ref)}
         if op == "get":
             hexid = msg.get("ref", "")
@@ -167,7 +198,16 @@ class CppGateway:
                 ref = self._refs.get(hexid)
             if ref is None:
                 return {"ok": False, "error": f"unknown ref {hexid!r}"}
-            value = ray_tpu.get(ref, timeout=msg.get("timeout", 300))
+            try:
+                value = ray_tpu.get(ref, timeout=msg.get("timeout", 300))
+            except Exception as e:
+                from ray_tpu._private.exceptions import ActorError
+                if isinstance(e, ActorError):
+                    # The target may have been re-created under its name:
+                    # drop cached handles so the next call re-resolves.
+                    for exp in _actor_exports.values():
+                        exp[1] = None
+                raise
             with self._refs_lock:
                 self._refs.pop(hexid, None)
             import numpy as np

@@ -98,7 +98,12 @@ class InferenceEngine:
             partial(_model.decode_step, cfg=cfg, page_size=page_size),
             donate_argnums=(1, 2))
         self._decode_chunk = None
-        self._chunk_cache: Dict = {}        # (steps, temp, top_k) -> jit fn
+        # (steps, temp, top_k) -> jit fn.  LRU-bounded: varied sampling
+        # params across serving traffic must not grow the compiled-program
+        # set (and its device executable memory) without bound.
+        from collections import OrderedDict
+        self._chunk_cache: "OrderedDict" = OrderedDict()
+        self._chunk_cache_cap = 32
         self._chunk_key = jax.random.key(0)
         # Device-resident (tokens, positions) between chunks: valid while
         # no admission/finish mutated the host mirrors, so back-to-back
@@ -352,6 +357,10 @@ class InferenceEngine:
                             temperature=sp0.temperature, top_k=sp0.top_k),
                     donate_argnums=(1, 2))
                 self._chunk_cache[shape_key] = fn
+                while len(self._chunk_cache) > self._chunk_cache_cap:
+                    self._chunk_cache.popitem(last=False)
+            else:
+                self._chunk_cache.move_to_end(shape_key)
             self._decode_chunk = fn
             self._chunk_key, key = self._jax.random.split(self._chunk_key)
             if self._dev_state is not None:

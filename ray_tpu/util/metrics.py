@@ -240,12 +240,14 @@ def _escape_tag_value(v: str) -> str:
             .replace("\n", "\\n"))
 
 
-def prometheus_text() -> str:
-    """Render all known metrics in Prometheus exposition format."""
+def _aggregate_snapshots():
+    """Merge per-process snapshots per (sample name, tag set): counters
+    and histogram buckets sum across processes, gauges take the latest
+    writer.  The single merge rule both exporters share.  Returns
+    (metric-name -> snapshot meta, sample-name -> {tags-key -> (tags,
+    value)})."""
     by_name: Dict[str, Dict[str, Any]] = {}
-    # sample-name -> accumulated {tags-key -> value}; counters/histogram
-    # buckets sum across processes, gauges take the latest writer.
-    acc: Dict[str, Dict[Tuple, float]] = {}
+    acc: Dict[str, Dict[Tuple, tuple]] = {}
     for snap in _merged_snapshots():
         by_name.setdefault(snap["name"], snap)
         summable = snap["type"] in ("counter", "histogram")
@@ -253,9 +255,16 @@ def prometheus_text() -> str:
             bucket = acc.setdefault(sample_name, {})
             key = _tags_key(tags)
             if summable:
-                bucket[key] = bucket.get(key, 0.0) + value
+                prev = bucket.get(key)
+                bucket[key] = (tags, (prev[1] if prev else 0.0) + value)
             else:
-                bucket[key] = value
+                bucket[key] = (tags, value)
+    return by_name, acc
+
+
+def prometheus_text() -> str:
+    """Render all known metrics in Prometheus exposition format."""
+    by_name, acc = _aggregate_snapshots()
     lines: List[str] = []
     emitted_meta = set()
     for sample_name, bucket in acc.items():
@@ -269,7 +278,7 @@ def prometheus_text() -> str:
             if meta["description"]:
                 lines.append(f"# HELP {base} {meta['description']}")
             lines.append(f"# TYPE {base} {meta['type']}")
-        for key, value in sorted(bucket.items()):
+        for key, (_tags, value) in sorted(bucket.items()):
             if key:
                 tag_str = ",".join(
                     f'{k}="{_escape_tag_value(v)}"' for k, v in key)
@@ -325,7 +334,10 @@ def export_otlp_json(path: str) -> str:
     open_telemetry_metric_recorder.h — here the file-based OTLP/JSON
     flavor, importable by any OTLP-compatible backend).  Counters land as
     monotonic sums, gauges as gauges, histograms as explicit-bucket
-    histogram points."""
+    histogram points.  Per-process snapshots are aggregated per
+    (metric, tag-set) first — counters and histogram buckets sum,
+    gauges take the latest writer — so one OTLP document never carries
+    duplicate same-name points (mirrors prometheus_text)."""
     import json
 
     now_ns = int(time.time() * 1e9)
@@ -334,8 +346,27 @@ def export_otlp_json(path: str) -> str:
         return [{"key": k, "value": {"stringValue": str(v)}}
                 for k, v in sorted(tags.items())]
 
+    by_name, acc = _aggregate_snapshots()
+    samples_by_metric: Dict[str, list] = {}
+    for sample_name, bucket in acc.items():
+        base = sample_name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample_name.endswith(suffix) and \
+                    sample_name[: -len(suffix)] in by_name:
+                base = sample_name[: -len(suffix)]
+                break
+        # Insertion order, NOT sorted: histogram buckets must stay in the
+        # ascending-le order their snapshots emit (the cumulative ->
+        # per-bucket conversion below depends on it).
+        samples_by_metric.setdefault(base, []).extend(
+            (sample_name, tags, value)
+            for _k, (tags, value) in bucket.items())
+
     otlp_metrics = []
-    for snap in _merged_snapshots():
+    for name, meta in by_name.items():
+        snap = {"name": name, "type": meta["type"],
+                "description": meta.get("description", ""),
+                "samples": samples_by_metric.get(name, [])}
         base = {"name": snap["name"],
                 "description": snap.get("description", "")}
         mtype = snap["type"]

@@ -165,6 +165,8 @@ class TestCppGateway:
                 return self.v
 
         Counter.options(name="counter", namespace="cppns").remote()
+        cpp_gateway.export_actor("counter", namespace="cppns",
+                                 methods=["bump"])
 
         gw = cpp_gateway.start()
         try:
