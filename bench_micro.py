@@ -165,6 +165,23 @@ def main() -> None:
 
     ray_tpu.shutdown()
 
+    # -- 2-node cluster variant: the same n:n pattern with the actors on a
+    # REMOTE node, driven over the driver's caller->actor direct channels
+    # (cluster.py DirectChannel) instead of the in-process fast path.
+    # There is no reference baseline for this shape; the single-node
+    # n_n baseline is printed for context only.
+    from ray_tpu.cluster_utils import Cluster
+    with Cluster(head_num_cpus=0) as c:
+        c.add_node(num_cpus=4)
+        c.add_node(num_cpus=4)
+        actors2 = [Echo.remote() for _ in range(n_actors)]
+        ray_tpu.get([a.ping.remote() for a in actors2])
+        per2 = int(125 * scale)
+        timeit("n_n_actor_calls_async_2node",
+               lambda: ray_tpu.get([a.ping.remote() for a in actors2
+                                    for _ in range(per2)]),
+               multiplier=n_actors * per2)
+
 
 if __name__ == "__main__":
     main()

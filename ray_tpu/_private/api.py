@@ -69,7 +69,7 @@ class ObjectRefGenerator:
             # worker-side facade: block through a get to learn the state
             raise RuntimeError(
                 "ObjectRefGenerator iteration is driver-side only")
-        st.event.wait()
+        st.wait()
         if isinstance(st.desc, tuple) and st.desc and st.desc[0] == "end":
             self._terminated = True
             raise StopIteration
@@ -368,15 +368,21 @@ class ActorMethod:
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._qual: Optional[str] = None   # "Cls.method", built on first use
 
     def options(self, **opts) -> "ActorMethod":
         return ActorMethod(self._handle, self._name,
                            opts.get("num_returns", self._num_returns))
 
     def remote(self, *args, **kwargs):
+        qual = self._qual
+        if qual is None:
+            qual = self._qual = \
+                f"{self._handle._class_name}.{self._name}"
         return _submit_actor_task(
             self._handle, method_name=self._name, fn_blob=None,
-            args=args, kwargs=kwargs, num_returns=self._num_returns)
+            args=args, kwargs=kwargs, num_returns=self._num_returns,
+            qual=qual)
 
     def bind(self, *args, **kwargs):
         """Lazy DAG node for this method call (reference: dag/dag_node.py —
@@ -386,7 +392,7 @@ class ActorMethod:
 
 
 def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
-                       args, kwargs, num_returns: Any):
+                       args, kwargs, num_returns: Any, qual=None):
     """Shared submit path for actor methods and __ray_call__ applies.
     ``num_returns="streaming"`` runs a generator method: yielded items
     publish one-by-one and the caller gets an ObjectRefGenerator
@@ -398,11 +404,18 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
     rt = _require_runtime()
     streaming = num_returns == "streaming"
     task_id = TaskID.of(handle._actor_id)
-    return_ids = [] if streaming else [
-        ObjectID.of(task_id, i) for i in range(num_returns)]
+    if streaming:
+        return_ids = []
+    elif num_returns == 1:
+        return_ids = [ObjectID.of(task_id, 0)]
+    else:
+        return_ids = [ObjectID.of(task_id, i) for i in range(num_returns)]
     nested: List[ObjectID] = []
-    arg_descs = [_pack_arg(a, nested) for a in args]
-    kwarg_descs = {k: _pack_arg(v, nested) for k, v in kwargs.items()}
+    arg_descs = [_pack_arg(a, nested) for a in args] if args else []
+    kwarg_descs = {k: _pack_arg(v, nested)
+                   for k, v in kwargs.items()} if kwargs else {}
+    if qual is None:
+        qual = f"{handle._class_name}.{method_name or '__ray_call__'}"
     tracing_on = _tracing._enabled or _tracing.current() is not None
     if (not streaming and method_name is not None and not tracing_on
             and not nested
@@ -410,8 +423,7 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
             and all(d[0] == "val" for d in arg_descs)
             and all(d[0] == "val" for d in kwarg_descs.values())):
         if rt.submit_actor_direct(
-                handle._actor_id, task_id,
-                f"{handle._class_name}.{method_name}", method_name,
+                handle._actor_id, task_id, qual, method_name,
                 return_ids,
                 [("inline", p) for _t, p in arg_descs],
                 {k: ("inline", p) for k, (_t, p) in kwarg_descs.items()},
@@ -434,8 +446,7 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
         wire_kwargs = {k: ("inline", p)
                        for k, (_t, p) in kwarg_descs.items()}
         if rt.submit_actor_direct(
-                handle._actor_id, task_id,
-                f"{handle._class_name}.{method_name or '__ray_call__'}",
+                handle._actor_id, task_id, qual,
                 method_name, return_ids, wire_args, wire_kwargs,
                 handle._max_concurrency, streaming, fn_blob=fn_blob):
             if streaming:
@@ -444,7 +455,7 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
             return refs[0] if num_returns == 1 else refs
     spec = TaskSpec(
         task_id=task_id,
-        name=f"{handle._class_name}.{method_name or '__ray_call__'}",
+        name=qual,
         fn_blob=fn_blob, method_name=method_name,
         arg_descs=arg_descs, kwarg_descs=kwarg_descs,
         nested_refs=tuple(nested),
@@ -452,11 +463,8 @@ def _submit_actor_task(handle: "ActorHandle", *, method_name, fn_blob,
         actor_id=handle._actor_id,
         max_concurrency=handle._max_concurrency,
         streaming=streaming,
-        trace_ctx=_tracing.submit_span(
-            f"{handle._class_name}.{method_name or '__ray_call__'}",
-            task_id.hex())
-        if (_tracing._enabled or _tracing.current() is not None)
-        else None)
+        trace_ctx=_tracing.submit_span(qual, task_id.hex())
+        if tracing_on else None)
     rt.submit_spec(spec)
     if streaming:
         return ObjectRefGenerator(task_id)
@@ -490,7 +498,12 @@ class ActorHandle:
             return _RayCallMethod(self)
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        # Memoize: the hot loop `handle.m.remote()` must not allocate a
+        # fresh ActorMethod per call.  Instance-dict entries win over
+        # __getattr__, so this runs once per (handle, method).
+        m = ActorMethod(self, name)
+        self.__dict__[name] = m
+        return m
 
     def __reduce__(self):
         return (ActorHandle,

@@ -105,6 +105,12 @@ class DispatchTask:
     args: list
     kwargs: dict
     target_worker: Optional[WorkerID]
+    # Pipelined (lease-less) dispatch: queue ahead on a busy pooled worker
+    # instead of granting a booked lease; the node answers with
+    # UpPipelineReject when no worker has pipeline room (reference: the
+    # C++ submitter's max_tasks_in_flight_per_worker pipelining,
+    # normal_task_submitter.cc:516).
+    pipelined: bool = False
 
 
 @dataclass
@@ -192,6 +198,14 @@ class UpDispatchFailed:
     spec: TaskSpec
     reason: str
     lost_object_bytes: Optional[bytes] = None
+
+
+@dataclass
+class UpPipelineReject:
+    """Node -> head: a pipelined dispatch found no worker with queue room;
+    the head returns the task's credit and resubmits through normal
+    (booked) scheduling."""
+    spec: TaskSpec
 
 
 @dataclass
@@ -558,13 +572,15 @@ class RemoteNodeProxy:
     # -- NodeManager surface -------------------------------------------------
 
     def dispatch_task(self, spec: TaskSpec, resolved_args, resolved_kwargs,
-                      target_worker: Optional[WorkerID] = None) -> None:
+                      target_worker: Optional[WorkerID] = None,
+                      pipelined: bool = False) -> None:
         # Untagged descriptors in the head directory are head-local; tag
         # them so the receiving node knows where to pull from.
         hid = self.head.runtime.node_id.binary()
         args = [tag_desc(d, hid) for d in resolved_args]
         kwargs = {k: tag_desc(d, hid) for k, d in resolved_kwargs.items()}
-        self.send(DispatchTask(spec, args, kwargs, target_worker))
+        self.send(DispatchTask(spec, args, kwargs, target_worker,
+                               pipelined=pipelined))
 
     def send_to_worker(self, worker_id: WorkerID, msg) -> None:
         self.send(ToWorker(worker_id, msg))
@@ -899,6 +915,8 @@ class HeadServer:
         elif isinstance(msg, UpDispatchFailed):
             rt.on_dispatch_failed(msg.spec, msg.reason,
                                   lost_object_bytes=msg.lost_object_bytes)
+        elif isinstance(msg, UpPipelineReject):
+            rt.on_pipeline_reject(msg.spec, nid)
         elif isinstance(msg, UpFailTask):
             rt.fail_task_bytes(msg.task_id_bytes, msg.return_id_bytes,
                                msg.reason)
@@ -1303,6 +1321,10 @@ class NodeServer:
 
     def _do_dispatch(self, msg: DispatchTask) -> None:
         args, kwargs = self.puller.localize_all(msg.args, msg.kwargs)
+        if getattr(msg, "pipelined", False):
+            if not self.node.dispatch_pipelined(msg.spec, args, kwargs):
+                self.send_up(UpPipelineReject(msg.spec))
+            return
         self.node.dispatch_task(msg.spec, args, kwargs,
                                 target_worker=msg.target_worker)
 

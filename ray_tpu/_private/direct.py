@@ -155,7 +155,7 @@ class DirectChannel:
     calls fail fast)."""
 
     def __init__(self, owner, actor_id):
-        self.owner = owner          # WorkerRuntime
+        self.owner = owner          # WorkerRuntime (or the driver adapter)
         self.actor_id = actor_id
         self.lock = threading.Lock()
         self.state = "RESOLVING"
@@ -164,6 +164,21 @@ class DirectChannel:
         self.inflight: Dict[bytes, List] = {}   # task_id -> return_ids
         self.buffered: List[tuple] = []         # frames awaiting resolve
         self._resolver_running = False
+        self._closed = False
+
+    def close(self) -> None:
+        """Owner shutdown: stop resolving, fail nothing (the owner is
+        going away with its refs)."""
+        with self.lock:
+            self._closed = True
+            self.state = "DEAD"
+            self.death_cause = "runtime shut down"
+            try:
+                if self.conn is not None:
+                    self.conn.close()
+            except Exception:
+                pass
+            self.conn = None
 
     # -- submission ------------------------------------------------------- #
 
@@ -230,6 +245,8 @@ class DirectChannel:
         delay = 0.02
         deadline = time.monotonic() + 120.0
         while True:
+            if self._closed:
+                return
             try:
                 res = self.owner.control("resolve_actor_direct",
                                          self.actor_id.binary())

@@ -732,6 +732,23 @@ class NodeManager:
         self._send(handle, wire.encode_run_task(
             spec, resolved_args, resolved_kwargs, spec.fn_blob))
 
+    @staticmethod
+    def _pipeline_eligible(h, max_depth: int) -> bool:
+        """Can this pooled worker take a queued-ahead (pipelined) task?
+        Single definition shared by the has_pipeline_room precheck and
+        the dispatch_pipelined selection loop — they must never drift."""
+        return (h.state in (BUSY, IDLE) and h.actor_id is None
+                and not h.dedicated and h.env_key == ""
+                and h.ready.is_set() and len(h.running) < max_depth)
+
+    def has_pipeline_room(self, max_depth: int = 4) -> bool:
+        """Cheap precheck for dispatch_pipelined: is any pooled worker
+        below the queue-ahead depth cap?  Lets the topup loop skip the
+        resolve/queue/requeue cycle when the pool is full."""
+        with self._lock:
+            return any(self._pipeline_eligible(h, max_depth)
+                       for h in self._workers.values())
+
     def dispatch_pipelined(self, spec: TaskSpec, resolved_args,
                            resolved_kwargs, max_depth: int = 4) -> bool:
         """Queue a plain task ahead on a busy pooled worker (pipelined
@@ -745,10 +762,7 @@ class NodeManager:
             best = None
             best_depth = max_depth
             for h in self._workers.values():
-                if (h.state in (BUSY, IDLE) and h.actor_id is None
-                        and not h.dedicated and h.env_key == ""
-                        and h.ready.is_set()
-                        and len(h.running) < best_depth):
+                if self._pipeline_eligible(h, best_depth):
                     best = h
                     best_depth = len(h.running)
             if best is None:

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import serialization
+from . import wire as _wire
 from .config import Config
 from .events import (FAILED, FINISHED, PENDING_ARGS, RUNNING,
                      SUBMITTED_TO_NODE, ProfileSpan, TaskEventBuffer)
@@ -66,44 +67,74 @@ def driver_runtime() -> Optional["Runtime"]:
 
 
 class ObjectState:
-    __slots__ = ("event", "desc", "callbacks", "lock")
+    """One object-directory entry.  The direct-call fast path creates
+    tens of thousands per second, so construction must be
+    allocation-light: the real threading.Event (whose Condition is the
+    single most expensive allocation on the submit path) is created
+    lazily, only when a consumer blocks before the result lands.
+    ``ready`` is a plain bool flipped under the class-wide lock; readers
+    may peek it unlocked (GIL write-once visibility — the same guarantee
+    Event.is_set() gave).  The shared lock is fine: every critical
+    section is O(1) and tiny."""
+
+    __slots__ = ("ready", "desc", "callbacks", "_evt")
+    _lock = threading.Lock()
 
     def __init__(self):
-        self.event = threading.Event()
+        self.ready = False
         self.desc = None
-        self.callbacks: List[Callable[[], None]] = []
-        self.lock = threading.Lock()
+        self.callbacks: Optional[List[Callable[[], None]]] = None
+        self._evt: Optional[threading.Event] = None
 
     def mark_ready(self, desc) -> None:
-        with self.lock:
-            if self.event.is_set():
+        with ObjectState._lock:
+            if self.ready:
                 return
             self.desc = desc
-            self.event.set()
-            cbs, self.callbacks = self.callbacks, []
-        for cb in cbs:
+            self.ready = True
+            evt = self._evt
+            cbs, self.callbacks = self.callbacks, None
+        if evt is not None:
+            evt.set()
+        for cb in cbs or ():
             cb()
 
     def reset(self) -> None:
         """Back to pending (object lost; reconstruction in flight) so
-        consumers block on the event until the re-executed task delivers."""
-        with self.lock:
+        consumers block until the re-executed task delivers."""
+        with ObjectState._lock:
             self.desc = None
-            self.event.clear()
+            self.ready = False
+            if self._evt is not None:
+                self._evt.clear()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self.ready:
+            return True
+        with ObjectState._lock:
+            if self.ready:
+                return True
+            evt = self._evt
+            if evt is None:
+                evt = self._evt = threading.Event()
+        return evt.wait(timeout)
 
     def add_callback(self, cb: Callable[[], None]) -> None:
-        with self.lock:
-            if not self.event.is_set():
+        with ObjectState._lock:
+            if not self.ready:
+                if self.callbacks is None:
+                    self.callbacks = []
                 self.callbacks.append(cb)
                 return
         cb()
 
     def discard_callback(self, cb: Callable[[], None]) -> None:
-        with self.lock:
-            try:
-                self.callbacks.remove(cb)
-            except ValueError:
-                pass
+        with ObjectState._lock:
+            if self.callbacks:
+                try:
+                    self.callbacks.remove(cb)
+                except ValueError:
+                    pass
 
 
 def _has_remote_desc(args, kwargs) -> bool:
@@ -139,6 +170,34 @@ class _ActorRuntimeState:
     # Direct-call listener of the actor's worker (direct.py); set on the
     # worker's "alive" report, cleared on worker death.
     direct_addr: Optional[Tuple[str, int]] = None
+    # Driver->actor direct channel (cluster mode).  driver_mode flips to
+    # "direct" (sticky) the first time a fast-path call finds the actor
+    # quiescent — no queued/unbound calls AND no classic dispatches still
+    # in flight — so a channel frame can never overtake a classic one.
+    driver_mode: Optional[str] = None
+    driver_ch: Any = None
+    classic_inflight: set = field(default_factory=set)
+
+
+class _DriverChannelOwner:
+    """DirectChannel owner shim for the driver Runtime: actor resolution
+    goes straight to the controller; channel replies land in the driver's
+    object directory (local_ready -> mark_ready).  Non-inline results
+    arrive upstream as a normal TaskDone from the actor's node — which
+    registers and marks them ready — so the channel's "upstream" signal
+    is a no-op here."""
+
+    def __init__(self, rt):
+        self.rt = rt
+        self.direct_token = rt.node.direct_token
+
+    def control(self, method: str, *args):
+        return getattr(self.rt, "ctl_" + method)(*args)
+
+    def local_ready(self, oid_bytes: bytes, desc) -> None:
+        if desc and desc[0] == "upstream":
+            return
+        self.rt.mark_ready(ObjectID(oid_bytes), desc)
 
 
 class Runtime:
@@ -278,6 +337,19 @@ class Runtime:
         # Tasks queued ahead on a busy worker (pipelined submission):
         # they hold no resource booking, so TaskDone skips release.
         self._pipelined: set = set()
+        # Per-node credit accounting for REMOTE pipelining (reference: the
+        # C++ submitter's per-lease in-flight cap,
+        # normal_task_submitter.cc:516): at most _pipeline_cap(node)
+        # lease-less tasks ride ahead to each remote node; a credit
+        # returns on TaskDone/failure/UpPipelineReject.
+        self._pipeline_credits: Dict[NodeID, int] = {}
+        self._pipelined_node: Dict[TaskID, NodeID] = {}
+        self._pipeline_lock = threading.Lock()
+        # node_id -> monotonic deadline: a node that just rejected a
+        # pipelined dispatch is skipped until the deadline, so a full
+        # pool doesn't ping-pong tasks head<->node (localizing args each
+        # round trip) while nothing has changed.
+        self._pipeline_cooldown: Dict[NodeID, float] = {}
         self.node = NodeManager(node_info, self, num_tpu_chips=int(num_tpus or 0))
         self.scheduler.add_node(node_info)
         self.nodes: Dict[NodeID, NodeManager] = {self.node_id: self.node}
@@ -406,7 +478,7 @@ class Runtime:
     def _object_ready(self, object_id: ObjectID) -> bool:
         with self._dir_lock:
             st = self.directory.get(object_id)
-        return st is not None and st.event.is_set()
+        return st is not None and st.ready
 
     def mark_ready(self, object_id: ObjectID, desc) -> None:
         self._state(object_id).mark_ready(desc)
@@ -504,7 +576,7 @@ class Runtime:
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
                 raise GetTimeoutError("get timed out")
-            if not st.event.wait(remaining):
+            if not st.wait(remaining):
                 raise GetTimeoutError("get timed out")
         values = []
         max_attempts = int(Config.get("object_reconstruction_max_attempts"))
@@ -523,7 +595,7 @@ class Runtime:
                         raise
                     remaining = None if deadline is None else \
                         deadline - time.monotonic()
-                    if not st.event.wait(remaining):
+                    if not st.wait(remaining):
                         raise GetTimeoutError(
                             "get timed out during object reconstruction")
             if last is not None:
@@ -541,33 +613,42 @@ class Runtime:
                 "refs passed to wait()")
         deadline = None if timeout is None else time.monotonic() + timeout
         cond = threading.Condition()
-        n_ready = [0]
+        states = self._states(object_ids)
+        # Count already-ready objects up front and register callbacks only
+        # on pending ones; the callback wakes the waiter ONCE, when the
+        # count crosses num_returns — a 1k-ref wait must not pay 1k
+        # wakeups (reference: WaitManager's single completion signal).
+        pending_states = [st for st in states if not st.ready]
+        n_ready = [len(states) - len(pending_states)]
 
         def on_ready():
             with cond:
                 n_ready[0] += 1
-                cond.notify()
+                if n_ready[0] >= num_returns:
+                    cond.notify()
 
-        states = self._states(object_ids)
-        for st in states:
-            st.add_callback(on_ready)
-        try:
-            with cond:
-                while n_ready[0] < num_returns:
-                    remaining = None if deadline is None else \
-                        deadline - time.monotonic()
-                    if remaining is not None and remaining <= 0:
-                        break
-                    cond.wait(remaining)
-        finally:
-            # Unregister from still-pending states: polling wait() loops
-            # must not accumulate dead closures on never-ready objects.
-            for st in states:
-                st.discard_callback(on_ready)
-        ready = [o for o, st in zip(object_ids, states) if st.event.is_set()]
+        if n_ready[0] < num_returns:
+            for st in pending_states:
+                st.add_callback(on_ready)
+            try:
+                with cond:
+                    while n_ready[0] < num_returns:
+                        remaining = None if deadline is None else \
+                            deadline - time.monotonic()
+                        if remaining is not None and remaining <= 0:
+                            break
+                        cond.wait(remaining)
+            finally:
+                # Unregister from still-pending states: polling wait()
+                # loops must not accumulate dead closures on never-ready
+                # objects.
+                for st in pending_states:
+                    st.discard_callback(on_ready)
+        ready = [o for o, st in zip(object_ids, states) if st.ready]
         ready = ready[:max(num_returns, 0)] if len(ready) > num_returns \
             else ready
-        pending = [o for o in object_ids if o not in set(ready)]
+        ready_set = set(ready)
+        pending = [o for o in object_ids if o not in ready_set]
         return ready, pending
 
     def _track_view(self, oid: ObjectID, value: Any) -> None:
@@ -730,7 +811,7 @@ class Runtime:
                     continue
                 with self._dir_lock:
                     st = self.directory.get(oid)
-                if st is not None and not st.event.is_set():
+                if st is not None and not st.ready:
                     self._dropped.add(oid)
                 else:
                     to_free.append(oid)
@@ -750,7 +831,7 @@ class Runtime:
                 if self._collectable_locked(oid):
                     with self._dir_lock:
                         st = self.directory.get(oid)
-                    if st is not None and not st.event.is_set():
+                    if st is not None and not st.ready:
                         # Producing task still in flight: collect at
                         # mark_ready instead.
                         self._dropped.add(oid)
@@ -884,7 +965,7 @@ class Runtime:
         for dep in deps:
             with self._dir_lock:
                 st = self.directory.get(dep)
-            if st is None or not st.event.is_set():
+            if st is None or not st.ready:
                 if self._recover_object(dep) is None:
                     err = ("err", serialization.pack_payload(ObjectLostError(
                         f"object {oid} is unrecoverable: its input {dep} "
@@ -1058,28 +1139,97 @@ class Runtime:
                 self.scheduler.submit(nxt.spec, nxt.dispatch)
                 return
 
+    def _pipeline_cap(self, node_id: NodeID) -> int:
+        """In-flight pipelined-task cap for a remote node: ~2 queued-ahead
+        tasks per pooled worker (reference: the per-worker in-flight cap of
+        the C++ submitter's pipelining)."""
+        info = self.controller.nodes.get(node_id)
+        cpus = info.total_resources.get("CPU") if info is not None else 1.0
+        return max(2, min(32, int(2 * (cpus or 1.0))))
+
     def _try_pipeline(self, spec: TaskSpec) -> bool:
         """Scheduler callback when the cluster is full: queue the task
-        ahead on a busy local worker (no booking) to hide the
-        done->dispatch round trip.  Single-node only — remote pipelining
-        would need per-node credit accounting."""
-        if len(self.nodes) > 1 or self._puller is not None:
+        ahead on a busy worker (no booking) to hide the done->dispatch
+        round trip.  Local workers take it synchronously; remote nodes
+        take it under per-node credit accounting, answering
+        UpPipelineReject when their pools have no queue room."""
+        if len(self.nodes) == 1 and self._puller is None \
+                and not self.node.has_pipeline_room():
+            # Cheap precheck: a full pool means resolve/queue/requeue
+            # below is guaranteed wasted work (the topup loop runs on
+            # every TaskDone).
             return False
         try:
             args, kwargs = self._resolve(spec)
         except _DepsPending:
             return False
+        if len(self.nodes) == 1 and self._puller is None:
+            with self._running_lock:
+                self._running[spec.task_id] = _RunningTask(spec,
+                                                           self.node_id)
+            self._pipelined.add(spec.task_id)
+            if self.node.dispatch_pipelined(spec, args, kwargs):
+                self.events.record(spec.task_id.hex(), SUBMITTED_TO_NODE,
+                                   node_id=self.node_id.hex())
+                return True
+            self._pipelined.discard(spec.task_id)
+            with self._running_lock:
+                self._running.pop(spec.task_id, None)
+            return False
+        # Cluster: pick the remote node with the most spare credit (the
+        # local node is excluded — its dispatches ride the ordered
+        # transfer queue, where queue-ahead wins nothing).  Credit
+        # mutations happen under _pipeline_lock: submit threads and the
+        # completion (poller) thread race here, and a lost decrement
+        # would leak credits until pipelining silently turned off.
+        now = time.monotonic()
+        with self._pipeline_lock:
+            best, best_spare = None, 0
+            for nid, node in self.nodes.items():
+                if not getattr(node, "is_remote", False):
+                    continue
+                if self._pipeline_cooldown.get(nid, 0.0) > now:
+                    continue
+                spare = self._pipeline_cap(nid) - \
+                    self._pipeline_credits.get(nid, 0)
+                if spare > best_spare:
+                    best, best_spare = nid, spare
+            if best is None:
+                return False
+            node = self.nodes.get(best)
+            if node is None:
+                return False
+            self._pipelined_node[spec.task_id] = best
+            self._pipeline_credits[best] = \
+                self._pipeline_credits.get(best, 0) + 1
         with self._running_lock:
-            self._running[spec.task_id] = _RunningTask(spec, self.node_id)
+            self._running[spec.task_id] = _RunningTask(spec, best)
         self._pipelined.add(spec.task_id)
-        if self.node.dispatch_pipelined(spec, args, kwargs):
-            self.events.record(spec.task_id.hex(), SUBMITTED_TO_NODE,
-                               node_id=self.node_id.hex())
-            return True
-        self._pipelined.discard(spec.task_id)
+        node.dispatch_task(spec, args, kwargs, pipelined=True)
+        self.events.record(spec.task_id.hex(), SUBMITTED_TO_NODE,
+                           node_id=best.hex())
+        return True
+
+    def _return_pipeline_credit(self, task_id: TaskID) -> None:
+        with self._pipeline_lock:
+            nid = self._pipelined_node.pop(task_id, None)
+            if nid is not None and nid in self._pipeline_credits:
+                self._pipeline_credits[nid] = max(
+                    0, self._pipeline_credits[nid] - 1)
+
+    def on_pipeline_reject(self, spec: TaskSpec, node_id: NodeID) -> None:
+        """A remote node had no pipeline room: return the credit, put the
+        node on a short pipelining cooldown (otherwise the empty-queue
+        fast path would bounce the task straight back, re-localizing its
+        args each round trip), and run the task through normal (booked)
+        scheduling."""
         with self._running_lock:
             self._running.pop(spec.task_id, None)
-        return False
+        self._pipelined.discard(spec.task_id)
+        self._return_pipeline_credit(spec.task_id)
+        with self._pipeline_lock:
+            self._pipeline_cooldown[node_id] = time.monotonic() + 0.5
+        self.scheduler.submit(spec, self._dispatch_normal)
 
     def _dispatch_normal(self, spec: TaskSpec, node_id: NodeID) -> None:
         try:
@@ -1125,6 +1275,12 @@ class Runtime:
         self.scheduler.submit(spec, self._dispatch_normal)
 
     def _actor_state(self, actor_id: ActorID) -> _ActorRuntimeState:
+        # Lock-free read first: dict.get is GIL-atomic and entries are
+        # never replaced once inserted, so the hot path (one lookup per
+        # direct call) skips the lock.
+        st = self._actors.get(actor_id)
+        if st is not None:
+            return st
         with self._actors_lock:
             st = self._actors.get(actor_id)
             if st is None:
@@ -1189,6 +1345,9 @@ class Runtime:
                 ast.pending_bind.append((spec, args, kwargs))
                 return
             node_id, worker_id = ast.node_id, ast.worker_id
+            # Classic dispatches in flight block the driver channel from
+            # activating (frames on two transports must never reorder).
+            ast.classic_inflight.add(spec.task_id)
         node = self.nodes.get(node_id)
         if node is None:
             self._fail_task(spec, ActorError(
@@ -1228,45 +1387,107 @@ class Runtime:
         on_task_done: the call frame goes directly to the bound worker and
         the reply is routed by ``on_direct_task_done`` via
         ``_direct_inflight``.  Falls back (returns False) whenever ordering
-        or placement needs the full path: worker unbound/restarting, queued
-        calls ahead (per-caller submission order must hold), remote actor
-        node, or a cluster data plane whose dispatches ride the transfer
-        queue.  Actor method results are not lineage-reconstructable either
-        way, so skipping lineage loses nothing."""
-        from . import wire as _wire
+        needs the full path: worker unbound/restarting, or queued calls
+        ahead (per-caller submission order must hold).
+
+        Cluster mode: the driver opens its own caller->actor channel
+        (direct.py DirectChannel over TCP) to actors on remote nodes — and
+        to local actors whose classic dispatches ride the ordered transfer
+        queue — activating it (sticky) only at quiescence: no queued or
+        in-flight classic dispatches, so a channel frame can never
+        overtake a classic one.  Channel calls record no task events
+        (mirrors worker->worker direct calls); calls with ref args still
+        take the classic path, which is unordered relative to the channel
+        — the same documented trade the worker-side channels make."""
         ast = self._actor_state(actor_id)
         tb = task_id.binary()
+        if ast.driver_mode == "direct":
+            return self._submit_via_channel(
+                ast, actor_id, tb, name, method_name, return_ids, args,
+                kwargs, max_concurrency)
         with ast.lock:
             if (ast.worker_id is None or ast.pending_bind
                     or ast.next_dispatch != ast.next_seq):
                 return False
             node = self.nodes.get(ast.node_id)
-            if node is None or getattr(node, "is_remote", False) \
-                    or self._xfer_q is not None:
+            if node is None:
                 return False
-            # Claim the sequence slot and ship while still holding
-            # ast.lock so a concurrently submitted call claiming seq N+1
-            # cannot reach the worker pipe before this frame (seq N).
-            ast.next_seq += 1
-            ast.next_dispatch += 1
-            if self._gc_enabled:
-                # Pending states must exist before a ref drop can arrive
-                # (see submit_spec's pre-create note).
-                self._states(return_ids)
-            with self._direct_lock:
-                self._direct_inflight[tb] = (actor_id, return_ids, name)
-            frame = (_wire.RUN_TASK, tb, name, None, None, method_name,
-                     tuple(r.binary() for r in return_ids),
-                     actor_id.binary(), False, max_concurrency, None,
-                     args, kwargs, None)
-            sent = node.send_direct(ast.worker_id, frame)
-        if not sent:
+            if getattr(node, "is_remote", False) or \
+                    self._xfer_q is not None:
+                if ast.classic_inflight or ast.direct_addr is None:
+                    return False  # not quiescent yet: classic this call
+                ast.driver_mode = "direct"
+            if ast.driver_mode == "direct":
+                pass  # channel submission happens outside ast.lock
+            else:
+                return self._submit_direct_local(
+                    ast, node, actor_id, tb, name, method_name,
+                    return_ids, args, kwargs, max_concurrency)
+        return self._submit_via_channel(
+            ast, actor_id, tb, name, method_name, return_ids, args,
+            kwargs, max_concurrency)
+
+    def _submit_direct_local(self, ast, node, actor_id: ActorID,
+                             tb: bytes, name: str, method_name: str,
+                             return_ids: List[ObjectID], args: list,
+                             kwargs: dict, max_concurrency: int) -> bool:
+        """The in-process fast path (caller holds ast.lock)."""
+        # Claim the sequence slot and ship while still holding
+        # ast.lock so a concurrently submitted call claiming seq N+1
+        # cannot reach the worker pipe before this frame (seq N).
+        ast.next_seq += 1
+        ast.next_dispatch += 1
+        if self._gc_enabled:
+            # Pending states must exist before a ref drop can arrive
+            # (see submit_spec's pre-create note).  The oids are freshly
+            # minted — no concurrent creator exists — so GIL-atomic
+            # setitem is enough (skips the directory lock).
+            directory = self.directory
+            for oid in return_ids:
+                if oid not in directory:
+                    directory[oid] = ObjectState()
+        with self._direct_lock:
+            self._direct_inflight[tb] = (actor_id, return_ids, name)
+        frame = (_wire.RUN_TASK, tb, name, None, None, method_name,
+                 tuple(r.binary() for r in return_ids),
+                 actor_id.binary(), False, max_concurrency, None,
+                 args, kwargs, None)
+        if not node.send_direct(ast.worker_id, frame):
             with self._direct_lock:
                 self._direct_inflight.pop(tb, None)
             desc = ("err", serialization.pack_payload(ActorError(
                 actor_id, "actor worker died before the call was sent")))
             for oid in return_ids:
                 self.mark_ready(oid, desc)
+        return True
+
+    def _submit_via_channel(self, ast, actor_id: ActorID, tb: bytes,
+                            name: str, method_name: str,
+                            return_ids: List[ObjectID], args: list,
+                            kwargs: dict, max_concurrency: int) -> bool:
+        """Driver->actor direct channel (cluster mode): the frame rides
+        the driver's own TCP connection to the actor's worker — the
+        head's control plane sees neither the call nor its inline reply
+        (reference: caller->executor pushes as the cluster default,
+        normal_task_submitter.cc:516, actor_task_submitter.h:68)."""
+        ch = ast.driver_ch
+        if ch is None:
+            with ast.lock:
+                ch = ast.driver_ch
+                if ch is None:
+                    from .direct import DirectChannel
+                    ch = DirectChannel(_DriverChannelOwner(self), actor_id)
+                    ast.driver_ch = ch
+                    with ch.lock:
+                        ch._ensure_resolver_locked()
+        # Object states must exist before the frame ships: the inline
+        # reply can land on the channel's recv thread immediately.
+        self._states(return_ids)
+        frame = (_wire.RUN_TASK, tb, name, None, None, method_name,
+                 tuple(r.binary() for r in return_ids),
+                 actor_id.binary(), False, max_concurrency, None,
+                 args, kwargs, None)
+        ch.submit(frame, return_ids)
         return True
 
     def on_direct_task_done(self, t: tuple) -> bool:
@@ -1355,6 +1576,11 @@ class Runtime:
         with self._running_lock:
             running = self._running.pop(msg.task_id, None)
         spec = running.spec if running else None
+        if spec is not None and spec.actor_id is not None:
+            with self._actors_lock:
+                ast = self._actors.get(spec.actor_id)
+            if ast is not None:
+                ast.classic_inflight.discard(spec.task_id)
         resubmit = False
         if msg.error is not None:
             # A task that failed because an *input* object was lost gets
@@ -1396,6 +1622,7 @@ class Runtime:
             # or exchange, but the freed worker-queue slot can take the
             # next queued task.
             self._pipelined.discard(spec.task_id)
+            self._return_pipeline_credit(spec.task_id)
             self._pipeline_topup()
         elif spec is not None and spec.create_actor_id is None:
             # Actor creation keeps its resources for the actor's lifetime.
@@ -1468,6 +1695,7 @@ class Runtime:
             spec = running.spec
             if spec.task_id in self._pipelined:
                 self._pipelined.discard(spec.task_id)
+                self._return_pipeline_credit(spec.task_id)
             elif spec.create_actor_id is None and (
                     not spec.resources.is_empty()
                     or spec.placement_group is not None):
@@ -1487,6 +1715,11 @@ class Runtime:
         self._finish_recovery(task_id)
 
     def _fail_task(self, spec: TaskSpec, exc: Exception) -> None:
+        if spec.actor_id is not None:
+            with self._actors_lock:
+                ast = self._actors.get(spec.actor_id)
+            if ast is not None:
+                ast.classic_inflight.discard(spec.task_id)
         self.events.record(spec.task_id.hex(), FAILED, name=spec.name,
                            error_message=repr(exc))
         self._release_deps(spec.task_id)
@@ -1503,7 +1736,7 @@ class Runtime:
         i = 0
         while True:
             st = self._state(ObjectID.of(task_id, i))
-            if not st.event.is_set():
+            if not st.ready:
                 st.mark_ready(err_desc)
                 self.scheduler.notify_object_ready(ObjectID.of(task_id, i))
                 return
@@ -1529,6 +1762,7 @@ class Runtime:
                 # Pipelined task: no booking to release; the resubmit
                 # below goes through normal (booked) submission.
                 self._pipelined.discard(spec.task_id)
+                self._return_pipeline_credit(spec.task_id)
             elif spec.create_actor_id is None and (
                     not spec.resources.is_empty()
                     or spec.placement_group is not None):
@@ -1563,6 +1797,9 @@ class Runtime:
             ast.worker_id = None
             ast.node_id = None
             ast.direct_addr = None
+            # Classic frames to the dead worker can't be in flight anymore;
+            # a stale entry would wedge driver-channel activation forever.
+            ast.classic_inflight.clear()
         # Release the actor's held creation resources.
         if info.creation_spec is not None:
             cs = info.creation_spec
@@ -1603,7 +1840,17 @@ class Runtime:
                 if rt.node_id == node_id:
                     self._running.pop(tid, None)
                     specs.append(rt.spec)
+        with self._pipeline_lock:
+            self._pipeline_credits.pop(node_id, None)
+            self._pipeline_cooldown.pop(node_id, None)
         for spec in specs:
+            # Pipelined entries must clear BEFORE the resubmit: the retried
+            # task reuses its task_id, and a stale _pipelined entry would
+            # make its eventual TaskDone skip the booked-resource release.
+            if spec.task_id in self._pipelined:
+                self._pipelined.discard(spec.task_id)
+                with self._pipeline_lock:
+                    self._pipelined_node.pop(spec.task_id, None)
             # Creation tasks are re-placed (the actor never came up, so no
             # restart is consumed); retryable tasks resubmit; others fail.
             self._requeue_or_fail(
@@ -1677,7 +1924,7 @@ class Runtime:
             if not is_remote and any(
                     isinstance(st.desc, tuple) and st.desc
                     and st.desc[0] == "at" for st in states
-                    if st.event.is_set()):
+                    if st.ready):
                 # Local reader needs remote objects: the pull blocks, so
                 # run the reply construction on the transfer thread.
                 self._offload(lambda: _build_reply(timed_out))
@@ -1688,7 +1935,7 @@ class Runtime:
             values = []
             pinned_keys = []
             for oid, st in zip(msg.object_ids, states):
-                if not st.event.is_set():
+                if not st.ready:
                     values.append(("err", b""))
                     continue
                 d = st.desc
@@ -2035,6 +2282,11 @@ class Runtime:
     def shutdown(self) -> None:
         self._shutdown = True
         self.scheduler.stop()
+        with self._actors_lock:
+            asts = list(self._actors.values())
+        for ast in asts:
+            if ast.driver_ch is not None:
+                ast.driver_ch.close()
         if self._gc_enabled:
             self._ref_drop_q.put(None)
         if self._xfer_q is not None:
