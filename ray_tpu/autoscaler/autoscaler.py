@@ -89,13 +89,21 @@ class Autoscaler:
     def _launch(self, name: str, ntc: NodeTypeConfig) -> None:
         pid = self.provider.create_node(name, ntc.resources)
         # Join expectation: the worker count this launch should bring the
-        # cluster to.  Base = max(current count, any still-unmet earlier
+        # cluster to.  Base = max(current count, any still-unmet RECENT
         # expectation) so concurrent launches stack (+1 each) and foreign
         # or pre-existing nodes — counted in the base — never satisfy it.
+        # Stale expectations (launch never joined within 120s: spawn
+        # failure) are dropped here, not ratcheted into the base — one
+        # dead launch must not inflate every future expectation.
+        now = time.monotonic()
+        for p in list(self._expected_alive):
+            ts = self._launched.get(p)
+            if ts is None or now - ts[1] > 120.0:
+                self._expected_alive.pop(p, None)
         base = max([self._alive_workers()]
                    + list(self._expected_alive.values()))
         self._expected_alive[pid] = base + 1
-        self._launched[pid] = (name, time.monotonic())
+        self._launched[pid] = (name, now)
 
     def _gang_launches(self, counts: Dict[str, int]) -> Dict[str, int]:
         """Atomic multi-host gangs (pending slice/STRICT_SPREAD placement
@@ -128,7 +136,10 @@ class Autoscaler:
                 # downscales don't inflate future launch baselines.
                 self._expected_alive.pop(pid, None)
             if now - ts > 120.0:
-                continue  # never joined: spawn failure — stop blocking
+                # Never joined: spawn failure — stop blocking AND stop
+                # counting toward future launch baselines.
+                self._expected_alive.pop(pid, None)
+                continue
             os_pid = get_pid(pid) if get_pid else None
             if os_pid is not None:
                 if os_pid not in joined_os_pids:

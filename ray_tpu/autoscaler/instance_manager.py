@@ -1,0 +1,407 @@
+"""Declarative instance manager: desired state in, provider actions out.
+
+Reference: the v2 autoscaler's InstanceManager
+(python/ray/autoscaler/v2/instance_manager/instance_manager.py) and its
+reconciler (v2/instance_manager/reconciler.py) — instances move through
+an explicit lifecycle FSM, every transition is persisted with a version,
+and the reconciler converges ACTUAL (what the cloud + the cluster
+report) toward DESIRED (what the scheduler wants), never trusting its
+own memory of in-flight work.  Launches are idempotent by request id, so
+a crashed-and-restarted reconciler re-issues the same request instead of
+double-buying a TPU slice.
+
+TPU-first sizing: the provider ABC models GKE's QueuedResources flow —
+you *request* a slice (maybe multi-host), the request sits QUEUED until
+the fabric has capacity, then every host of the slice comes up together
+and each host's node server joins the head.  A slice is therefore the
+atomic unit of request/terminate, with per-host bind tracking.
+
+Lifecycle:
+
+    REQUESTED     reconciler asked the provider for the instance
+    PROVISIONING  provider acknowledged; resource not yet running
+    RUNNING       cloud reports the VM/host up; node not yet joined
+    JOINED        a cluster node registered from this instance
+    TERMINATING   surplus/failed: terminate issued
+    TERMINATED    gone (terminal)
+    FAILED        provider reported the request dead (terminal; the
+                  reconciler replaces it with a fresh REQUESTED)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+REQUESTED = "REQUESTED"
+PROVISIONING = "PROVISIONING"
+RUNNING = "RUNNING"
+JOINED = "JOINED"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+FAILED = "FAILED"
+
+_TERMINAL = (TERMINATED, FAILED)
+_ALIVE = (REQUESTED, PROVISIONING, RUNNING, JOINED)
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = REQUESTED
+    # Idempotency key: one request id per launch decision; re-issuing the
+    # same id after a crash must not create a second instance.
+    request_id: str = ""
+    cloud_id: str = ""          # provider's id once acknowledged
+    ray_node_id: str = ""       # head's node id once joined
+    os_pid: int = 0             # join matching (fake/subprocess providers)
+    version: int = 0            # bumps on every persisted transition
+    updated_at: float = field(default_factory=time.time)
+    history: List[Tuple[str, float]] = field(default_factory=list)
+
+
+@dataclass
+class CloudInstance:
+    """Provider-side view of one host."""
+    cloud_id: str
+    request_id: str
+    node_type: str
+    status: str                 # "queued" | "provisioning" | "running" |
+    #                             "failed" | "terminated"
+    os_pid: int = 0
+
+
+class CloudProvider:
+    """Async cloud provider ABC (reference: v2 node_provider.py
+    ICloudInstanceProvider — request/terminate return immediately, state
+    arrives by polling).  Sized for GKE TPU QueuedResources: `request`
+    asks for `count` hosts of `node_type` AS ONE UNIT (a slice); the
+    provider reports each host as a CloudInstance carrying the request
+    id, so the manager can bind hosts back to its instances.
+
+    Idempotency contract: `request` with an already-seen request_id is a
+    no-op.  `terminate` of an unknown/gone id is a no-op.  Both may be
+    retried forever."""
+
+    def request(self, request_id: str, node_type: str,
+                count: int) -> None:
+        raise NotImplementedError
+
+    def describe(self) -> List[CloudInstance]:
+        raise NotImplementedError
+
+    def terminate(self, cloud_ids: List[str]) -> None:
+        raise NotImplementedError
+
+
+class InstanceStore:
+    """Versioned instance table with an append-only JSONL journal
+    (reference: v2 instance_storage.py over the GCS KV).  Every
+    transition lands on disk before the reconciler acts on it, so a
+    restarted manager resumes mid-flight launches instead of repeating
+    them."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._lock = threading.Lock()
+        self._instances: Dict[str, Instance] = {}
+        if path and os.path.exists(path):
+            self._replay(path)
+
+    def _replay(self, path: str) -> None:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail
+                inst = self._instances.get(rec["instance_id"])
+                if inst is None:
+                    inst = Instance(rec["instance_id"], rec["node_type"])
+                    self._instances[inst.instance_id] = inst
+                inst.status = rec["status"]
+                inst.request_id = rec.get("request_id", inst.request_id)
+                inst.cloud_id = rec.get("cloud_id", inst.cloud_id)
+                inst.ray_node_id = rec.get("ray_node_id",
+                                           inst.ray_node_id)
+                inst.os_pid = rec.get("os_pid", inst.os_pid)
+                inst.version = rec.get("version", inst.version)
+
+    def upsert(self, inst: Instance, status: Optional[str] = None) -> None:
+        with self._lock:
+            if status is not None and status != inst.status:
+                inst.history.append((inst.status, time.time()))
+                inst.status = status
+            inst.version += 1
+            inst.updated_at = time.time()
+            self._instances[inst.instance_id] = inst
+            if self._path:
+                rec = {"instance_id": inst.instance_id,
+                       "node_type": inst.node_type,
+                       "status": inst.status,
+                       "request_id": inst.request_id,
+                       "cloud_id": inst.cloud_id,
+                       "ray_node_id": inst.ray_node_id,
+                       "os_pid": inst.os_pid,
+                       "version": inst.version}
+                with open(self._path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+                    f.flush()
+
+    def all(self) -> List[Instance]:
+        with self._lock:
+            return list(self._instances.values())
+
+    def alive(self) -> List[Instance]:
+        return [i for i in self.all() if i.status in _ALIVE]
+
+
+class InstanceManager:
+    """The reconciler: one `reconcile()` pass computes provider actions
+    from (desired counts, provider view, cluster view) and persists every
+    resulting transition.  Deliberately synchronous and idempotent — the
+    caller loops it; crashing between any two statements and re-running
+    converges to the same state (reference: v2 reconciler.py's
+    sync-then-step design)."""
+
+    def __init__(self, provider: CloudProvider,
+                 store: Optional[InstanceStore] = None,
+                 joined_pids: Optional[Callable[[], Dict[int, str]]] = None,
+                 request_timeout_s: float = 300.0):
+        self.provider = provider
+        self.store = store or InstanceStore()
+        # () -> {os_pid: ray_node_id} of nodes registered with the head.
+        self._joined_pids = joined_pids or (lambda: {})
+        self.request_timeout_s = request_timeout_s
+
+    # -- desired state ---------------------------------------------------- #
+
+    def reconcile(self, desired: Dict[str, int]) -> None:
+        """One convergence step: sync provider + cluster state into the
+        table, then launch/terminate toward ``desired`` (node_type ->
+        target instance count)."""
+        self._sync_cloud_state()
+        self._sync_join_state()
+        self._replace_failed()
+        # REQUESTED entries whose provider call was dropped (crash or
+        # API error between persist and acknowledge) re-issue here —
+        # idempotent by request id, so an acknowledged request is a
+        # no-op.  Without this, the count diff below sees have == want
+        # and the cluster under-provisions until request_timeout_s.
+        self.retry_pending_requests()
+        counts: Dict[str, int] = {}
+        for inst in self.store.alive():
+            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+        for ntype, want in desired.items():
+            have = counts.get(ntype, 0)
+            if want > have:
+                self._launch(ntype, want - have)
+            elif want < have:
+                self._terminate_surplus(ntype, have - want)
+        # Types with live instances but no desired entry drain to zero.
+        for ntype, have in counts.items():
+            if ntype not in desired and have > 0:
+                self._terminate_surplus(ntype, have)
+
+    # -- sync ------------------------------------------------------------- #
+
+    def _sync_cloud_state(self) -> None:
+        by_request: Dict[str, List[CloudInstance]] = {}
+        by_cloud_id: Dict[str, CloudInstance] = {}
+        for ci in self.provider.describe():
+            by_request.setdefault(ci.request_id, []).append(ci)
+            by_cloud_id[ci.cloud_id] = ci
+        now = time.time()
+        for inst in self.store.all():
+            if inst.status in _TERMINAL:
+                continue
+            ci = by_cloud_id.get(inst.cloud_id) if inst.cloud_id else None
+            if ci is None and inst.request_id:
+                # Bind one unbound cloud host of our request to this
+                # instance (slice hosts come up together; each binds to
+                # one table entry).
+                bound = {i.cloud_id for i in self.store.all()
+                         if i.cloud_id}
+                for cand in by_request.get(inst.request_id, ()):
+                    if cand.cloud_id not in bound:
+                        ci = cand
+                        inst.cloud_id = ci.cloud_id
+                        inst.os_pid = ci.os_pid
+                        break
+            if ci is None:
+                if inst.status in (RUNNING, JOINED, TERMINATING):
+                    # Cloud lost it (preemption / terminate finished).
+                    self.store.upsert(inst, TERMINATED)
+                elif inst.status in (REQUESTED, PROVISIONING) and \
+                        now - inst.updated_at > self.request_timeout_s:
+                    self.store.upsert(inst, FAILED)
+                continue
+            if ci.os_pid and ci.os_pid != inst.os_pid:
+                # Late pid report (host agent came up after RUNNING).
+                inst.os_pid = ci.os_pid
+            if ci.status == "failed":
+                self.store.upsert(inst, FAILED)
+            elif ci.status == "terminated":
+                self.store.upsert(inst, TERMINATED)
+            elif ci.status == "running":
+                if inst.status in (REQUESTED, PROVISIONING):
+                    inst.os_pid = ci.os_pid or inst.os_pid
+                    self.store.upsert(inst, RUNNING)
+            elif ci.status in ("queued", "provisioning"):
+                if inst.status == REQUESTED:
+                    self.store.upsert(inst, PROVISIONING)
+
+    def _sync_join_state(self) -> None:
+        joined = self._joined_pids()
+        if not joined:
+            return
+        for inst in self.store.all():
+            if inst.status == RUNNING and inst.os_pid in joined:
+                inst.ray_node_id = joined[inst.os_pid]
+                self.store.upsert(inst, JOINED)
+
+    def _replace_failed(self) -> None:
+        """FAILED is terminal for the *instance*; the reconcile loop's
+        count diff buys the replacement.  Make sure failed-but-acked
+        cloud resources are told to die (idempotent)."""
+        dead = [i.cloud_id for i in self.store.all()
+                if i.status == FAILED and i.cloud_id]
+        if dead:
+            try:
+                self.provider.terminate(dead)
+            except Exception:
+                pass  # retried next pass
+
+    # -- actions ----------------------------------------------------------- #
+
+    def _launch(self, node_type: str, count: int) -> None:
+        """One request for the whole shortfall: a multi-host slice is
+        requested as a unit (QueuedResources semantics), with one table
+        entry per expected host, all sharing the request id."""
+        request_id = uuid.uuid4().hex[:12]
+        for _ in range(count):
+            inst = Instance(instance_id=uuid.uuid4().hex[:12],
+                            node_type=node_type, request_id=request_id)
+            self.store.upsert(inst)
+        try:
+            self.provider.request(request_id, node_type, count)
+        except Exception:
+            # Table entries stay REQUESTED; the idempotent request is
+            # re-issued by request_id on the next pass.
+            pass
+
+    def retry_pending_requests(self) -> None:
+        """Re-issue provider requests for REQUESTED instances (e.g. after
+        a manager restart): grouped by request id, idempotent."""
+        groups: Dict[str, List[Instance]] = {}
+        for inst in self.store.all():
+            if inst.status == REQUESTED and inst.request_id:
+                groups.setdefault(inst.request_id, []).append(inst)
+        for rid, insts in groups.items():
+            try:
+                self.provider.request(rid, insts[0].node_type, len(insts))
+            except Exception:
+                pass
+
+    def _terminate_surplus(self, node_type: str, count: int) -> None:
+        # Drain youngest-first, never a JOINED node before an unjoined
+        # one (joined nodes hold work).
+        order = {REQUESTED: 0, PROVISIONING: 1, RUNNING: 2, JOINED: 3}
+        cands = sorted(
+            (i for i in self.store.alive() if i.node_type == node_type),
+            key=lambda i: (order.get(i.status, 9), -i.updated_at))
+        doomed = cands[:count]
+        cloud_ids = [i.cloud_id for i in doomed if i.cloud_id]
+        for inst in doomed:
+            self.store.upsert(
+                inst, TERMINATING if inst.cloud_id else TERMINATED)
+        if cloud_ids:
+            try:
+                self.provider.terminate(cloud_ids)
+            except Exception:
+                pass
+
+
+class FakeCloudProvider(CloudProvider):
+    """In-memory provider for tests (reference:
+    autoscaler/_private/fake_multi_node/node_provider.py:237): instances
+    move queued -> provisioning -> running after configurable delays;
+    failure injection kills a whole request (the QueuedResources
+    all-or-nothing failure mode) or individual hosts."""
+
+    def __init__(self, provision_delay_s: float = 0.0,
+                 run_delay_s: float = 0.0):
+        self._lock = threading.Lock()
+        self._instances: Dict[str, CloudInstance] = {}
+        self._created_at: Dict[str, float] = {}
+        self._seen_requests: set = set()
+        self.provision_delay_s = provision_delay_s
+        self.run_delay_s = run_delay_s
+        self.request_log: List[Tuple[str, str, int]] = []
+
+    def request(self, request_id: str, node_type: str, count: int) -> None:
+        with self._lock:
+            if request_id in self._seen_requests:
+                return  # idempotent
+            self._seen_requests.add(request_id)
+            self.request_log.append((request_id, node_type, count))
+            for i in range(count):
+                cid = f"{request_id}-{i}"
+                self._instances[cid] = CloudInstance(
+                    cid, request_id, node_type, "queued", os_pid=0)
+                self._created_at[cid] = time.time()
+
+    def describe(self) -> List[CloudInstance]:
+        now = time.time()
+        with self._lock:
+            out = []
+            for cid, ci in self._instances.items():
+                age = now - self._created_at[cid]
+                if ci.status in ("failed", "terminated"):
+                    pass
+                elif age >= self.provision_delay_s + self.run_delay_s:
+                    ci.status = "running"
+                elif age >= self.provision_delay_s:
+                    ci.status = "provisioning"
+                out.append(CloudInstance(ci.cloud_id, ci.request_id,
+                                         ci.node_type, ci.status,
+                                         ci.os_pid))
+            return out
+
+    def terminate(self, cloud_ids: List[str]) -> None:
+        with self._lock:
+            for cid in cloud_ids:
+                ci = self._instances.get(cid)
+                if ci is not None:
+                    ci.status = "terminated"
+
+    # -- failure injection -------------------------------------------------- #
+
+    def kill_request(self, request_id: str) -> None:
+        """The whole queued/provisioning slice dies (capacity reclaim)."""
+        with self._lock:
+            for ci in self._instances.values():
+                if ci.request_id == request_id and \
+                        ci.status not in ("terminated",):
+                    ci.status = "failed"
+
+    def kill_instance(self, cloud_id: str) -> None:
+        with self._lock:
+            ci = self._instances.get(cloud_id)
+            if ci is not None:
+                ci.status = "failed"
+
+    def mark_joined_pid(self, cloud_id: str, pid: int) -> None:
+        with self._lock:
+            ci = self._instances.get(cloud_id)
+            if ci is not None:
+                ci.os_pid = pid

