@@ -4,10 +4,12 @@ Reference analog: the vLLM engine the reference wraps (reference:
 python/ray/llm/_internal/serve/engines/vllm/ — PagedAttention block
 manager); here the cache is a functional JAX structure laid out for the
 TPU paged-attention kernel (jax.experimental.pallas.ops.tpu.paged_attention
-expects k_pages [num_kv_heads, total_pages, page_size, head_dim]):
+reads kv_pages [total_pages, page_size, 2 * num_kv_heads, head_dim]):
 
-    k_pages / v_pages : [L, Hkv, NUM_PAGES, PAGE, D]
-    block table       : [max_slots, pages_per_seq] int32 page ids
+    kv_pages    : per-layer tuple of combined [NUM_PAGES, PAGE, 2*Hkv, D]
+                  arrays (K even / V odd combined-head indices — see
+                  _model.decode_step's layout note)
+    block table : [max_slots, pages_per_seq] int32 page ids
 
 Page allocation is host-side (free list in the engine); device arrays are
 donated through the jitted step so decode updates are in-place.

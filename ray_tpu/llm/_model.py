@@ -93,66 +93,84 @@ def prefill(params: Dict[str, Any], tokens: jax.Array, length: jax.Array,
 
 
 
-def decode_step(params: Dict[str, Any], k_pages, v_pages,
+def write_prefill(kv_pages, ks, vs, page_ids, offs):
+    """Scatter a prefilled prompt's K/V into every layer's pages in ONE
+    device program (kv_pages: per-layer tuple of combined
+    [NP, page, 2*Hkv, D] arrays, donated) — per-layer host-dispatched
+    scatters would cost 2*layers dispatches per admission, which over a
+    high-latency host link takes longer than the decode itself.
+
+    ks/vs: [L, S_pad, Hkv, D] from prefill; page_ids/offs: [S_pad]
+    (positions past the real prompt length point at reserved page 0, so
+    the scatter shape is bucket-static)."""
+    from ..ops.paged_attention import combine_kv
+    kv = list(kv_pages)
+    dt = kv[0].dtype
+    for li in range(len(kv)):
+        comb = combine_kv(ks[li], vs[li]).astype(dt)   # [S_pad, 2Hkv, D]
+        kv[li] = kv[li].at[page_ids, offs, :, :].set(comb)
+    return tuple(kv)
+
+
+def decode_step(params: Dict[str, Any], kv_pages,
                 tokens: jax.Array, positions: jax.Array,
                 block_tables: jax.Array, active: jax.Array,
                 cfg: LlamaConfig, page_size: int):
     """One decode step for every slot.
 
     tokens: [B] last sampled token per slot; positions: [B] their position;
-    block_tables: [B, P]; active: [B] bool.
-    Returns (logits [B, vocab], new k_pages, new v_pages) — cache arrays
-    are updated in place via donation.
-    """
+    block_tables: [B, P] page ids; active: [B] bool.
+    Returns (logits [B, vocab], new kv_pages) — cache arrays are updated
+    in place via donation.
+
+    Cache layout: a TUPLE of per-layer COMBINED page arrays
+    ``[num_pages, page_size, 2*Hkv, D]`` (K even / V odd combined-head
+    indices — the ragged-paged-attention kernel's native layout).  Each
+    leaf takes exactly ONE scatter per step whose [2*Hkv, D] window is
+    fully contiguous at a leading (page, offset) index — the layout this
+    replaced (split K/V, heads leading) needed 48 strided scatters per
+    step that cost ~3x the model's matmuls on v5e."""
+    from ..ops.paged_attention import combine_kv
     dt = cfg.dtype
     B = tokens.shape[0]
     x = params["embed"].astype(dt)[tokens][:, None, :]     # [B, 1, E]
     seq_lens = jnp.where(active, positions + 1, 0)
     page_idx = jnp.take_along_axis(
         block_tables, (positions // page_size)[:, None], axis=1)[:, 0]
-    page_off = positions % page_size
+    # Inactive slots park their write on reserved page 0 (never read)
+    # instead of a predicated read-modify-write of live pages.
+    page_idx = jnp.where(active, page_idx, 0)
+    page_off = jnp.where(active, positions % page_size, 0)
 
-    def body(carry, inputs):
-        x = carry
-        layer, kp, vp = inputs
+    n_layers = params["blocks"]["wq"].shape[0]
+    kv_pages = list(kv_pages)
+    for li in range(n_layers):
+        layer = jax.tree.map(lambda a, li=li: a[li], params["blocks"])
+        kv = kv_pages[li]
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q, k, v = _project_qkv(cfg, layer, h, positions[:, None])
-        # Write the new K/V into the cache pages: kp [Hkv, NP, page, D];
-        # the advanced-index target kp[:, page_idx, page_off, :] is
-        # [Hkv, B, D], matching k_new's layout.
-        k_new = k[:, :, 0, :].transpose(1, 0, 2)           # [Hkv, B, D]
-        v_new = v[:, :, 0, :].transpose(1, 0, 2)
-        kp = kp.at[:, page_idx, page_off, :].set(
-            jnp.where(active[None, :, None],
-                      k_new, kp[:, page_idx, page_off, :]))
-        vp = vp.at[:, page_idx, page_off, :].set(
-            jnp.where(active[None, :, None],
-                      v_new, vp[:, page_idx, page_off, :]))
-        attn = paged_decode_attention(q[:, :, 0, :], kp, vp, block_tables,
+        # ONE combined scatter: target kv[page_idx, page_off] is
+        # [B, 2*Hkv, D] with a contiguous window per index.
+        comb = combine_kv(k[:, :, 0, :], v[:, :, 0, :]).astype(kv.dtype)
+        kv = kv.at[page_idx, page_off, :, :].set(comb,
+                                                 unique_indices=False)
+        kv_pages[li] = kv
+        attn = paged_decode_attention(q[:, :, 0, :], kv, block_tables,
                                       seq_lens, page_size)
         attn_out = jnp.einsum("bhd,hde->be", attn, layer["wo"].astype(dt))
         x = x + attn_out[:, None, :]
         h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         x = x + _mlp(cfg, layer, h2)
-        return x, (kp, vp)
-
-    # Manual python loop over layers (cache arrays updated per layer).
-    n_layers = params["blocks"]["wq"].shape[0]
-    new_k, new_v = [], []
-    for li in range(n_layers):
-        layer = jax.tree.map(lambda a, li=li: a[li], params["blocks"])
-        x, (kp, vp) = body(x, (layer, k_pages[li], v_pages[li]))
-        new_k.append(kp)
-        new_v.append(vp)
-    k_pages = jnp.stack(new_k)
-    v_pages = jnp.stack(new_v)
+    kv_pages = tuple(kv_pages)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("be,ev->bv", x[:, 0, :].astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
-    return logits, k_pages, v_pages
+    # bf16 reads with f32 MXU accumulation: casting lm_head to f32 would
+    # materialize a 4-byte copy of the largest matrix every step.
+    logits = jnp.einsum("be,ev->bv", x[:, 0, :], params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits.astype(jnp.float32), kv_pages
 
 
-def decode_chunk(params: Dict[str, Any], k_pages, v_pages,
+def decode_chunk(params: Dict[str, Any], kv_pages,
                  tokens: jax.Array, positions: jax.Array,
                  block_tables: jax.Array, active: jax.Array,
                  rng_key: jax.Array, cfg: LlamaConfig, page_size: int,
@@ -166,7 +184,7 @@ def decode_chunk(params: Dict[str, Any], k_pages, v_pages,
     (reference: vLLM num_scheduler_steps / multi-step decode).
 
     tokens/positions/active: [B] as in decode_step.  Returns
-    (sampled [steps, B], new positions, k_pages, v_pages).  Sampling:
+    (sampled [steps, B], new positions, kv_pages).  Sampling:
     greedy when temperature <= 0 else top-k/categorical, per-step keys
     folded from ``rng_key``.  Stop tokens are enforced by the HOST after
     the chunk (bounded overgeneration by design)."""
@@ -182,17 +200,17 @@ def decode_chunk(params: Dict[str, Any], k_pages, v_pages,
             jnp.int32)
 
     def body(carry, i):
-        toks, pos, kp, vp = carry
-        logits, kp, vp = decode_step(params, kp, vp, toks, pos,
-                                     block_tables, active, cfg, page_size)
+        toks, pos, kv = carry
+        logits, kv = decode_step(params, kv, toks, pos,
+                                 block_tables, active, cfg, page_size)
         nxt = sample(logits, jax.random.fold_in(rng_key, i))
         nxt = jnp.where(active, nxt, toks)
         pos = jnp.where(active, pos + 1, pos)
-        return (nxt, pos, kp, vp), nxt
+        return (nxt, pos, kv), nxt
 
     # lax.scan keeps one copy of the (donated) cache live across steps.
     import jax.lax as lax
-    (_, positions, k_pages, v_pages), out = lax.scan(
-        body, (tokens, positions, k_pages, v_pages),
+    (_, positions, kv_pages), out = lax.scan(
+        body, (tokens, positions, kv_pages),
         jnp.arange(steps, dtype=jnp.int32))
-    return out, positions, k_pages, v_pages
+    return out, positions, kv_pages

@@ -71,10 +71,14 @@ class InferenceEngine:
 
         L = cfg.layers
         Hkv, D = cfg.kv_heads, cfg.head_dim
-        self.k_pages = jnp.zeros((L, Hkv, num_pages, page_size, D),
-                                 cfg.dtype)
-        self.v_pages = jnp.zeros((L, Hkv, num_pages, page_size, D),
-                                 cfg.dtype)
+        self.num_pages = num_pages
+        # One COMBINED page array per layer (tuple pytree): K even / V
+        # odd combined-head indices, pages leading — the ragged kernel's
+        # native layout and the one whose per-token insert is a single
+        # contiguous-window scatter (see _model.decode_step).
+        self.kv_pages = tuple(
+            jnp.zeros((num_pages, page_size, 2 * Hkv, D), cfg.dtype)
+            for _ in range(L))
         # Host-side slot state (mirrored to device each step).
         self.block_tables = np.zeros((max_slots, self.pages_per_seq),
                                      np.int32)
@@ -96,7 +100,7 @@ class InferenceEngine:
 
         self._decode = jax.jit(
             partial(_model.decode_step, cfg=cfg, page_size=page_size),
-            donate_argnums=(1, 2))
+            donate_argnums=(1,))
         self._decode_chunk = None
         # (steps, temp, top_k) -> jit fn.  LRU-bounded: varied sampling
         # params across serving traffic must not grow the compiled-program
@@ -113,6 +117,8 @@ class InferenceEngine:
             b: jax.jit(partial(_model.prefill, cfg=cfg),
                        static_argnums=())
             for b in self.prefill_buckets}
+        self._write_prefill = jax.jit(_model.write_prefill,
+                                      donate_argnums=(0,))
 
     # -- request intake -----------------------------------------------------
 
@@ -189,18 +195,19 @@ class InferenceEngine:
             toks[0, :n] = req.prompt_tokens
             logits, ks, vs = self._prefills[bucket](
                 self.params, jnp.asarray(toks), jnp.asarray(n))
-            # Scatter prompt K/V into this request's pages (device-side
-            # vectorized scatter; cache never round-trips to host).
-            page_ids = jnp.asarray(
-                [pages[t // self.page_size] for t in range(n)], jnp.int32)
-            offs = jnp.arange(n, dtype=jnp.int32) % self.page_size
-            # ks: [L, S_pad, Hkv, D] -> value [L, Hkv, n, D]
-            kv_val = ks[:, :n].transpose(0, 2, 1, 3)
-            vv_val = vs[:, :n].transpose(0, 2, 1, 3)
-            self.k_pages = self.k_pages.at[:, :, page_ids, offs, :].set(
-                kv_val.astype(self.k_pages.dtype))
-            self.v_pages = self.v_pages.at[:, :, page_ids, offs, :].set(
-                vv_val.astype(self.v_pages.dtype))
+            # Scatter prompt K/V into this request's pages: ONE jitted
+            # device program for all layers (bucket-static shape; padding
+            # positions land in reserved page 0, which no block table
+            # references).  Per-layer host-side scatters would cost
+            # 2*layers dispatches per admission — slower than the decode
+            # itself over a high-latency host link.
+            page_ids_np = np.zeros((bucket,), np.int32)
+            for t in range(n):
+                page_ids_np[t] = pages[t // self.page_size]
+            offs_np = np.arange(bucket, dtype=np.int32) % self.page_size
+            self.kv_pages = self._write_prefill(
+                self.kv_pages, ks, vs,
+                jnp.asarray(page_ids_np), jnp.asarray(offs_np))
 
             # Mark the slot taken now; the first token lands after the
             # batched sync below.
@@ -290,8 +297,8 @@ class InferenceEngine:
             if not any(self.slot_active):
                 return finished
             self._dev_state = None  # per-token path mutates host mirrors
-            logits, self.k_pages, self.v_pages = self._decode(
-                self.params, self.k_pages, self.v_pages,
+            logits, self.kv_pages = self._decode(
+                self.params, self.kv_pages,
                 jnp.asarray(self.slot_tokens), jnp.asarray(self.slot_pos),
                 jnp.asarray(self.block_tables),
                 jnp.asarray(self.slot_active))
@@ -355,7 +362,7 @@ class InferenceEngine:
                     partial(_model.decode_chunk, cfg=self.cfg,
                             page_size=self.page_size, steps=steps,
                             temperature=sp0.temperature, top_k=sp0.top_k),
-                    donate_argnums=(1, 2))
+                    donate_argnums=(1,))
                 self._chunk_cache[shape_key] = fn
                 while len(self._chunk_cache) > self._chunk_cache_cap:
                     self._chunk_cache.popitem(last=False)
@@ -368,8 +375,8 @@ class InferenceEngine:
             else:
                 toks_dev = jnp.asarray(self.slot_tokens)
                 pos_dev = jnp.asarray(self.slot_pos)
-            out, new_pos, self.k_pages, self.v_pages = self._decode_chunk(
-                self.params, self.k_pages, self.v_pages,
+            out, new_pos, self.kv_pages = self._decode_chunk(
+                self.params, self.kv_pages,
                 toks_dev, pos_dev, jnp.asarray(self.block_tables),
                 jnp.asarray(self.slot_active), key)
             # Next chunk can resume from device state (last sampled token
