@@ -126,21 +126,50 @@ def main() -> None:
     def big_puts():
         put_refs.clear()
         put_refs.extend(ray_tpu.put(big) for _ in range(n_big))
-    timeit("single_client_put_gigabytes", big_puts, multiplier=gib)
 
-    # Context for the line above: a put is ONE memcpy into the arena, so
-    # the machine's single-thread copy bandwidth is the physical ceiling.
-    # Print it so vs_baseline (measured on different hardware) can be
-    # read honestly.
-    dst = np.empty_like(big)
-    np.copyto(dst, big)
-    t0 = time.perf_counter()
-    for _ in range(4):
-        np.copyto(dst, big)
-    ceiling = 4 * big.nbytes / (1 << 30) / (time.perf_counter() - t0)
+    # A put is ONE memcpy into the arena (serialize_payload is
+    # out-of-band: ~0.05ms), so the machine's copy bandwidth INTO shared
+    # memory is the physical ceiling.  Mirror the put's memory pattern —
+    # n_big distinct shm destinations, not one warm private buffer — and
+    # interleave the two measurements best-of, so CPU-steal on a shared
+    # box hits both equally and the ratio reads honestly.
+    from multiprocessing import shared_memory
+    seg = shared_memory.SharedMemory(create=True,
+                                     size=n_big * big.nbytes)
+    views = [np.frombuffer(seg.buf, np.float64, big.size,
+                           offset=i * big.nbytes) for i in range(n_big)]
+    best_put, best_ceiling = 0.0, 0.0
+    try:
+        big_puts()  # warm pool/arena
+        for _ in range(4):
+            t0 = time.perf_counter()
+            big_puts()
+            best_put = max(best_put, gib / (time.perf_counter() - t0))
+            t0 = time.perf_counter()
+            for v in views:
+                np.copyto(v, big)
+            best_ceiling = max(best_ceiling,
+                               gib / (time.perf_counter() - t0))
+        del v
+    finally:
+        del views
+        seg.close()
+        seg.unlink()
     print(json.dumps({
-        "benchmark": "hw_memcpy_ceiling", "value": round(ceiling, 2),
+        "benchmark": "single_client_put_gigabytes",
+        "value": round(best_put, 2), "unit": "GiB/s",
+        "baseline": BASELINES["single_client_put_gigabytes"],
+        "vs_baseline": round(
+            best_put / BASELINES["single_client_put_gigabytes"], 3),
+    }), flush=True)
+    print(json.dumps({
+        "benchmark": "hw_memcpy_ceiling", "value": round(best_ceiling, 2),
         "unit": "GiB/s", "baseline": None, "vs_baseline": None,
+    }), flush=True)
+    print(json.dumps({
+        "benchmark": "put_vs_hw_ceiling",
+        "value": round(best_put / best_ceiling, 3), "unit": "ratio",
+        "baseline": None, "vs_baseline": None,
     }), flush=True)
 
     @ray_tpu.remote

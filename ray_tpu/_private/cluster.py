@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from .config import Config
 from .controller import NodeInfo
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from .protocol import ContainedRefs as _ContainedRefs
 from .protocol import (ActorStateMsg, BorrowRetained, GetReply, GetRequest,
                        PutFromWorker, RpcCall, RpcReply, TaskDone, TaskSpec,
                        WaitRequest)
@@ -97,6 +98,11 @@ class RegisterAck:
     # Highest up-message sequence the head processed from this node (the
     # node resends everything after it on re-attach).
     last_up_seq: int = 0
+    # True when a WAL-restarted head accepted this re-attach: the head
+    # lost all in-memory state, so the node must reset its down-seq
+    # tracking and kill actor workers the new head knows nothing about
+    # (revived actors re-create elsewhere).
+    wal_resumed: bool = False
 
 
 @dataclass
@@ -698,6 +704,13 @@ class HeadServer:
         with self._lock:
             self.proxies[node_id] = proxy
         rt.controller.register_node(info)
+        # Identity persists so a WAL-restarted head accepts this node's
+        # same-identity re-attach (reference: gcs node table in
+        # gcs_init_data.h).
+        rt.controller.note_revivable(
+            node_id.binary(),
+            (msg.hostname, dict(msg.resources),
+             int(msg.num_tpu_chips or 0)))
         rt.nodes[node_id] = proxy
         # Raw handshake reply (the seq framing starts after registration).
         try:
@@ -726,18 +739,75 @@ class HeadServer:
         with self._lock:
             proxy = self.proxies.get(nid)
             if proxy is None or not proxy.alive:
-                return False  # grace expired (death fan-out already ran)
-            if proxy._ring_overflow:
-                # The redelivery ring evicted unacked frames: a silent
-                # gap is worse than a loud fresh join.
-                return False
-            # Swap under the head lock: the grace timer's death check
-            # reads proxy.conn under the same lock, so a re-attach and a
-            # death declaration can never interleave (no task runs twice).
-            proxy.reattach(conn, msg.last_down_seq, RegisterAck(
+                # Unknown to THIS head process — but a WAL-restarted head
+                # accepts re-attaches from nodes whose identity the dead
+                # head persisted: their local planes (workers, running
+                # tasks) survive the head crash (reference:
+                # gcs_init_data.h node table + raylet re-registration).
+                wal_revive = proxy is None and \
+                    rt.controller.get_revivable(nid.binary()) is not None
+            else:
+                wal_revive = False
+                if proxy._ring_overflow:
+                    # The redelivery ring evicted unacked frames: a
+                    # silent gap is worse than a loud fresh join.
+                    return False
+                # Swap under the head lock: the grace timer's death check
+                # reads proxy.conn under the same lock, so a re-attach
+                # and a death declaration can never interleave (no task
+                # runs twice).
+                proxy.reattach(conn, msg.last_down_seq, RegisterAck(
+                    nid.binary(), rt.job_id.binary(), Config.blob(),
+                    rt.data_server.address, rt.node_id.binary(),
+                    last_up_seq=proxy.last_up_seq))
+        if wal_revive:
+            # Blocking work (controller/scheduler registration + the
+            # handshake send) runs OUTSIDE the head lock — one sick
+            # rejoining peer must not freeze the control plane.
+            return self._reattach_from_wal(msg, conn, nid)
+        if proxy is None or not proxy.alive:
+            return False  # grace expired / truly unknown
+        threading.Thread(target=self._reader_loop, args=(proxy,),
+                         name=f"head-node-{nid.hex()[:8]}",
+                         daemon=True).start()
+        return True
+
+    def _reattach_from_wal(self, msg: RegisterNode, conn,
+                           nid: NodeID) -> bool:
+        """Accept a same-identity re-attach at a WAL-restarted head.
+        The node keeps its worker pool and running plain tasks; their
+        TaskDones ride the node's unacked up-ring and replay against the
+        fresh tables.  The ack's ``wal_resumed`` flag tells the node to
+        reset its down-seq tracking (this head's sequence space starts
+        at zero) and to kill actor workers this head doesn't know
+        (revived actors re-create through the normal revival path)."""
+        rt = self.runtime
+        info = NodeInfo(nid, msg.hostname, ResourceSet(msg.resources),
+                        labels={"os_pid": str(msg.os_pid)}, is_head=False)
+        proxy = RemoteNodeProxy(self, conn, info, msg.data_address)
+        with self._lock:
+            if nid in self.proxies:
+                return False  # a concurrent re-attach of the same node won
+            self.proxies[nid] = proxy
+        rt.controller.register_node(info)
+        rt.nodes[nid] = proxy
+        try:
+            conn.send(RegisterAck(
                 nid.binary(), rt.job_id.binary(), Config.blob(),
                 rt.data_server.address, rt.node_id.binary(),
-                last_up_seq=proxy.last_up_seq))
+                last_up_seq=0, wal_resumed=True))
+        except (BrokenPipeError, OSError):
+            # Undo fully: a half-registered proxy would make the node's
+            # RETRY take the normal re-attach path (no wal_resumed), and
+            # its stale down-seq tracking would drop every frame from
+            # this head forever.
+            with self._lock:
+                if self.proxies.get(nid) is proxy:
+                    self.proxies.pop(nid, None)
+            rt.nodes.pop(nid, None)
+            rt.controller.mark_node_dead(nid, "wal re-attach ack failed")
+            return False
+        rt.scheduler.add_node(info)
         threading.Thread(target=self._reader_loop, args=(proxy,),
                          name=f"head-node-{nid.hex()[:8]}",
                          daemon=True).start()
@@ -912,6 +982,8 @@ class HeadServer:
         elif isinstance(msg, BorrowRetained):
             for oid in msg.object_ids:
                 rt.mark_escaped(oid)
+        elif isinstance(msg, _ContainedRefs):
+            rt.note_contained(msg.outer, msg.inner)
         elif isinstance(msg, UpDispatchFailed):
             rt.on_dispatch_failed(msg.spec, msg.reason,
                                   lost_object_bytes=msg.lost_object_bytes)
@@ -1063,6 +1135,12 @@ class _NodeServerRuntime:
         # Borrow escalation from a worker on this node: the owner (head)
         # must pin the object.
         self._server.send_up(BorrowRetained([oid]))
+
+    def note_contained(self, outer, inner) -> None:
+        # Containment from a worker on this node: the owner (head)
+        # retains the inner refs for the outer object's lifetime.
+        from .protocol import ContainedRefs
+        self._server.send_up(ContainedRefs(outer, list(inner)))
 
 
 class NodeServer:
@@ -1245,19 +1323,39 @@ class NodeServer:
                 continue
             if not isinstance(ack, RegisterAck) or \
                     ack.node_id_bytes != self.node_id.binary():
-                # Head forgot us (grace expired or restart): a fresh
-                # identity means a fresh local plane — reject here.
+                # Head forgot us (grace expired or restart with no WAL):
+                # a fresh identity means a fresh local plane — reject.
                 try:
                     conn.close()
                 except Exception:
                     pass
                 return False
+            wal_resumed = getattr(ack, "wal_resumed", False)
+            if wal_resumed:
+                # A WAL-restarted head accepted us: its down-seq space
+                # restarts at zero (stale _last_down would drop every
+                # frame as a duplicate), and actor workers here are
+                # unknown to it — revived instances spawn through the
+                # normal revival path, so kill the stale ones to prevent
+                # two live copies of one actor.
+                self._last_down = 0
+                self.node.kill_all_actor_workers(
+                    reason="head restarted; actor revived elsewhere")
             with self._send_lock:
                 self.conn = conn
                 # Drop what the head already processed; resend the tail.
                 while self._up_ring and \
                         self._up_ring[0][1] <= ack.last_up_seq:
                     self._up_ring.popleft()
+                if wal_resumed:
+                    # Ring frames bake in ack-of-down values from the
+                    # DEAD head's sequence space; replaying them would
+                    # make the new head prune its fresh down ring as
+                    # "acked".  Rewrite the tail with ack 0.
+                    rebuilt = _deque(
+                        (("useq", f[1], 0, f[3]) for f in self._up_ring),
+                        maxlen=self._up_ring.maxlen)
+                    self._up_ring = rebuilt
                 for frame in list(self._up_ring):
                     try:
                         conn.send(frame)

@@ -104,6 +104,11 @@ class Controller:
         self._pubsub_rings: Dict[str, List] = {}
         self._pubsub_seq = 0
         self._pubsub_ring_cap = 1000
+        # Persisted node identities a restarted head will accept
+        # same-identity re-attaches from (node_id bytes -> (hostname,
+        # resources dict, num_tpus)); reference: gcs_init_data.h node
+        # table driving raylet re-registration after GCS failover.
+        self.revivable_nodes: Dict[bytes, tuple] = {}
 
     # -- nodes --------------------------------------------------------------
 
@@ -148,6 +153,10 @@ class Controller:
                     self._kv.setdefault(r[1], {})[r[2]] = r[3]
                 elif kind == "kv_del":
                     self._kv.get(r[1], {}).pop(r[2], None)
+                elif kind == "node_identity":
+                    self.revivable_nodes[r[1]] = r[2]
+                elif kind == "node_gone":
+                    self.revivable_nodes.pop(r[1], None)
 
     def snapshot_records(self) -> List[tuple]:
         """Full table state as a compact record stream (for WAL
@@ -163,6 +172,8 @@ class Controller:
             for ns, kv in self._kv.items():
                 for k, v in kv.items():
                     out.append(("kv_put", ns, k, v))
+            for nid, ident in self.revivable_nodes.items():
+                out.append(("node_identity", nid, ident))
             return out
 
     def _export(self, source_type: str, event: Dict[str, Any]) -> None:
@@ -172,6 +183,22 @@ class Controller:
                 sink(source_type, event)
             except Exception:  # noqa: BLE001 — observability must not break
                 pass
+
+    def note_revivable(self, node_id_bytes: bytes, ident: tuple) -> None:
+        """Persist a node identity for post-restart re-attach (all
+        mutations locked: snapshot_records iterates this table)."""
+        with self._lock:
+            self.revivable_nodes[node_id_bytes] = ident
+        self._p(("node_identity", node_id_bytes, ident))
+
+    def drop_revivable(self, node_id_bytes: bytes) -> None:
+        with self._lock:
+            self.revivable_nodes.pop(node_id_bytes, None)
+        self._p(("node_gone", node_id_bytes))
+
+    def get_revivable(self, node_id_bytes: bytes):
+        with self._lock:
+            return self.revivable_nodes.get(node_id_bytes)
 
     def register_node(self, info: NodeInfo) -> None:
         with self._lock:

@@ -37,10 +37,10 @@ from .controller import NodeInfo
 from .ids import ActorID, NodeID, TaskID, WorkerID
 from .object_store import NativeArenaStore, create_store
 from .protocol import (ActorStateMsg, AllocReply, AllocRequest,
-                       BorrowRetained, GetRequest, KillWorker, PutFromWorker,
-                       ReadDone, RpcCall, RunTask, SealObject,
-                       SubmitFromWorker, TaskDone, TaskSpec, WaitRequest,
-                       WorkerReady)
+                       BorrowRetained, ContainedRefs, GetRequest,
+                       KillWorker, PutFromWorker, ReadDone, RpcCall,
+                       RunTask, SealObject, SubmitFromWorker, TaskDone,
+                       TaskSpec, WaitRequest, WorkerReady)
 from .resources import ResourceSet, TPU
 
 IDLE = "idle"
@@ -109,9 +109,9 @@ class NodeManager:
         self._lock = threading.RLock()
         self._chip_pool: List[int] = list(range(num_tpu_chips))
         self._closed = False
-        # exists (not isdir): zip/egg/pyz entries are importable too.
-        self._sys_path_blob = os.pathsep.join(
-            p for p in sys.path if p and os.path.exists(p))
+        # (sys.path ships per SPAWN, not frozen here: a driver that
+        # appends an import dir after init — compiled protos, generated
+        # code — must still resolve in later workers.)
         # Workers are spawned as fresh interpreters that dial back in
         # (reference: worker_pool.h StartWorkerProcess + raylet socket
         # registration) — no fork, no __main__ re-import, no jax inheritance.
@@ -430,7 +430,10 @@ class NodeManager:
             # Driver sys.path travels to workers so functions pickled
             # by reference (importable modules, incl. test files) resolve
             # (reference: runtime-env working_dir/py_modules propagation).
-            "RAY_TPU_SYS_PATH": self._sys_path_blob,
+            # Computed per spawn — exists (not isdir): zip/egg/pyz
+            # entries are importable too.
+            "RAY_TPU_SYS_PATH": os.pathsep.join(
+                p for p in sys.path if p and os.path.exists(p)),
             # Arena segment name: workers write large results straight into
             # the node's C++ store (empty = fall back to per-object segments).
             "RAY_TPU_ARENA_SEG":
@@ -999,6 +1002,8 @@ class NodeManager:
         elif isinstance(msg, BorrowRetained):
             for oid in msg.object_ids:
                 rt.mark_escaped(oid)
+        elif isinstance(msg, ContainedRefs):
+            rt.note_contained(msg.outer, msg.inner)
         elif isinstance(msg, RpcCall):
             rt.on_rpc_call(self, msg)
 
@@ -1095,6 +1100,16 @@ class NodeManager:
             self._kill_and_reap(handle)
         else:
             self._send(handle, KillWorker("actor killed"))
+
+    def kill_all_actor_workers(self, reason: str = "") -> None:
+        """Hard-kill every bound actor worker (head restarted from its
+        WAL: these actors are being revived elsewhere; a surviving stale
+        worker would be a second live instance)."""
+        with self._lock:
+            doomed = [h.worker_id for h in self._workers.values()
+                      if h.actor_id is not None]
+        for wid in doomed:
+            self.kill_actor_worker(wid, force=True)
 
     def num_workers(self) -> int:
         with self._lock:

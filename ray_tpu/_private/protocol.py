@@ -205,6 +205,18 @@ class BorrowRetained:
 
 
 @dataclass
+class ContainedRefs:
+    """worker -> node: ``inner`` ObjectRefs were serialized INSIDE the
+    value of ``outer`` (a task result / stream item / worker put).  The
+    owner retains the inner objects for exactly as long as the outer
+    object lives — freeing the outer releases them — instead of pinning
+    them forever (reference: reference_counter.h:44 nested-ref
+    containment via serializer hooks)."""
+    outer: ObjectID
+    inner: List[ObjectID]
+
+
+@dataclass
 class ReadDone:
     """worker -> node: descriptors from a GetReply are no longer referenced.
     retain=True (actor context) transfers the pins to the worker's lifetime

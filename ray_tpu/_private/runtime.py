@@ -307,6 +307,11 @@ class Runtime:
         self._dropped: set = set()
         self._dep_counts: Dict[ObjectID, int] = {}
         self._deps_retained: Dict[TaskID, List[ObjectID]] = {}
+        # outer object -> ObjectIDs serialized inside its value: the
+        # inner objects are retained (via _dep_counts) for exactly the
+        # outer's lifetime (reference: reference_counter.h:44 nested-ref
+        # containment).
+        self._contained: Dict[ObjectID, List[ObjectID]] = {}
 
         # -- lineage + reconstruction (reference: task_manager.h:248
         # ResubmitTask, object_recovery_manager.h:41) ---------------------- #
@@ -544,7 +549,17 @@ class Runtime:
             self._put_index += 1
             idx = (1 << 20) + self._put_index
         object_id = ObjectID.of(self.driver_task_id, idx)
-        meta, buffers = serialization.serialize_payload(value)
+        # Refs inside the value become containment-retained (released
+        # when this object frees), not escaped-forever pins.
+        from .api import _nested_collector
+        inner: list = []
+        token = _nested_collector.set(inner)
+        try:
+            meta, buffers = serialization.serialize_payload(value)
+        finally:
+            _nested_collector.reset(token)
+        if inner:
+            self.note_contained(object_id, inner)
         nbytes = serialization.payload_nbytes(meta, buffers)
         if nbytes <= Config.get("max_inline_object_size"):
             buf = bytearray(nbytes)
@@ -702,11 +717,15 @@ class Runtime:
                     deferred.append(oid)
         if deferred:
             object_ids = [o for o in object_ids if o not in set(deferred)]
+        contained_freed: List[ObjectID] = []
         for oid in object_ids:
             with self._ref_lock:
                 self._local_refs.pop(oid, None)
                 self._escaped.discard(oid)
                 self._dropped.discard(oid)
+            # Refs serialized inside this object's value lose their
+            # container: release the retention (frees cascade below).
+            contained_freed.extend(self._release_contained(oid))
             with self._dir_lock:
                 st = self.directory.pop(oid, None)
             if st is not None and st.desc and st.desc[0] == "at":
@@ -746,6 +765,8 @@ class Runtime:
                         seg.unlink()
                     except FileNotFoundError:
                         pass
+        if contained_freed:
+            self.free(contained_freed)
 
     # ------------------------------------------------------------------ #
     # ownership GC (reference: reference_counter.h local refs + borrows)
@@ -847,6 +868,45 @@ class Runtime:
         if self._gc_enabled:
             with self._ref_lock:
                 self._escaped.add(oid)
+
+    def note_contained(self, outer: ObjectID,
+                       inner: List[ObjectID]) -> None:
+        """``inner`` refs were serialized inside ``outer``'s value: retain
+        them for the outer object's lifetime (released by free(outer)),
+        NOT forever (reference: reference_counter.h:44 containment)."""
+        if not self._gc_enabled or not inner:
+            return
+        with self._ref_lock:
+            self._contained.setdefault(outer, []).extend(inner)
+            for oid in inner:
+                self._dep_counts[oid] = self._dep_counts.get(oid, 0) + 1
+
+    def _release_contained(self, outer: ObjectID) -> List[ObjectID]:
+        """Drop the outer->inner retention; returns inner objects that
+        became collectable (caller frees them outside the lock).  A
+        still-pending inner (producer in flight) defers to the _dropped
+        set like _apply_ref_drops does — freeing now would let the late
+        mark_ready resurrect a zero-reference directory entry and pin
+        its payload forever."""
+        to_free: List[ObjectID] = []
+        with self._ref_lock:
+            inner = self._contained.pop(outer, None)
+            for oid in inner or ():
+                n = self._dep_counts.get(oid, 0) - 1
+                if n > 0:
+                    self._dep_counts[oid] = n
+                    continue
+                self._dep_counts.pop(oid, None)
+                if not self._collectable_locked(oid):
+                    continue
+                with self._dir_lock:
+                    st = self.directory.get(oid)
+                if st is not None and not st.ready:
+                    self._dropped.add(oid)
+                else:
+                    self._dropped.discard(oid)
+                    to_free.append(oid)
+        return to_free
 
     def _collectable_locked(self, oid: ObjectID) -> bool:
         return (oid not in self._escaped
@@ -1832,6 +1892,9 @@ class Runtime:
         with self._node_views_lock:
             self._node_views.pop(node_id, None)
         self.controller.mark_node_dead(node_id, "connection lost")
+        # Death fan-out reruns/fails its work: a later same-identity
+        # re-attach (even across a head restart) must be refused.
+        self.controller.drop_revivable(node_id.binary())
         self.scheduler.remove_node(node_id)
 
         specs: List[TaskSpec] = []

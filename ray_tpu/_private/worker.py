@@ -529,7 +529,18 @@ class WorkerRuntime:
             self._obj_index += 1
             idx = self._obj_index
         object_id = ObjectID.of(task_id, idx)
-        desc = _serialize_result(self, object_id, value)
+        # Refs inside the value: containment-retained by the owner for
+        # this object's lifetime (see _run_task's result handling).
+        from .api import _nested_collector
+        inner: list = []
+        token = _nested_collector.set(inner)
+        try:
+            desc = _serialize_result(self, object_id, value)
+        finally:
+            _nested_collector.reset(token)
+        if inner:
+            from .protocol import ContainedRefs
+            self.send(ContainedRefs(object_id, list(inner)))
         self.send(PutFromWorker(object_id, desc))
         return object_id
 
@@ -736,19 +747,23 @@ class WorkerLoop:
             else:
                 fn = self._load_fn(spec)
                 value_list = self._split_returns(fn(*args, **kwargs), spec)
-            # A borrowed ref serialized into the RESULTS outlives the
-            # task at its consumer: escalate it like a retained borrow.
+            # A ref serialized into a RESULT outlives the task at its
+            # consumer: the owner retains it for the result object's
+            # lifetime (containment, reference: reference_counter.h:44)
+            # — ContainedRefs must hit the wire BEFORE TaskDone (FIFO
+            # outbox) so the retention exists before the consumer reads.
             from .api import _nested_collector
-            in_results: list = []
-            token = _nested_collector.set(in_results)
-            try:
-                for i, oid in enumerate(spec.return_ids):
-                    results.append(
-                        (oid, _serialize_result(rt, oid, value_list[i])))
-            finally:
-                _nested_collector.reset(token)
-            if in_results:
-                rt.send(BorrowRetained(list(in_results)))
+            from .protocol import ContainedRefs
+            for i, oid in enumerate(spec.return_ids):
+                in_result: list = []
+                token = _nested_collector.set(in_result)
+                try:
+                    desc = _serialize_result(rt, oid, value_list[i])
+                finally:
+                    _nested_collector.reset(token)
+                results.append((oid, desc))
+                if in_result:
+                    rt.send(ContainedRefs(oid, list(in_result)))
             # Release the arg/result locals so the borrow survivor check
             # in the finally sees only refs the USER kept (actor state,
             # globals) — not this frame's own temporaries.
@@ -799,12 +814,21 @@ class WorkerLoop:
         as ObjectID.of(task_id, i); the final ("end",) marker closes the
         stream, and a mid-stream exception lands as an err descriptor at
         the failing index so the consumer raises at the right position."""
+        from .api import _nested_collector
+        from .protocol import ContainedRefs
         count = 0
         try:
             for item in produce():
                 oid = ObjectID.of(spec.task_id, count)
-                rt.send(PutFromWorker(
-                    oid, _serialize_result(rt, oid, item)))
+                inner: list = []
+                token = _nested_collector.set(inner)
+                try:
+                    desc = _serialize_result(rt, oid, item)
+                finally:
+                    _nested_collector.reset(token)
+                if inner:
+                    rt.send(ContainedRefs(oid, list(inner)))
+                rt.send(PutFromWorker(oid, desc))
                 count += 1
         except BaseException as exc:  # noqa: BLE001
             stream_err = TaskError(exc, spec.name, traceback.format_exc())

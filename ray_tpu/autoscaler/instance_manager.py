@@ -182,6 +182,10 @@ class InstanceManager:
         # () -> {os_pid: ray_node_id} of nodes registered with the head.
         self._joined_pids = joined_pids or (lambda: {})
         self.request_timeout_s = request_timeout_s
+        # cloud_ids whose terminate call succeeded at least once — FAILED
+        # entries are terminal and never pruned, so without this every
+        # pass would re-send the full history of dead ids.
+        self._terminate_issued: set = set()
 
     # -- desired state ---------------------------------------------------- #
 
@@ -189,9 +193,9 @@ class InstanceManager:
         """One convergence step: sync provider + cluster state into the
         table, then launch/terminate toward ``desired`` (node_type ->
         target instance count)."""
-        self._sync_cloud_state()
+        live_ids = self._sync_cloud_state()
         self._sync_join_state()
-        self._replace_failed()
+        self._replace_failed(live_ids)
         # REQUESTED entries whose provider call was dropped (crash or
         # API error between persist and acknowledge) re-issue here —
         # idempotent by request id, so an acknowledged request is a
@@ -214,12 +218,17 @@ class InstanceManager:
 
     # -- sync ------------------------------------------------------------- #
 
-    def _sync_cloud_state(self) -> None:
+    def _sync_cloud_state(self) -> set:
+        """Sync table statuses from one provider.describe() snapshot;
+        returns the live cloud ids so _replace_failed reuses the same
+        snapshot (cloud list calls are rate-limited/billed)."""
         by_request: Dict[str, List[CloudInstance]] = {}
         by_cloud_id: Dict[str, CloudInstance] = {}
         for ci in self.provider.describe():
             by_request.setdefault(ci.request_id, []).append(ci)
             by_cloud_id[ci.cloud_id] = ci
+        live_ids = {cid for cid, ci in by_cloud_id.items()
+                    if ci.status not in ("terminated", "failed")}
         now = time.time()
         for inst in self.store.all():
             if inst.status in _TERMINAL:
@@ -238,12 +247,18 @@ class InstanceManager:
                         inst.os_pid = ci.os_pid
                         break
             if ci is None:
-                if inst.status in (RUNNING, JOINED, TERMINATING):
+                if inst.status in (RUNNING, JOINED) or (
+                        inst.status == TERMINATING and inst.cloud_id):
                     # Cloud lost it (preemption / terminate finished).
                     self.store.upsert(inst, TERMINATED)
                 elif inst.status in (REQUESTED, PROVISIONING) and \
                         now - inst.updated_at > self.request_timeout_s:
                     self.store.upsert(inst, FAILED)
+                elif inst.status == TERMINATING and \
+                        now - inst.updated_at > self.request_timeout_s:
+                    # Drained before its queued host ever appeared, and
+                    # none materialized within the window: close it out.
+                    self.store.upsert(inst, TERMINATED)
                 continue
             if ci.os_pid and ci.os_pid != inst.os_pid:
                 # Late pid report (host agent came up after RUNNING).
@@ -259,6 +274,7 @@ class InstanceManager:
             elif ci.status in ("queued", "provisioning"):
                 if inst.status == REQUESTED:
                     self.store.upsert(inst, PROVISIONING)
+        return live_ids
 
     def _sync_join_state(self) -> None:
         joined = self._joined_pids()
@@ -269,15 +285,28 @@ class InstanceManager:
                 inst.ray_node_id = joined[inst.os_pid]
                 self.store.upsert(inst, JOINED)
 
-    def _replace_failed(self) -> None:
+    def _replace_failed(self, live: set) -> None:
         """FAILED is terminal for the *instance*; the reconcile loop's
-        count diff buys the replacement.  Make sure failed-but-acked
-        cloud resources are told to die (idempotent)."""
-        dead = [i.cloud_id for i in self.store.all()
-                if i.status == FAILED and i.cloud_id]
+        count diff buys the replacement.  Failed-but-acked cloud
+        resources are told to die once (idempotent; re-issued only until
+        the call succeeds — not re-sent forever for every historical
+        failure).  TERMINATING instances whose hosts the cloud still
+        reports (``live``: this pass's describe snapshot) re-issue
+        terminate too: a swallowed API error must not leave surplus
+        hosts running indefinitely."""
+        dead = []
+        for i in self.store.all():
+            if not i.cloud_id:
+                continue
+            if i.status == FAILED and i.cloud_id not in \
+                    self._terminate_issued:
+                dead.append(i.cloud_id)
+            elif i.status == TERMINATING and i.cloud_id in live:
+                dead.append(i.cloud_id)
         if dead:
             try:
                 self.provider.terminate(dead)
+                self._terminate_issued.update(dead)
             except Exception:
                 pass  # retried next pass
 
@@ -322,11 +351,16 @@ class InstanceManager:
         doomed = cands[:count]
         cloud_ids = [i.cloud_id for i in doomed if i.cloud_id]
         for inst in doomed:
-            self.store.upsert(
-                inst, TERMINATING if inst.cloud_id else TERMINATED)
+            # Even without a cloud_id the instance stays TERMINATING, not
+            # TERMINATED: its slice request may still be live and its
+            # host can materialize later — _sync_cloud_state then binds
+            # it here and _replace_failed terminates it, instead of the
+            # host orphaning against a terminal table entry.
+            self.store.upsert(inst, TERMINATING)
         if cloud_ids:
             try:
                 self.provider.terminate(cloud_ids)
+                self._terminate_issued.update(cloud_ids)
             except Exception:
                 pass
 

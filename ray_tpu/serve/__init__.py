@@ -16,6 +16,8 @@ from .api import (Application, Deployment, DeploymentHandle, deployment,
                   get_deployment_handle, run, shutdown, status)
 from .batching import batch
 from .controller import AutoscalingConfig
+from .grpc_ingress import (GrpcMethod, add_grpc_service,
+                           remove_grpc_service)
 from .long_poll import LongPollBroker
 from .multiplex import get_multiplexed_model_id, multiplexed
 
@@ -24,4 +26,5 @@ __all__ = [
     "DeploymentHandle", "get_deployment_handle", "batch",
     "AutoscalingConfig", "LongPollBroker",
     "multiplexed", "get_multiplexed_model_id",
+    "GrpcMethod", "add_grpc_service", "remove_grpc_service",
 ]

@@ -1,0 +1,184 @@
+"""Typed (proto-driven) gRPC ingress for Serve.
+
+The reference's gRPCProxy serves user-defined proto services: the user
+compiles a .proto, deploys servicer functions, and typed stubs call
+straight into deployments (reference:
+python/ray/serve/_private/proxy.py:601 gRPCProxy + the
+grpc_servicer_functions deployment option).  Here the same capability is
+registry-driven: ``add_grpc_service`` binds each proto service method to
+a deployment, naming the generated request/response message classes by
+import path.  Every per-node ProxyActor (proxy.py) resolves the registry
+from the cluster KV and installs REAL typed handlers — requests are
+parsed with ``RequestCls.FromString`` and replies serialized with
+``SerializeToString``, so any standard gRPC client with the same proto
+talks to the cluster natively.  The proto-free JSON generic service
+stays as the no-proto fallback.
+
+The generated ``*_pb2.py`` module must be importable on every node
+(driver sys.path ships to workers, so a module next to the driver
+script works; cluster deployments use runtime_env py_modules).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Optional
+
+GRPC_KV_KEY = "serve:grpc_services"
+
+
+@dataclass
+class GrpcMethod:
+    """One service method -> deployment binding.
+
+    request_type / response_type: "module:ClassName" import paths of the
+    protoc-generated message classes.  The deployment receives the
+    PARSED request message and must return a response message instance
+    (or a dict of response fields, which is coerced).
+    """
+    deployment: str
+    request_type: str
+    response_type: str
+    streaming: bool = False
+    # Optional attribute on the deployment to call instead of __call__.
+    handler_method: Optional[str] = None
+
+
+@dataclass
+class GrpcService:
+    name: str                                   # e.g. "rtdemo.EchoService"
+    methods: Dict[str, GrpcMethod] = field(default_factory=dict)
+
+
+def _type_path(cls_or_path) -> str:
+    if isinstance(cls_or_path, str):
+        return cls_or_path
+    return f"{cls_or_path.__module__}:{cls_or_path.__qualname__}"
+
+
+def resolve_type(path: str):
+    """'module:Class' -> class (imported on the consuming proxy)."""
+    mod_name, _, qual = path.partition(":")
+    import importlib
+    mod = importlib.import_module(mod_name)
+    obj = mod
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def add_grpc_service(service: str,
+                     methods: Dict[str, GrpcMethod]) -> None:
+    """Register (or replace) a typed gRPC service cluster-wide.  Method
+    classes may be given as classes or 'module:Class' strings."""
+    from .._private.api import _control
+    norm = {}
+    for mname, m in methods.items():
+        norm[mname] = GrpcMethod(
+            deployment=m.deployment,
+            request_type=_type_path(m.request_type),
+            response_type=_type_path(m.response_type),
+            streaming=m.streaming,
+            handler_method=m.handler_method)
+    registry = _load_registry()
+    registry[service] = {k: asdict(v) for k, v in norm.items()}
+    _control("kv_put", GRPC_KV_KEY, json.dumps(registry).encode())
+    _handler_cache.clear()   # local; proxies converge within the TTL
+
+
+def remove_grpc_service(service: str) -> None:
+    from .._private.api import _control
+    registry = _load_registry()
+    if registry.pop(service, None) is not None:
+        _control("kv_put", GRPC_KV_KEY, json.dumps(registry).encode())
+    _handler_cache.clear()
+
+
+def _load_registry() -> Dict[str, Dict[str, dict]]:
+    from .._private.api import _control
+    blob = _control("kv_get", GRPC_KV_KEY)
+    if not blob:
+        return {}
+    try:
+        return json.loads(blob)
+    except ValueError:
+        return {}
+
+
+def lookup_method(service: str, method: str) -> Optional[GrpcMethod]:
+    """Proxy-side resolution of one /service/method call."""
+    entry = _load_registry().get(service, {}).get(method)
+    if entry is None:
+        return None
+    return GrpcMethod(**entry)
+
+
+# (service, method) -> (resolved handler tuple | None, expiry): the
+# proxy hot path must not pay a cluster KV round-trip + import per RPC;
+# registrations are rare, so a short TTL bounds staleness.
+_handler_cache: Dict[tuple, tuple] = {}
+_HANDLER_TTL_S = 5.0
+
+
+def make_typed_handlers(service: str, method: str):
+    """Build (handler, request_deserializer, response_serializer,
+    streaming) for a registered typed method, or None when unregistered.
+    Used by the per-node proxy's generic handler — typed end-to-end
+    without grpcio-tools-generated servicer classes.  Resolutions
+    (including negative ones) are cached for a few seconds."""
+    import time as _time
+    key = (service, method)
+    hit = _handler_cache.get(key)
+    now = _time.monotonic()
+    if hit is not None and hit[1] > now:
+        return hit[0]
+    out = _make_typed_handlers_uncached(service, method)
+    if len(_handler_cache) > 512:
+        _handler_cache.clear()
+    _handler_cache[key] = (out, now + _HANDLER_TTL_S)
+    return out
+
+
+def _make_typed_handlers_uncached(service: str, method: str):
+    spec = lookup_method(service, method)
+    if spec is None:
+        return None
+    import ray_tpu
+
+    from . import api as serve_api
+
+    req_cls = resolve_type(spec.request_type)
+    resp_cls = resolve_type(spec.response_type)
+
+    def coerce(result):
+        if isinstance(result, resp_cls):
+            return result
+        if isinstance(result, dict):
+            return resp_cls(**result)
+        raise TypeError(
+            f"deployment {spec.deployment!r} returned "
+            f"{type(result).__name__}; expected {resp_cls.__name__} or "
+            "a field dict")
+
+    def call_handle(message):
+        h = serve_api.get_deployment_handle(spec.deployment)
+        if spec.handler_method:
+            h = getattr(h, spec.handler_method)
+        return h.remote(message)
+
+    if spec.streaming:
+        def stream_handler(message, ctx):
+            h = serve_api.get_deployment_handle(
+                spec.deployment).options(stream=True)
+            if spec.handler_method:
+                h = getattr(h, spec.handler_method)
+            for item_ref in h.remote(message):
+                yield coerce(ray_tpu.get(item_ref, timeout=300))
+        handler = stream_handler
+    else:
+        def unary_handler(message, ctx):
+            return coerce(ray_tpu.get(call_handle(message), timeout=300))
+        handler = unary_handler
+    return handler, req_cls.FromString, \
+        lambda m: m.SerializeToString(), spec.streaming

@@ -54,12 +54,48 @@ class _ProxyImpl:
 
         class GenericIngress(grpc.GenericRpcHandler):
             def service(self, call_details):
-                method = call_details.method  # /ray_tpu.serve/<name>
+                method = call_details.method  # /<service>/<method>
                 parts = method.strip("/").split("/", 1)
-                if len(parts) != 2 or not parts[0].startswith(
-                        "ray_tpu.serve"):
+                if len(parts) != 2:
                     return None
                 service_name, deployment = parts
+                if not service_name.startswith("ray_tpu.serve"):
+                    # Typed proto service registered via
+                    # serve.add_grpc_service (grpc_ingress.py): real
+                    # FromString/SerializeToString handlers — any stock
+                    # gRPC client with the same proto works.
+                    from .grpc_ingress import make_typed_handlers
+                    try:
+                        typed = make_typed_handlers(service_name,
+                                                    deployment)
+                    except Exception:  # registry/import error -> 404
+                        typed = None
+                    if typed is None:
+                        return None
+                    handler, req_des, resp_ser, t_stream = typed
+
+                    def typed_unary(request, ctx, _h=handler):
+                        try:
+                            return _h(request, ctx)
+                        except Exception as e:  # noqa: BLE001
+                            ctx.set_code(grpc.StatusCode.INTERNAL)
+                            ctx.set_details(repr(e))
+                            return None
+
+                    def typed_stream(request, ctx, _h=handler):
+                        try:
+                            yield from _h(request, ctx)
+                        except Exception as e:  # noqa: BLE001
+                            ctx.set_code(grpc.StatusCode.INTERNAL)
+                            ctx.set_details(repr(e))
+
+                    if t_stream:
+                        return grpc.unary_stream_rpc_method_handler(
+                            typed_stream, request_deserializer=req_des,
+                            response_serializer=resp_ser)
+                    return grpc.unary_unary_rpc_method_handler(
+                        typed_unary, request_deserializer=req_des,
+                        response_serializer=resp_ser)
                 streaming = service_name.endswith(".stream")
 
                 def unary(request: bytes, ctx):

@@ -139,6 +139,111 @@ class TestHeadFaultTolerance:
         assert pg.id.hex() in pgs
         assert pgs[pg.id.hex()]["state"] == "CREATED"
 
+    def test_head_restart_nodes_reattach_tasks_survive(self, tmp_path):
+        """Kill -9 the head with tasks RUNNING on two worker nodes,
+        restart it on the same port from its WAL, and the nodes
+        re-attach under their persisted identities — the in-flight tasks
+        complete on their original workers without resubmission
+        (reference: gcs_init_data.h failover + raylet re-registration)."""
+        import socket as _socket
+
+        import ray_tpu
+        from ray_tpu._private.api import ObjectRef
+
+        # Fixed join port so rejoining nodes can redial the new head.
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        node_port = s.getsockname()[1]
+        s.close()
+        state_dir = str(tmp_path / "state")
+        addr_file = os.path.join(str(tmp_path), "head_address")
+        token = "a" * 32
+        env = dict(os.environ)
+        env.pop("RAY_TPU_CONFIG_BLOB", None)
+        env["RAY_TPU_NODE_RECONNECT_GRACE_S"] = "60"
+
+        def start_head():
+            try:
+                os.unlink(addr_file)
+            except FileNotFoundError:
+                pass
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.scripts.head",
+                 "--port", "0", "--node-port", str(node_port),
+                 "--token", token, "--address-file", addr_file,
+                 "--dashboard-port", "-1", "--state-dir", state_dir,
+                 "--num-cpus", "0", "--num-tpus", "0"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT)
+            deadline = time.monotonic() + HEAD_BOOT_TIMEOUT
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(f"head died rc={proc.returncode}")
+                try:
+                    with open(addr_file) as f:
+                        return proc, json.load(f)
+                except (FileNotFoundError, json.JSONDecodeError):
+                    time.sleep(0.2)
+            raise RuntimeError("head did not boot")
+
+        def start_node():
+            return subprocess.Popen(
+                [sys.executable, "-m",
+                 "ray_tpu._private.node_server_main",
+                 "--address", f"127.0.0.1:{node_port}",
+                 "--token", token, "--num-cpus", "2", "--num-tpus", "0"],
+                env=dict(env, RAY_TPU_TPU_CHIPS_PER_HOST_OVERRIDE="0"),
+                start_new_session=True)
+        nodes = []
+        head = None
+        try:
+            head, info = start_head()
+            nodes = [start_node(), start_node()]
+            rt = ray_tpu.init(address=info["node_address"],
+                              cluster_token=token.encode())
+            deadline = time.monotonic() + 30
+            while len(ray_tpu.nodes()) < 3:
+                assert time.monotonic() < deadline, "nodes did not join"
+                time.sleep(0.2)
+
+            @ray_tpu.remote(num_cpus=1)
+            def slow(i):
+                import os as _os
+                import time as _time
+                start = _time.time()
+                _time.sleep(6.0)
+                return (i * 10, _os.getpid(), start)
+
+            refs = [slow.remote(i) for i in range(4)]  # fills both nodes
+            ids = [r.id() for r in refs]
+            time.sleep(2.0)  # all four dispatched and running
+            kill_time = time.time()
+            head.send_signal(signal.SIGKILL)
+            head.wait(timeout=15)
+            ray_tpu.shutdown()
+            del refs, rt
+
+            head, info2 = start_head()
+            rt2 = ray_tpu.init(address=info2["node_address"],
+                               cluster_token=token.encode())
+            vals = ray_tpu.get([ObjectRef(oid) for oid in ids],
+                               timeout=90)
+            assert [v[0] for v in vals] == [0, 10, 20, 30]
+            # Started BEFORE the head died on the surviving workers: the
+            # tasks were not re-executed after the restart.
+            for _val, _pid, start in vals:
+                assert start < kill_time, \
+                    "task re-executed after head restart"
+            # Both nodes re-attached (3 alive incl. the new head node).
+            assert len(ray_tpu.nodes()) == 3
+            ray_tpu.shutdown()
+        finally:
+            for p in nodes:
+                if p.poll() is None:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            if head is not None and head.poll() is None:
+                head.kill()
+
     def test_wal_snapshot_roundtrip(self, tmp_path):
         from ray_tpu._private.persist import StateStore
 

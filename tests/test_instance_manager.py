@@ -122,6 +122,42 @@ class TestFailureConvergence:
         c = counts(mgr)
         assert c.get(RUNNING) == 2 and c.get(TERMINATED) == 1
 
+    def test_scale_down_before_hosts_appear_no_orphans(self):
+        """Desired drops while the slice request is still queued: the
+        drained entries stay TERMINATING, bind the late-materializing
+        hosts, and terminate them — no orphaned cloud instances."""
+        prov = FakeCloudProvider(provision_delay_s=0.15)  # hosts lag
+        mgr = InstanceManager(prov)
+        mgr.reconcile({"worker": 3})
+        mgr.reconcile({"worker": 1})  # scale down pre-materialization
+        import time as _t
+        _t.sleep(0.2)
+        for _ in range(4):
+            mgr.reconcile({"worker": 1})
+        cloud = {c.cloud_id: c.status for c in prov.describe()}
+        assert sum(1 for s in cloud.values() if s == "running") == 1, cloud
+        assert sum(1 for s in cloud.values() if s == "terminated") == 2
+
+    def test_terminate_failure_retried(self):
+        class FlakyTerm(FakeCloudProvider):
+            fails = 1
+
+            def terminate(self, cloud_ids):
+                if FlakyTerm.fails:
+                    FlakyTerm.fails = 0
+                    raise ConnectionError("api down")
+                super().terminate(cloud_ids)
+
+        prov = FlakyTerm()
+        mgr = InstanceManager(prov)
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 0})   # terminate raises, swallowed
+        for _ in range(3):
+            mgr.reconcile({"worker": 0})
+        cloud = {c.cloud_id: c.status for c in prov.describe()}
+        assert all(s == "terminated" for s in cloud.values()), cloud
+
     def test_provider_request_exception_retried(self):
         class Flaky(FakeCloudProvider):
             def __init__(self):

@@ -64,15 +64,86 @@ class TestOwnershipGC:
         assert ray_tpu.get(out_ref, timeout=30) == 200_000.0
 
     def test_escaped_ref_not_collected(self, rt):
+        import pickle
         inner = ray_tpu.put(np.arange(50_000))
-        holder = ray_tpu.put([inner])  # pickles the ref -> escaped
+        # Pickling OUTSIDE any runtime serialization context (user dumps
+        # to disk/network): copies can live anywhere — escaped forever.
+        blob = pickle.dumps([inner])
+        inner_id = inner.id()
+        del inner
+        gc.collect()
+        time.sleep(0.2)
+        got = pickle.loads(blob)
+        assert ray_tpu.get(got[0])[-1] == 49_999
+        assert inner_id in rt._escaped
+
+    def test_put_containment_holds_then_releases(self, rt):
+        """A ref inside a put() value is retained by the OUTER object —
+        not pinned forever: dropping the inner handle keeps it alive
+        while the holder lives; freeing the holder frees it (reference:
+        reference_counter.h:44 nested-ref containment)."""
+        inner = ray_tpu.put(np.arange(50_000))
+        holder = ray_tpu.put([inner])
         inner_id = inner.id()
         del inner
         gc.collect()
         time.sleep(0.2)
         got = ray_tpu.get(holder)
         assert ray_tpu.get(got[0])[-1] == 49_999
-        assert inner_id in rt._escaped
+        assert inner_id not in rt._escaped
+        del got
+        del holder
+        gc.collect()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with rt._dir_lock:
+                gone = inner_id not in rt.directory
+            if gone:
+                break
+            time.sleep(0.05)
+        assert gone, "contained object not freed with its holder"
+        assert not rt._contained
+
+    def test_nested_ref_through_two_actors_releases_slot(self, rt):
+        """The round-5 target scenario: a ref buried in a dataclass
+        passes through TWO actors (arg containment in, RESULT containment
+        out at each hop); when every handle drops, the arena slot is
+        reclaimed (reference: reference_counter.h:44)."""
+        from dataclasses import dataclass
+
+        @dataclass
+        class Box:
+            ref: object
+            tag: str = ""
+
+        @ray_tpu.remote
+        class Courier:
+            def forward(self, box):
+                # Returns a NEW dataclass still containing the ref: the
+                # result object becomes the container.
+                return Box(box.ref, box.tag + "x")
+
+        a, b = Courier.remote(), Courier.remote()
+        payload = ray_tpu.put(np.ones(300_000))   # arena-resident
+        oid = payload.id()
+        stats_before = rt.node.store.stats()["num_objects"]
+        box1 = ray_tpu.get(a.forward.remote(Box(payload)), timeout=60)
+        box2 = ray_tpu.get(b.forward.remote(box1), timeout=60)
+        assert box2.tag == "xx"
+        assert float(ray_tpu.get(box2.ref).sum()) == 300_000.0
+        assert oid not in rt._escaped
+        del payload, box1, box2
+        gc.collect()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with rt._dir_lock:
+                gone = oid not in rt.directory
+            if gone:
+                break
+            time.sleep(0.05)
+        assert gone, "nested ref still pinned after all handles dropped"
+        # Arena slot actually reclaimed.
+        assert rt.node.store.stats()["num_objects"] <= stats_before
 
     def test_nested_ref_borrow_released_after_two_hops(self, rt):
         """A ref pickled INSIDE task args is a tracked borrow, not an
