@@ -124,11 +124,16 @@ def shape_verify_7b() -> None:
 
 def bench_decode(params, cfg, *, max_slots: int, prompt_len: int,
                  gen_tokens: int, num_pages: int,
-                 chunk: int = 32) -> float:
+                 chunk: int = 64) -> dict:
     """Steady-state decode throughput through the serving engine's
-    device-resident chunked decode (paged KV + pallas paged-attention +
-    lax.scan multi-token steps with on-device sampling — one host sync
-    per ``chunk`` tokens).  Returns tokens/s across all active slots."""
+    device-resident chunked decode (paged KV + the pallas
+    ragged-paged-attention kernel + lax.scan multi-token steps with
+    on-device sampling) with DOUBLE-BUFFERED chunks: the host applies
+    chunk k while the device runs k+1, hiding the host-link readback
+    latency.  Returns {"tps", "p50_ms", "p99_ms"} — per-token latency
+    percentiles come from a separate per-chunk-timed (non-pipelined)
+    pass: a token's latency is its chunk's wall time over the chunk's
+    steps."""
     import numpy as np
 
     from ray_tpu.llm import InferenceEngine, SamplingParams
@@ -141,24 +146,38 @@ def bench_decode(params, cfg, *, max_slots: int, prompt_len: int,
     # whole number of chunks (one compiled chunk shape).
     sp = SamplingParams(max_tokens=gen_tokens + 1, temperature=0.0)
 
-    def run_batch():
+    def add_all():
         for _ in range(max_slots):
             eng.add_request(rng.integers(
                 1, cfg.vocab_size, prompt_len).tolist(), sp)
-        n = 0
-        while eng.has_work():
-            eng.step_chunk(chunk)
-            n += 1
-            if n > 10 * gen_tokens:
-                raise RuntimeError("decode bench did not drain")
 
-    run_batch()  # compiles prefill + chunk
+    add_all()                      # compiles prefill + chunk programs
+    eng.run_pipelined(chunk, max_chunks=20 * gen_tokens)
+    add_all()
     t0 = time.perf_counter()
-    run_batch()
+    eng.run_pipelined(chunk, max_chunks=20 * gen_tokens)
     dt = time.perf_counter() - t0
+
+    # Latency pass: per-chunk timing through the non-pipelined path.
+    add_all()
+    per_token_ms = []
+    n = 0
+    while eng.has_work():
+        t1 = time.perf_counter()
+        eng.step_chunk(chunk)
+        cdt = time.perf_counter() - t1
+        per_token_ms.extend([cdt * 1000.0 / chunk] * chunk)
+        n += 1
+        if n > 20 * gen_tokens:
+            raise RuntimeError("decode bench did not drain")
+    # Drop the whole first chunk's entries: its wall time includes the
+    # admission prefills.
+    lat = np.asarray(per_token_ms[chunk:] or [0.0])
     # Prefill cost is inside dt; report decoded tokens over the window —
     # the steady-state serving mix a continuous-batching engine sees.
-    return max_slots * gen_tokens / dt
+    return {"tps": max_slots * gen_tokens / dt,
+            "p50_ms": float(np.percentile(lat, 50)),
+            "p99_ms": float(np.percentile(lat, 99))}
 
 
 def main() -> None:
@@ -244,16 +263,16 @@ def main() -> None:
     # throughput (paged KV + pallas paged-attention on TPU) on the same
     # weights.
     del opt, batch, step_fn
-    decode_tps = None
+    decode = None
     try:
         if on_tpu:
-            decode_tps = bench_decode(params, cfg, max_slots=16,
-                                      prompt_len=256, gen_tokens=256,
-                                      num_pages=1024, chunk=32)
+            decode = bench_decode(params, cfg, max_slots=64,
+                                  prompt_len=256, gen_tokens=256,
+                                  num_pages=2200, chunk=64)
         else:
-            decode_tps = bench_decode(params, cfg, max_slots=2,
-                                      prompt_len=64, gen_tokens=8,
-                                      num_pages=64, chunk=4)
+            decode = bench_decode(params, cfg, max_slots=2,
+                                  prompt_len=64, gen_tokens=8,
+                                  num_pages=64, chunk=4)
     except Exception as e:  # decode bench must never sink the headline
         print(f"# decode bench failed: {e!r}", file=sys.stderr)
 
@@ -263,8 +282,10 @@ def main() -> None:
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs_baseline, 3),
     }
-    if decode_tps is not None:
-        line["decode_tokens_per_sec"] = round(decode_tps, 1)
+    if decode is not None:
+        line["decode_tokens_per_sec"] = round(decode["tps"], 1)
+        line["decode_p50_ms_per_token"] = round(decode["p50_ms"], 2)
+        line["decode_p99_ms_per_token"] = round(decode["p99_ms"], 2)
     print(json.dumps(line))
     print(f"# loss={float(metrics['loss']):.4f} mfu={mfu:.3f} "
           f"params={p/1e6:.0f}M devices={n_dev} step_ms={dt/iters*1e3:.1f}",

@@ -43,7 +43,8 @@ def timeit(name, fn, multiplier=1, *, repeat=3, warmup=1):
     base = BASELINES.get(name)
     print(json.dumps({
         "benchmark": name, "value": round(best, 2),
-        "unit": "GiB/s" if "gigabytes" in name else "ops/s",
+        "unit": "GiB/s" if ("gigabytes" in name or "pipeline" in name)
+                else "ops/s",
         "baseline": base,
         "vs_baseline": round(best / base, 3) if base else None,
     }), flush=True)
@@ -191,6 +192,37 @@ def main() -> None:
             pg.ready(timeout=10)
             ray_tpu.remove_placement_group(pg)
     timeit("placement_group_create_removal", pg_cycle, multiplier=n_pg)
+
+    # -- Data: parquet -> batches pipeline, numpy blocks vs Arrow blocks
+    # (zero-copy scan; numpy only at the consumer boundary).
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu import data as rd
+    from ray_tpu.data.context import DataContext
+
+    with tempfile.TemporaryDirectory() as td:
+        rows = int(2_000_000 * scale)
+        t = pa.table({"x": np.arange(rows, dtype=np.int64),
+                      "y": np.arange(rows, dtype=np.float64)})
+        for i in range(4):
+            pq.write_table(t.slice(i * rows // 4, rows // 4),
+                           f"{td}/part{i}.parquet")
+        gib_data = 2 * rows * 8 / (1 << 30)
+        for fmt in ("numpy", "arrow"):
+            DataContext.get().block_format = fmt
+
+            def pipeline():
+                ds = rd.read_parquet(f"{td}/part*.parquet")
+                n = 0
+                for b in ds.iter_batches(batch_size=65536):
+                    n += len(b["x"])
+                assert n == rows
+            timeit(f"data_parquet_pipeline_{fmt}", pipeline,
+                   multiplier=gib_data)
+        DataContext.get().block_format = "numpy"
 
     ray_tpu.shutdown()
 

@@ -30,6 +30,12 @@ class DataContext:
         # task never head-of-line-blocks the consumer.  take()/execute()
         # always preserve order regardless.
         self.preserve_order: bool = False
+        # Physical block layout: "numpy" (dict of ndarrays — the
+        # device-feed default) or "arrow" (pyarrow Tables: parquet scans
+        # and slice/take/concat stay zero-copy; numpy materializes only
+        # at the consumer boundary).  Reference:
+        # _internal/arrow_block.py Arrow-native blocks.
+        self.block_format: str = "numpy"
 
     @classmethod
     def get(cls) -> "DataContext":

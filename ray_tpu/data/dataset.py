@@ -94,9 +94,12 @@ class Dataset:
         if isinstance(paths, str):
             paths = sorted(g.glob(paths)) or [paths]
 
+        from .context import DataContext
+        fmt = DataContext.get().block_format
+
         def load(path):
             import pyarrow.parquet as pq
-            return BlockAccessor.from_arrow(pq.read_table(path))
+            return BlockAccessor.from_arrow(pq.read_table(path), fmt)
         return _read_files(paths, load, parallelism)
 
     @staticmethod
@@ -147,9 +150,12 @@ class Dataset:
         if isinstance(paths, str):
             paths = sorted(g.glob(paths)) or [paths]
 
+        from .context import DataContext
+        fmt = DataContext.get().block_format
+
         def load(path):
             import pyarrow.csv as pc
-            return BlockAccessor.from_arrow(pc.read_csv(path))
+            return BlockAccessor.from_arrow(pc.read_csv(path), fmt)
         return _read_files(paths, load, parallelism)
 
     @staticmethod
@@ -159,9 +165,12 @@ class Dataset:
         if isinstance(paths, str):
             paths = sorted(g.glob(paths)) or [paths]
 
+        from .context import DataContext
+        fmt = DataContext.get().block_format
+
         def load(path):
             import pyarrow.json as pj
-            return BlockAccessor.from_arrow(pj.read_json(path))
+            return BlockAccessor.from_arrow(pj.read_json(path), fmt)
         return _read_files(paths, load, parallelism)
 
     # ------------------------------------------------------------------ #
@@ -179,8 +188,19 @@ class Dataset:
         return self._with_stage(Stage(f"map({fn.__name__})", apply))
 
     def map_batches(self, fn: Callable[[Block], Block],
-                    **_compat) -> "Dataset":
-        return self._with_stage(Stage(f"map_batches({fn.__name__})", fn))
+                    batch_format: str = "numpy", **_compat) -> "Dataset":
+        """``batch_format`` controls what the UDF sees ("numpy" dict by
+        default, "pyarrow" for Table-native UDFs on Arrow pipelines);
+        the returned value becomes the output block as-is."""
+        def apply(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            if batch_format == "numpy":
+                return fn(acc.to_numpy())
+            if batch_format == "pyarrow":
+                return fn(acc.to_arrow())
+            return fn(block)
+        return self._with_stage(Stage(f"map_batches({fn.__name__})",
+                                      apply))
 
     def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
         def apply(block: Block) -> Block:
@@ -199,8 +219,8 @@ class Dataset:
 
     def add_column(self, name: str, fn: Callable[[Block], np.ndarray]) -> "Dataset":
         def apply(block: Block) -> Block:
-            out = dict(block)
-            out[name] = np.asarray(fn(block))
+            out = dict(BlockAccessor(block).to_numpy())
+            out[name] = np.asarray(fn(out))
             return out
         return self._with_stage(Stage(f"add_column({name})", apply))
 
@@ -209,10 +229,11 @@ class Dataset:
         cols = list(cols)
 
         def apply(block: Block) -> Block:
-            missing = [c for c in cols if c not in block]
-            if block and missing:
+            b = BlockAccessor(block).to_numpy()
+            missing = [c for c in cols if c not in b]
+            if b and missing:
                 raise KeyError(f"select_columns: missing {missing}")
-            return {c: block[c] for c in cols if c in block}
+            return {c: b[c] for c in cols if c in b}
         return self._with_stage(Stage(f"select_columns({cols})", apply))
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
@@ -220,7 +241,8 @@ class Dataset:
         drop = set(cols)
 
         def apply(block: Block) -> Block:
-            return {k: v for k, v in block.items() if k not in drop}
+            b = BlockAccessor(block).to_numpy()
+            return {k: v for k, v in b.items() if k not in drop}
         return self._with_stage(Stage(f"drop_columns({cols})", apply))
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
@@ -229,12 +251,13 @@ class Dataset:
         frozen = dict(mapping)
 
         def apply(block: Block) -> Block:
-            names = [frozen.get(k, k) for k in block]
+            b = BlockAccessor(block).to_numpy()
+            names = [frozen.get(k, k) for k in b]
             if len(set(names)) != len(names):
                 dup = {n for n in names if names.count(n) > 1}
                 raise ValueError(
                     f"rename_columns: duplicate target columns {sorted(dup)}")
-            return {frozen.get(k, k): v for k, v in block.items()}
+            return {frozen.get(k, k): v for k, v in b.items()}
         return self._with_stage(Stage("rename_columns", apply))
 
     def unique(self, column: str) -> List[Any]:
@@ -408,7 +431,9 @@ class Dataset:
         it = iter_batches(self, batch_size=batch_size,
                           drop_last=drop_last, shuffle_seed=shuffle_seed)
         if batch_format == "numpy":
-            return it
+            # Arrow pipelines materialize numpy HERE — the consumer
+            # boundary — and nowhere earlier.
+            return (BlockAccessor(b).to_numpy() for b in it)
         if batch_format == "pyarrow":
             return (BlockAccessor(b).to_arrow() for b in it)
         if batch_format == "pandas":
@@ -500,8 +525,9 @@ _AGG_OPS = ("count", "sum", "mean", "min", "max", "std")
 def _agg_block(key: str, aggs: Dict[str, tuple], block: Block) -> Block:
     """Per-reduce-block aggregation: after the hash exchange every key
     lives wholly in one block, so local aggregates are global."""
-    if not block or BlockAccessor(block).num_rows() == 0:
+    if block is None or BlockAccessor(block).num_rows() == 0:
         return {}
+    block = BlockAccessor(block).to_numpy()
     uniq, inv = np.unique(block[key], return_inverse=True)
     out: Block = {key: uniq}
     for name, (col, op) in aggs.items():
@@ -535,8 +561,9 @@ def _agg_block(key: str, aggs: Dict[str, tuple], block: Block) -> Block:
 
 def _map_groups_block(key: str, fn: Callable[[Block], Block],
                       block: Block) -> Block:
-    if not block or BlockAccessor(block).num_rows() == 0:
+    if block is None or BlockAccessor(block).num_rows() == 0:
         return {}
+    block = BlockAccessor(block).to_numpy()
     uniq, inv = np.unique(block[key], return_inverse=True)
     acc = BlockAccessor(block)
     pieces = []

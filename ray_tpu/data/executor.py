@@ -298,7 +298,7 @@ def _key_split(key: str, boundaries, n_out: int, fns, block_or_read):
     if acc.num_rows() == 0:
         parts = [block] * n_out
     else:
-        keys = block[key]
+        keys = acc.to_numpy()[key]
         if boundaries is None:
             assignment = _stable_hash_mod(keys, n_out)
         else:

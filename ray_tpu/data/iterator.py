@@ -74,6 +74,7 @@ def device_put_iterator(batches: Iterator[Block], sharding=None,
     import jax
 
     def put(b):
+        b = BlockAccessor(b).to_numpy()   # Arrow -> numpy at the device
         return {k: (jax.device_put(v, sharding) if sharding is not None
                     else jax.device_put(v)) for k, v in b.items()}
 

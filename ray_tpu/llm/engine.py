@@ -328,66 +328,96 @@ class InferenceEngine:
         otherwise.  Stop tokens/budgets are enforced host-side after the
         chunk — the bounded overgeneration is the price of the batching.
         """
-        jnp = self._jnp
-        from . import _model
-
         with self._lock:
             self._admit()
             finished = list(self._admission_finished)
             self._admission_finished.clear()
-            active_reqs = [self.slot_req[s] for s in range(self.max_slots)
-                           if self.slot_active[s]]
-            if not active_reqs:
-                return finished
-            sp0 = active_reqs[0].params
-            if any(r.params.temperature != sp0.temperature
-                   or r.params.top_k != sp0.top_k for r in active_reqs):
-                return finished + self.step()
-            # Cap the chunk so no request overruns its token budget or
-            # page allocation, then round DOWN to a power of two: the
-            # compiled-program set stays tiny (log2(max_steps) shapes,
-            # dict-cached) instead of recompiling the scanned model for
-            # every distinct remaining-budget value.
-            steps = min([max_steps] + [
-                r.params.max_tokens - len(r.output_tokens)
-                for r in active_reqs])
-            if steps <= 0:
-                return finished + self.step()
-            steps = 1 << (steps.bit_length() - 1)
-            shape_key = (steps, sp0.temperature, sp0.top_k)
-            fn = self._chunk_cache.get(shape_key)
-            if fn is None:
-                from functools import partial
-                fn = self._jax.jit(
-                    partial(_model.decode_chunk, cfg=self.cfg,
-                            page_size=self.page_size, steps=steps,
-                            temperature=sp0.temperature, top_k=sp0.top_k),
-                    donate_argnums=(1,))
-                self._chunk_cache[shape_key] = fn
-                while len(self._chunk_cache) > self._chunk_cache_cap:
-                    self._chunk_cache.popitem(last=False)
-            else:
-                self._chunk_cache.move_to_end(shape_key)
-            self._decode_chunk = fn
-            self._chunk_key, key = self._jax.random.split(self._chunk_key)
-            if self._dev_state is not None:
-                toks_dev, pos_dev = self._dev_state
-            else:
-                toks_dev = jnp.asarray(self.slot_tokens)
-                pos_dev = jnp.asarray(self.slot_pos)
-            out, new_pos, self.kv_pages = self._decode_chunk(
-                self.params, self.kv_pages,
-                toks_dev, pos_dev, jnp.asarray(self.block_tables),
-                jnp.asarray(self.slot_active), key)
-            # Next chunk can resume from device state (last sampled token
-            # per slot + advanced positions) with no host upload.
-            self._dev_state = (out[-1], new_pos)
-            out = np.asarray(out)                       # ONE host sync
+            d = self._dispatch_chunk(max_steps)
+        if d is None:
+            return finished
+        if d == "incompatible":
+            return finished + self.step()
+        return finished + self._process_chunk(*d)
+
+    def _dispatch_chunk(self, max_steps: int):
+        """Dispatch one chunk (async — no host sync).  Caller holds the
+        lock.  Returns None (nothing active), "incompatible" (mixed
+        sampling params / exhausted budgets: use per-token step()), or
+        (device_out, steps, per-slot request snapshot)."""
+        jnp = self._jnp
+        from . import _model
+
+        active_reqs = [self.slot_req[s] for s in range(self.max_slots)
+                       if self.slot_active[s]]
+        if not active_reqs:
+            return None
+        sp0 = active_reqs[0].params
+        if any(r.params.temperature != sp0.temperature
+               or r.params.top_k != sp0.top_k for r in active_reqs):
+            return "incompatible"
+        # Cap the chunk so no request overruns its token budget or
+        # page allocation, then round DOWN to a power of two: the
+        # compiled-program set stays tiny (log2(max_steps) shapes,
+        # dict-cached) instead of recompiling the scanned model for
+        # every distinct remaining-budget value.
+        steps = min([max_steps] + [
+            r.params.max_tokens - len(r.output_tokens)
+            for r in active_reqs])
+        if steps <= 0:
+            return "incompatible"
+        steps = 1 << (steps.bit_length() - 1)
+        shape_key = (steps, sp0.temperature, sp0.top_k)
+        fn = self._chunk_cache.get(shape_key)
+        if fn is None:
+            from functools import partial
+            fn = self._jax.jit(
+                partial(_model.decode_chunk, cfg=self.cfg,
+                        page_size=self.page_size, steps=steps,
+                        temperature=sp0.temperature, top_k=sp0.top_k),
+                donate_argnums=(1,))
+            self._chunk_cache[shape_key] = fn
+            while len(self._chunk_cache) > self._chunk_cache_cap:
+                self._chunk_cache.popitem(last=False)
+        else:
+            self._chunk_cache.move_to_end(shape_key)
+        self._decode_chunk = fn
+        self._chunk_key, key = self._jax.random.split(self._chunk_key)
+        if self._dev_state is not None:
+            toks_dev, pos_dev = self._dev_state
+        else:
+            toks_dev = jnp.asarray(self.slot_tokens)
+            pos_dev = jnp.asarray(self.slot_pos)
+        out, new_pos, self.kv_pages = self._decode_chunk(
+            self.params, self.kv_pages,
+            toks_dev, pos_dev, jnp.asarray(self.block_tables),
+            jnp.asarray(self.slot_active), key)
+        # Next chunk can resume from device state (last sampled token
+        # per slot + advanced positions) with no host upload.
+        self._dev_state = (out[-1], new_pos)
+        snap = [self.slot_req[s] if self.slot_active[s] else None
+                for s in range(self.max_slots)]
+        return (out, steps, snap)
+
+    def _process_chunk(self, out_dev, steps: int, snap,
+                       keep_dev_state: bool = False) -> List[Request]:
+        """Sync one dispatched chunk to host and apply its tokens.
+
+        ``snap`` is the per-slot request snapshot at dispatch: a slot
+        freed and re-admitted since then is skipped (the old request's
+        overgenerated tail is dropped).  ``keep_dev_state=True`` is the
+        pipelined mode: a LATER chunk has already been dispatched from
+        the current device state, so finishing a request here must not
+        invalidate it (inactive slots are masked by the `active` array
+        at the next dispatch instead)."""
+        out = np.asarray(out_dev)                       # ONE host sync
+        finished: List[Request] = []
+        with self._lock:
             any_finished = False
-            for slot in range(self.max_slots):
-                if not self.slot_active[slot]:
+            for slot, req in enumerate(snap):
+                if req is None or req.finished:
                     continue
-                req = self.slot_req[slot]
+                if self.slot_req[slot] is not req:
+                    continue  # slot re-admitted to a newer request
                 for i in range(steps):
                     tok = int(out[i, slot])
                     req.output_tokens.append(tok)
@@ -400,9 +430,70 @@ class InferenceEngine:
                         finished.append(req)
                         any_finished = True
                         break
-            if any_finished:
+            if any_finished and not keep_dev_state:
                 self._dev_state = None  # host mirrors changed
-            return finished
+        return finished
+
+    def run_pipelined(self, max_steps: int = 64,
+                      max_chunks: int = 1_000_000) -> List[Request]:
+        """Drain all queued work with DOUBLE-BUFFERED chunks: the device
+        executes chunk k+1 while the host reads back and applies chunk
+        k — over a high-latency host link the readback latency is fully
+        hidden behind compute (reference analog: vLLM's async engine
+        loop overlapping scheduling with execution).
+
+        Admission happens at pipeline bubbles (start, drain, or when
+        requests are waiting — one bubble per admission wave), so new
+        requests wait at most one chunk.  Finished requests may
+        overgenerate up to one extra chunk whose tokens are dropped
+        host-side; budget-exhausted slots overflow-write to reserved
+        page 0.  Returns every finished request."""
+        done: List[Request] = []
+        pending = None
+        for _ in range(max_chunks):
+            d = None
+            with self._lock:
+                if pending is None:
+                    self._admit()
+                    done.extend(self._admission_finished)
+                    self._admission_finished.clear()
+                skip = False
+                if pending is not None:
+                    if self.waiting and not self.slot_active.all():
+                        # Bubble ONLY when admission can actually make
+                        # progress (a slot is free): at saturation the
+                        # queue stays non-empty for the whole run and a
+                        # bubble per chunk would serialize the pipeline
+                        # exactly when load is highest.
+                        skip = True
+                    else:
+                        # The in-flight chunk already covers every active
+                        # budget: a further dispatch would be pure
+                        # overgeneration (a whole wasted device chunk).
+                        rem = [r.params.max_tokens - len(r.output_tokens)
+                               - pending[1]
+                               for r in (self.slot_req[s]
+                                         for s in range(self.max_slots)
+                                         if self.slot_active[s])]
+                        skip = bool(rem) and max(rem) <= 0
+                if not skip:
+                    d = self._dispatch_chunk(max_steps)
+            if d == "incompatible":
+                if pending is not None:
+                    done.extend(self._process_chunk(
+                        *pending, keep_dev_state=True))
+                    pending = None
+                done.extend(self.step_chunk(max_steps))
+                continue
+            if pending is not None:
+                done.extend(self._process_chunk(
+                    *pending, keep_dev_state=True))
+            pending = d
+            if pending is None:
+                with self._lock:
+                    if not self.waiting and not self.slot_active.any():
+                        return done
+        raise RuntimeError("run_pipelined did not drain")
 
     # -- offline batch API --------------------------------------------------
 

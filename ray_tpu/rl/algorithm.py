@@ -39,6 +39,8 @@ class AlgorithmConfig:
         self.train_batch_size = 512
         self.seed = 0
         self.module_hidden = (64, 64)
+        # Custom module factory (see rl_module(module_factory=...)).
+        self.module_factory: Optional[Callable] = None
         self.extra: Dict[str, Any] = {}
 
     # -- fluent setters --------------------------------------------------- #
@@ -92,9 +94,17 @@ class AlgorithmConfig:
         self.extra.update(extra)
         return self
 
-    def rl_module(self, *, hidden=None) -> "AlgorithmConfig":
+    def rl_module(self, *, hidden=None,
+                  module_factory=None) -> "AlgorithmConfig":
+        """``module_factory``: zero-arg callable returning a custom
+        module (models.CNNPolicyModule / GRUPolicyModule, or anything
+        with the module dict surface).  Env runners AND learners build
+        from it, so recurrent modules train end-to-end (reference:
+        rl_module(rl_module_spec=...) custom RLModule classes)."""
         if hidden is not None:
             self.module_hidden = tuple(hidden)
+        if module_factory is not None:
+            self.module_factory = module_factory
         return self
 
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
@@ -143,7 +153,8 @@ class Algorithm:
                 num_envs_per_runner=config.num_envs_per_runner,
                 module_spec=config.module_spec(), seed=config.seed,
                 env_to_module_fn=config.env_to_module_fn
-                and config.build_env_to_module)
+                and config.build_env_to_module,
+                module_fn=config.module_factory)
         self.setup(config)
 
     # -- subclass hooks ---------------------------------------------------- #

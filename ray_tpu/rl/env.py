@@ -115,6 +115,42 @@ class StatelessGuess(Env):
         return obs, reward, True, False, {}
 
 
+class DelayedRecall(Env):
+    """Partially observable memory probe: the FIRST observation encodes
+    a one-hot cue; every later observation carries only a phase scalar.
+    Reward 1 arrives iff the action on the final step matches the cue —
+    a memoryless policy cannot beat 1/num_actions expected return, a
+    recurrent one reaches ~1.0 (the recurrent-module analog of rllib's
+    StatelessCartPole memory checks)."""
+
+    def __init__(self, delay: int = 3, n: int = 2, seed: int = 0):
+        self.delay = delay
+        self.observation_dim = 1 + n      # [phase, cue one-hot...]
+        self.num_actions = n
+        self._rng = np.random.default_rng(seed)
+        self._cue = 0
+        self._t = 0
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._cue = int(self._rng.integers(self.num_actions))
+        self._t = 0
+        obs = np.zeros(self.observation_dim, np.float32)
+        obs[1 + self._cue] = 1.0
+        return obs, {}
+
+    def step(self, action: int):
+        self._t += 1
+        done = self._t > self.delay
+        reward = 0.0
+        if done:
+            reward = 1.0 if int(action) == self._cue else 0.0
+        obs = np.zeros(self.observation_dim, np.float32)
+        obs[0] = self._t / (self.delay + 1)
+        return obs, reward, done, False, {}
+
+
 class Pendulum(Env):
     """Classic underactuated pendulum swing-up (gymnasium Pendulum-v1
     dynamics): obs [cos th, sin th, th_dot], torque in [-2, 2], reward

@@ -60,10 +60,35 @@ class EnvRunner:
         self._finished_returns: List[float] = []
         self._finished_lens: List[int] = []
 
-        self._explore_fn = jax.jit(self.module.forward_exploration)
-        self._infer_fn = jax.jit(self.module.forward_inference)
-        self._value_fn = jax.jit(
-            lambda p, o: self.module.forward_train(p, o)["value"])
+        # Recurrent modules (models.GRUPolicyModule surface:
+        # initial_state/forward_step) carry hidden state through the
+        # rollout; sample() then also records window-start states and
+        # PPO trains with sequence batches (reference:
+        # rllib/env/single_agent_env_runner.py:66 stateful-module
+        # handling via connector pipelines).
+        self.recurrent = hasattr(self.module, "initial_state") \
+            and hasattr(self.module, "forward_step")
+        if self.recurrent:
+            self._rec_state = np.asarray(
+                self.module.initial_state(num_envs), np.float32)
+
+            def explore_rec(p, obs, state, key):
+                logits, value, new_state = self.module.forward_step(
+                    p, obs, state)
+                action = jax.random.categorical(key, logits)
+                import jax.numpy as jnp
+                logp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits), action[:, None],
+                    axis=-1)[:, 0]
+                return action, logp, value, new_state
+
+            self._explore_rec = jax.jit(explore_rec)
+            self._step_fn = jax.jit(self.module.forward_step)
+        else:
+            self._explore_fn = jax.jit(self.module.forward_exploration)
+            self._infer_fn = jax.jit(self.module.forward_inference)
+            self._value_fn = jax.jit(
+                lambda p, o: self.module.forward_train(p, o)["value"])
 
     def _connect(self, obs: np.ndarray) -> np.ndarray:
         return obs if self.env_to_module is None else self.env_to_module(obs)
@@ -117,10 +142,20 @@ class EnvRunner:
         # V(final_obs) for truncated boundaries (0 elsewhere): the GAE
         # bootstrap for episodes cut by time limits, not by termination.
         boot_buf = np.zeros((num_steps, n), np.float32)
+        # Recurrent: the learner replays this window from its start
+        # state, resetting at in-window episode boundaries.
+        state_in = np.array(self._rec_state) if self.recurrent else None
 
         for t in range(num_steps):
             self._key, sub = jax.random.split(self._key)
-            if self.explore:
+            if self.recurrent:
+                actions, logp, values, new_state = self._explore_rec(
+                    self.params, self._obs, self._rec_state, sub)
+                self._rec_state = np.asarray(new_state)
+                if not self.explore:
+                    logp = np.zeros(n, np.float32)
+                    values = np.zeros(n, np.float32)
+            elif self.explore:
                 actions, logp, values = self._explore_fn(
                     self.params, self._obs, sub)
             else:
@@ -144,13 +179,26 @@ class EnvRunner:
             done_buf[t] = dones
             term_buf[t] = terms
             truncs = dones & ~terms
+            if self.recurrent and dones.any():
+                # Fresh episodes start from the zero state.  (np.asarray
+                # of a jax output is read-only: build a new array.)
+                self._rec_state = np.where(dones[:, None], 0.0,
+                                           self._rec_state
+                                           ).astype(np.float32)
             if self.explore and truncs.any():
                 # Note: with a stateful FrameStack connector the truncation
                 # bootstrap sees the post-step stack — an approximation the
                 # reference shares (final_observation is a single frame).
                 fo = final_obs if self.env_to_module is None else \
                     self.env_to_module.transform(final_obs)
-                vals = np.asarray(self._value_fn(self.params, fo))
+                if self.recurrent:
+                    # Value of the truncated final obs under the
+                    # pre-reset state (the state that produced it).
+                    _lg, v_dev, _st = self._step_fn(
+                        self.params, fo, np.asarray(new_state))
+                    vals = np.asarray(v_dev)
+                else:
+                    vals = np.asarray(self._value_fn(self.params, fo))
                 boot_buf[t, truncs] = vals[truncs]
             self._ep_returns += rewards
             self._ep_lens += 1
@@ -161,18 +209,25 @@ class EnvRunner:
                 self._ep_lens[i] = 0
 
         # Bootstrap value for the final observation of each sub-env.
-        if self.explore:
+        if self.explore and self.recurrent:
+            _lg, last_val, _st = self._step_fn(self.params, self._obs,
+                                               self._rec_state)
+            last_val = np.asarray(last_val)
+        elif self.explore:
             self._key, sub = jax.random.split(self._key)
             _, _, last_val = self._explore_fn(self.params, self._obs, sub)
             last_val = np.asarray(last_val)
         else:
             last_val = np.zeros(n, np.float32)
-        return {
+        out = {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
             "terminateds": term_buf, "bootstrap_values": boot_buf,
             "last_values": last_val,
         }
+        if self.recurrent:
+            out["state_in"] = state_in
+        return out
 
     def metrics(self, window: int = 100) -> Dict[str, float]:
         rets = self._finished_returns[-window:]
@@ -198,7 +253,7 @@ class EnvRunnerGroup:
                  num_envs_per_runner: int = 4,
                  module_spec: Optional[RLModuleSpec] = None, seed: int = 0,
                  runner_resources: Optional[Dict[str, float]] = None,
-                 env_to_module_fn=None):
+                 env_to_module_fn=None, module_fn=None):
         self.num_env_runners = num_env_runners
         # Prototype pipeline used only for merge_states on gathered
         # per-runner connector states (its own state is never consulted).
@@ -208,7 +263,8 @@ class EnvRunnerGroup:
             self.local = EnvRunner(
                 env_creator, num_envs=num_envs_per_runner,
                 module_spec=module_spec, seed=seed,
-                env_to_module=env_to_module_fn and env_to_module_fn())
+                env_to_module=env_to_module_fn and env_to_module_fn(),
+                module=module_fn and module_fn())
             self.remotes = []
         else:
             import ray_tpu
@@ -221,7 +277,8 @@ class EnvRunnerGroup:
                 cls.options(**opts).remote(
                     env_creator, num_envs=num_envs_per_runner,
                     module_spec=module_spec, seed=seed + 1000 * (i + 1),
-                    env_to_module=env_to_module_fn and env_to_module_fn())
+                    env_to_module=env_to_module_fn and env_to_module_fn(),
+                    module=module_fn and module_fn())
                 for i in range(num_env_runners)
             ]
 

@@ -17,7 +17,7 @@ integration needs that plumbing and is NOT automatic.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -172,17 +172,29 @@ class GRUPolicyModule:
         return h @ params["w_pi"], (h @ params["w_v"])[:, 0], h
 
     def forward_train(self, params: Params, obs_seq: jax.Array,
-                      initial_state: jax.Array) -> Dict[str, jax.Array]:
+                      initial_state: jax.Array,
+                      resets: Optional[jax.Array] = None
+                      ) -> Dict[str, jax.Array]:
         """obs_seq [B, T, obs_dim] -> {"action_logits" [B, T, A],
-        "value" [B, T]} — the module dict convention over sequences."""
-        xs = self._embed(params, obs_seq)          # [B, T, d]
+        "value" [B, T]} — the module dict convention over sequences.
 
-        def step(h, x_t):
+        ``resets`` [B, T] bool zeroes the hidden state BEFORE consuming
+        step t: training replays exactly the rollout's episode
+        boundaries (reference analog: rllib sequence masking for
+        recurrent modules)."""
+        xs = self._embed(params, obs_seq)          # [B, T, d]
+        if resets is None:
+            resets = jnp.zeros(obs_seq.shape[:2], bool)
+
+        def step(h, xr):
+            x_t, r_t = xr
+            h = jnp.where(r_t[:, None], 0.0, h)
             h = self._cell(params, x_t, h)
             return h, h
 
-        _, hs = jax.lax.scan(step, initial_state,
-                             jnp.swapaxes(xs, 0, 1))   # [T, B, H]
+        _, hs = jax.lax.scan(
+            step, initial_state,
+            (jnp.swapaxes(xs, 0, 1), jnp.swapaxes(resets, 0, 1)))
         hs = jnp.swapaxes(hs, 0, 1)                    # [B, T, H]
         return {"action_logits": hs @ params["w_pi"],
                 "value": (hs @ params["w_v"])[..., 0]}

@@ -75,6 +75,36 @@ def ppo_loss(module: DiscretePolicyModule, params, batch):
                    "kl": jnp.mean(batch["logp_old"] - logp)}
 
 
+def ppo_loss_recurrent(module, params, batch):
+    """PPO loss over SEQUENCE minibatches for stateful modules: the
+    module replays each env's whole rollout window from its recorded
+    start state, resetting at in-window episode boundaries (reference:
+    rllib recurrent PPO with sequence batching)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = module.forward_train(params, batch["obs"], batch["state_in"],
+                               batch["resets"])
+    logits = out["action_logits"]                  # [B, T, A]
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None].astype(jnp.int32),
+        axis=-1)[..., 0]                           # [B, T]
+    ratio = jnp.exp(logp - batch["logp_old"])
+    adv = batch["advantages"]
+    clip = batch["clip_param"][0]
+    surrogate = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+    policy_loss = -jnp.mean(surrogate)
+    value_loss = jnp.mean((out["value"] - batch["value_targets"]) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = policy_loss + batch["vf_coeff"][0] * value_loss \
+        - batch["ent_coeff"][0] * entropy
+    return total, {"policy_loss": policy_loss, "vf_loss": value_loss,
+                   "entropy": entropy,
+                   "kl": jnp.mean(batch["logp_old"] - logp)}
+
+
 class PPOConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__(PPO)
@@ -108,10 +138,14 @@ class PPO(Algorithm):
     def setup(self, config: PPOConfig) -> None:
         spec = config.module_spec()
         lr, seed = config.lr, config.seed
+        module_factory = config.module_factory
 
         def factory():
-            return JaxLearner(DiscretePolicyModule(spec), ppo_loss,
-                              learning_rate=lr, seed=seed)
+            module = module_factory() if module_factory \
+                else DiscretePolicyModule(spec)
+            loss = ppo_loss_recurrent \
+                if hasattr(module, "initial_state") else ppo_loss
+            return JaxLearner(module, loss, learning_rate=lr, seed=seed)
 
         self.learner_group = LearnerGroup(
             factory, num_learners=config.num_learners)
@@ -122,6 +156,8 @@ class PPO(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         cfg: PPOConfig = self.config
         rollouts = self.env_runner_group.sample(cfg.rollout_fragment_length)
+        if "state_in" in rollouts[0]:
+            return self._training_step_recurrent(cfg, rollouts)
 
         flat: Dict[str, list] = {k: [] for k in
                                  ("obs", "actions", "logp_old",
@@ -163,6 +199,56 @@ class PPO(Algorithm):
             self.learner_group.get_weights_ref())
         return {"learner": metrics,
                 "num_env_steps_sampled": n}
+
+    def _training_step_recurrent(self, cfg: "PPOConfig",
+                                 rollouts) -> Dict[str, Any]:
+        """Sequence batching for stateful modules: rows are whole
+        per-env rollout windows ([B, T] arrays, never shuffled across
+        time); the learner replays each from its recorded start state
+        with resets at in-window episode boundaries (reference: rllib
+        recurrent PPO sequence batching)."""
+        seq: Dict[str, list] = {k: [] for k in
+                                ("obs", "actions", "logp_old",
+                                 "advantages", "value_targets",
+                                 "state_in", "resets")}
+        for ro in rollouts:
+            adv, ret = compute_gae(ro["rewards"], ro["values"], ro["dones"],
+                                   ro["terminateds"], ro["last_values"],
+                                   cfg.gamma, cfg.lambda_,
+                                   ro.get("bootstrap_values"))
+            dones = np.swapaxes(ro["dones"], 0, 1)         # [N, T]
+            resets = np.zeros_like(dones)
+            resets[:, 1:] = dones[:, :-1]
+            seq["obs"].append(np.swapaxes(ro["obs"], 0, 1))
+            seq["actions"].append(np.swapaxes(ro["actions"], 0, 1))
+            seq["logp_old"].append(np.swapaxes(ro["logp"], 0, 1))
+            seq["advantages"].append(np.swapaxes(adv, 0, 1))
+            seq["value_targets"].append(np.swapaxes(ret, 0, 1))
+            seq["state_in"].append(ro["state_in"])
+            seq["resets"].append(resets)
+        batch = {k: np.concatenate(v) for k, v in seq.items()}
+        adv = batch["advantages"]
+        batch["advantages"] = ((adv - adv.mean())
+                               / (adv.std() + 1e-8)).astype(np.float32)
+        n_rows, T = batch["actions"].shape
+        consts = {
+            "clip_param": np.array([cfg.clip_param], np.float32),
+            "vf_coeff": np.array([cfg.vf_loss_coeff], np.float32),
+            "ent_coeff": np.array([cfg.entropy_coeff], np.float32),
+        }
+        metrics: Dict[str, float] = {}
+        mb_rows = max(1, min(n_rows, cfg.minibatch_size // max(T, 1)))
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n_rows)
+            for s in range(0, n_rows - mb_rows + 1, mb_rows):
+                idx = perm[s:s + mb_rows]
+                minibatch = {k: v[idx] for k, v in batch.items()}
+                minibatch.update(consts)
+                metrics = self.learner_group.update(minibatch)
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights_ref())
+        return {"learner": metrics,
+                "num_env_steps_sampled": n_rows * T}
 
     def get_weights(self):
         return self.learner_group.get_weights()

@@ -66,6 +66,23 @@ class TestInferenceEngine:
         want = [naive_greedy(params, p, 9) for p in prompts]
         assert chunked == want
 
+    def test_pipelined_decode_matches_per_step(self, params):
+        """Double-buffered chunk pipelining (run_pipelined: host applies
+        chunk k while the device runs k+1) is a pure latency
+        optimization — the greedy token streams are identical, including
+        mid-flight admission at a pipeline bubble."""
+        prompts = [[3, 17, 92, 5, 41], [7, 9, 23, 6], [11, 4], [8, 8, 2]]
+        sp = SamplingParams(max_tokens=9)
+        eng = InferenceEngine(params, CFG, max_slots=2, page_size=8,
+                              num_pages=64, prefill_buckets=(16,))
+        # max_slots=2 < 4 prompts forces admission waves mid-pipeline.
+        ids = [eng.add_request(p, sp) for p in prompts]
+        done = {r.request_id: r.output_tokens
+                for r in eng.run_pipelined(4, max_chunks=200)}
+        got = [done[i] for i in ids]
+        want = [naive_greedy(params, p, 9) for p in prompts]
+        assert got == want
+
     def test_continuous_batching_matches_sequential(self, params):
         prompts = [[7, 9, 23], [4, 4, 8, 15, 16, 23, 42], [99], [1, 2]]
         eng = InferenceEngine(params, CFG, max_slots=2, page_size=8,

@@ -556,3 +556,48 @@ class TestEnvRunnerHooks:
         assert batch["rewards"].shape == (10, 2)
         # CartPole rewards are +1; the reward-path connector clipped them.
         assert np.all(batch["rewards"] == 0.5)
+
+
+class TestRecurrentPPO:
+    """GRU-PPO through the FULL Algorithm/EnvRunner/Learner stack
+    (reference: rllib recurrent modules through
+    env/single_agent_env_runner.py:66 + sequence-batched PPO)."""
+
+    def _train(self, module_factory, iters, seed=0):
+        from ray_tpu.rl import PPOConfig
+        from ray_tpu.rl.env import DelayedRecall
+
+        cfg = (PPOConfig()
+               .environment(lambda: DelayedRecall(delay=3))
+               .env_runners(num_envs_per_env_runner=16,
+                            rollout_fragment_length=32)
+               .training(lr=5e-3, num_epochs=6, minibatch_size=256,
+                         gamma=0.9, entropy_coeff=0.003)
+               .debugging(seed=seed))
+        if module_factory is not None:
+            cfg = cfg.rl_module(module_factory=module_factory)
+        algo = cfg.build_algo()
+        try:
+            last = None
+            for _ in range(iters):
+                last = algo.train()
+            return last["env_runners"]["episode_return_mean"]
+        finally:
+            algo.stop()
+
+    def test_gru_ppo_beats_memoryless_on_memory_task(self, ray_start):
+        """DelayedRecall pays only for remembering the first
+        observation: the memoryless MLP is capped at ~1/2 expected
+        return; the GRU module through the same stack must clearly beat
+        it."""
+        from ray_tpu.rl import GRUPolicyModule, RecurrentPolicySpec
+
+        def gru_factory():
+            return GRUPolicyModule(RecurrentPolicySpec(
+                obs_dim=3, num_actions=2, hidden=16, embed=(32,)))
+
+        ret_gru = self._train(gru_factory, iters=25)
+        ret_mlp = self._train(None, iters=25)
+        assert ret_mlp < 0.75, f"memoryless should be capped: {ret_mlp}"
+        assert ret_gru > 0.85, f"GRU-PPO failed to learn: {ret_gru}"
+        assert ret_gru > ret_mlp + 0.15
