@@ -159,20 +159,37 @@ def bench_decode(params, cfg, *, max_slots: int, prompt_len: int,
     dt = time.perf_counter() - t0
 
     # Latency pass: per-chunk timing through the non-pipelined path.
+    # Each chunk's wall time is attributed over the tokens it ACTUALLY
+    # produced (the engine rounds steps to powers of two under remaining
+    # budgets), measured as the per-request output-length delta.
     add_all()
+    with eng._lock:
+        tracked = list(eng.running.values())
     per_token_ms = []
+    first_chunk_tokens = None
+    prev_lens = [len(r.output_tokens) for r in tracked]
     n = 0
     while eng.has_work():
         t1 = time.perf_counter()
         eng.step_chunk(chunk)
         cdt = time.perf_counter() - t1
-        per_token_ms.extend([cdt * 1000.0 / chunk] * chunk)
+        lens = [len(r.output_tokens) for r in tracked]
+        deltas = [a - b for a, b in zip(lens, prev_lens)]
+        prev_lens = lens
+        produced = sum(deltas)
+        steps = max(deltas, default=0)  # tokens per STREAM this chunk
+        if produced > 0 and steps > 0:
+            # A stream's inter-token latency this chunk is cdt/steps;
+            # one sample per produced token weights streams correctly.
+            per_token_ms.extend([cdt * 1000.0 / steps] * produced)
+            if first_chunk_tokens is None:
+                first_chunk_tokens = produced
         n += 1
         if n > 20 * gen_tokens:
             raise RuntimeError("decode bench did not drain")
     # Drop the whole first chunk's entries: its wall time includes the
     # admission prefills.
-    lat = np.asarray(per_token_ms[chunk:] or [0.0])
+    lat = np.asarray(per_token_ms[first_chunk_tokens or 0:] or [0.0])
     # Prefill cost is inside dt; report decoded tokens over the window —
     # the steady-state serving mix a continuous-batching engine sees.
     return {"tps": max_slots * gen_tokens / dt,

@@ -82,8 +82,17 @@ class Dataset:
         into ``parallelism`` blocks; a list maps table-per-block —
         numeric columns convert zero-copy."""
         if not isinstance(tables, (list, tuple)):
-            return Dataset.from_numpy(
-                BlockAccessor.from_arrow(tables), parallelism)
+            block = BlockAccessor.from_arrow(tables)
+            if isinstance(block, dict):
+                return Dataset.from_numpy(block, parallelism)
+            # Arrow layout: split into zero-copy table slices.
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            parallelism = max(1, min(parallelism, n or 1))
+            bounds = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+            return Dataset([acc.slice(int(a), int(b))
+                            for a, b in zip(bounds[:-1], bounds[1:])],
+                           [], parallelism)
         blocks = [BlockAccessor.from_arrow(t) for t in tables]
         return Dataset(blocks, [], max(1, len(blocks)))
 

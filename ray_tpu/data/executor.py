@@ -10,11 +10,13 @@ block into N outputs, reduce tasks merge partition j from every map task)
 — block payloads move worker-to-worker through the object store, never
 through the driver.
 
-Block format note (deliberate divergence): blocks stay dict-of-ndarray
-rather than Arrow tables — numpy columns serialize zero-copy through the
-shm store (pickle-5 out-of-band buffers) and feed jax.device_put directly,
-which is the TPU-first I/O path; Arrow interop lives at the read/write
-edges (BlockAccessor.from_arrow / to_pandas).
+Block format note: numpy dict blocks are the default (columns serialize
+zero-copy through the shm store and feed jax.device_put directly — the
+TPU-first I/O path); `DataContext.block_format = "arrow"` flows pyarrow
+Tables through these same stages instead (zero-copy scans/slices, numpy
+only at the consumer boundary).  Stage code must touch blocks through
+BlockAccessor (which dispatches on the physical layout), never raw dict
+operations.
 """
 
 from __future__ import annotations
@@ -283,7 +285,7 @@ def _stable_hash_mod(values: np.ndarray, n: int) -> np.ndarray:
 
 
 def _sample_keys(key: str, k: int, fns, block_or_read) -> np.ndarray:
-    block = _apply_chain(fns, block_or_read)
+    block = BlockAccessor(_apply_chain(fns, block_or_read)).to_numpy()
     keys = block.get(key)
     if keys is None or len(keys) == 0:
         return np.array([])

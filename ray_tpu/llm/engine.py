@@ -461,11 +461,17 @@ class InferenceEngine:
                 if pending is not None:
                     if self.waiting and not self.slot_active.all():
                         # Bubble ONLY when admission can actually make
-                        # progress (a slot is free): at saturation the
-                        # queue stays non-empty for the whole run and a
-                        # bubble per chunk would serialize the pipeline
-                        # exactly when load is highest.
-                        skip = True
+                        # progress (a slot is free AND the head request's
+                        # pages fit): at saturation — or against an
+                        # oversized head request — the queue stays
+                        # non-empty for the whole run and a bubble per
+                        # chunk would serialize the pipeline exactly when
+                        # load is highest.
+                        head = self.waiting[0]
+                        need = math.ceil(
+                            (len(head.prompt_tokens)
+                             + head.params.max_tokens) / self.page_size)
+                        skip = self.pool.num_free >= need
                     else:
                         # The in-flight chunk already covers every active
                         # budget: a further dispatch would be pure

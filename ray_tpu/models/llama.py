@@ -68,6 +68,12 @@ class LlamaConfig:
     # mesh with pp > 1 and layers % pp == 0; the "layers" logical axis is
     # then sharded over pp (see parallel/pipeline.py).
     pp_microbatches: int = 0
+    # Chunked cross-entropy: compute the [B, S, vocab] logits in this
+    # many sequence chunks (scan + remat), so only ONE chunk's f32
+    # logits are ever resident — the full tensor is ~2.6 GB at
+    # bs10/seq2048/vocab32k and dominates peak HBM at the loss.  0 = the
+    # single fused logits computation.
+    loss_chunks: int = 0
 
     def replace(self, **kw) -> "LlamaConfig":
         return dataclasses.replace(self, **kw)
@@ -236,10 +242,11 @@ def _block(cfg: LlamaConfig, cos, sin, positions, x, layer):
     return _mlp_half(cfg, x, layer)
 
 
-def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
-                     cfg: LlamaConfig,
-                     positions: Optional[jax.Array] = None):
-    """tokens: [B, S] int32 -> (logits [B, S, vocab] f32, moe aux loss).
+def _forward_hidden(params: Dict[str, Any], tokens: jax.Array,
+                    cfg: LlamaConfig,
+                    positions: Optional[jax.Array] = None):
+    """tokens: [B, S] int32 -> (final hidden [B, S, E], moe aux loss);
+    forward_with_aux applies the lm_head on top.
 
     ``positions``: absolute positions [S] (defaults to arange; sequence-
     sharded callers pass their shard's global positions).
@@ -265,6 +272,20 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
 
         def block(x, layer):
             return mlp(_attn_half(cfg, cos, sin, positions, x, layer), layer)
+    elif cfg.remat == "dots":
+        # Selective per-op saving: keep every matmul output (the MXU work
+        # worth not repeating), recompute the cheap VPU elementwise ops
+        # (norms/rope/silu) in backward — between "full" and no remat on
+        # the memory/FLOPs trade.
+        block = jax.checkpoint(
+            partial(_block, cfg, cos, sin, positions),
+            policy=jax.checkpoint_policies.checkpoint_dots)
+    elif cfg.remat == "dots_nobatch":
+        # Save only batch-free dots (weights-stationary projections);
+        # activation-activation matmuls recompute.
+        block = jax.checkpoint(
+            partial(_block, cfg, cos, sin, positions),
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
     elif cfg.remat is False:
         block = partial(_block, cfg, cos, sin, positions)
     else:
@@ -299,9 +320,17 @@ def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
     else:
         x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(dt),
+    return x, jnp.sum(auxes)
+
+
+def forward_with_aux(params: Dict[str, Any], tokens: jax.Array,
+                     cfg: LlamaConfig,
+                     positions: Optional[jax.Array] = None):
+    x, aux = _forward_hidden(params, tokens, cfg, positions)
+    logits = jnp.einsum("bse,ev->bsv", x,
+                        params["lm_head"].astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
-    return logits, jnp.sum(auxes)
+    return logits, aux
 
 
 def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
@@ -309,29 +338,70 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
     return forward_with_aux(params, tokens, cfg, positions)[0]
 
 
+def _chunked_nll_sum(x, lm_head, targets, mask, num_chunks: int, dt):
+    """Masked next-token NLL sum with the lm_head applied per sequence
+    chunk under remat: peak logits memory is one chunk's [B, S/c, vocab]
+    f32 slab (forward AND backward) instead of the full tensor."""
+    B, S, E = x.shape
+    assert S % num_chunks == 0, (S, num_chunks)
+    c = S // num_chunks
+    xs = jnp.swapaxes(x.reshape(B, num_chunks, c, E), 0, 1)
+    ts = jnp.swapaxes(targets.reshape(B, num_chunks, c), 0, 1)
+    ms = jnp.swapaxes(mask.reshape(B, num_chunks, c), 0, 1)
+
+    @jax.checkpoint
+    def chunk_nll(xc, tc, mc):
+        logits = jnp.einsum("bse,ev->bsv", xc, lm_head.astype(dt),
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return jnp.sum((lse - tgt) * mc)
+
+    def body(acc, xtm):
+        return acc + chunk_nll(*xtm), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                            (xs, ts, ms))
+    return total
+
+
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
             cfg: LlamaConfig,
             positions: Optional[jax.Array] = None) -> jax.Array:
     """Next-token cross-entropy.  batch: tokens [B,S], loss_mask [B,S]."""
     tokens = batch["tokens"]
-    logits, aux = forward_with_aux(params, tokens, cfg, positions)
     targets = jnp.concatenate(
         [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
-    # logsumexp formulation: nll = LSE(logits) - logit[target].  Unlike
-    # log_softmax this never materializes a second [B, S, vocab] array —
-    # the LSE reduce fuses into the lm_head matmul consumer, and the
-    # backward's softmax is recomputed elementwise into the dW/dx matmuls.
-    logits = logits.astype(jnp.float32)
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = lse - tgt
     mask = batch.get("loss_mask")
     if mask is None:
         mask = jnp.concatenate(
             [jnp.ones_like(tokens[:, 1:]), jnp.zeros_like(tokens[:, :1])],
             axis=1)
     mask = mask.astype(jnp.float32)
-    loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    # Gradient-accumulation callers inject the FULL batch's token count
+    # so per-microbatch means sum to exactly the unaccumulated loss even
+    # with uneven masking (see spmd.make_lm_train_step).
+    denom = batch.get("loss_denom")
+    if denom is None:
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+    if cfg.loss_chunks:
+        x, aux = _forward_hidden(params, tokens, cfg, positions)
+        nll_sum = _chunked_nll_sum(x, params["lm_head"], targets, mask,
+                                   cfg.loss_chunks, cfg.dtype)
+        loss = nll_sum / denom
+    else:
+        logits, aux = forward_with_aux(params, tokens, cfg, positions)
+        # logsumexp formulation: nll = LSE(logits) - logit[target].
+        # Unlike log_softmax this never materializes a second
+        # [B, S, vocab] array — the LSE reduce fuses into the lm_head
+        # matmul consumer, and the backward's softmax is recomputed
+        # elementwise into the dW/dx matmuls.
+        logits = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, targets[..., None],
+                                  axis=-1)[..., 0]
+        nll = lse - tgt
+        loss = jnp.sum(nll * mask) / denom
     if cfg.num_experts:
         loss = loss + 0.01 * aux / cfg.layers
     return loss

@@ -32,7 +32,8 @@ def batch_pspec(mesh, rules: Optional[ShardingRules] = None):
 
 def make_lm_train_step(cfg, mesh, *, rules: Optional[ShardingRules] = None,
                        optimizer=None, learning_rate: float = 3e-4,
-                       donate: bool = True, param_dtype=None):
+                       donate: bool = True, param_dtype=None,
+                       grad_accum: int = 1):
     """Build (init_fn, step_fn) for a models.llama LM on ``mesh``.
 
     init_fn(key) -> (params, opt_state) already sharded.
@@ -41,8 +42,16 @@ def make_lm_train_step(cfg, mesh, *, rules: Optional[ShardingRules] = None,
     ``param_dtype`` overrides parameter (and hence optimizer-state)
     storage: bfloat16 halves the adamw footprint so ~1.5B params fit one
     v5e chip with remat (HBM budget: params+m+v at 2 bytes each).
+
+    ``grad_accum`` > 1 splits the batch's leading dim into that many
+    microbatches, accumulating gradients in an f32 scan before ONE
+    optimizer update — the effective batch is unchanged, but saved
+    activations (and thus the remat policy's HBM bill) shrink by the
+    same factor, which is what lets lighter-recompute policies like
+    remat="mlp_only" fit a 16G chip at headline model sizes.
     """
     import jax
+    import jax.numpy as jnp
     import optax
     from jax.sharding import NamedSharding
 
@@ -78,7 +87,42 @@ def make_lm_train_step(cfg, mesh, *, rules: Optional[ShardingRules] = None,
     init_fn = jax.jit(init_all, out_shardings=(param_shardings, None))
 
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(L.loss_fn)(params, batch, cfg)
+        if grad_accum > 1:
+            def split(v):
+                b = v.shape[0]
+                assert b % grad_accum == 0, (b, grad_accum)
+                return v.reshape((grad_accum, b // grad_accum)
+                                 + v.shape[1:])
+
+            micro = {k: split(v) for k, v in batch.items()}
+            # Every microbatch normalizes by the FULL batch's unmasked
+            # token count, so summed per-micro losses/grads equal the
+            # unaccumulated step exactly even when masking is uneven
+            # across microbatches.
+            if "loss_mask" in batch:
+                denom = jnp.maximum(
+                    jnp.sum(batch["loss_mask"].astype(jnp.float32)), 1.0)
+            else:
+                t = batch["tokens"]
+                denom = jnp.asarray(t.shape[0] * (t.shape[1] - 1),
+                                    jnp.float32)
+            micro["loss_denom"] = jnp.full((grad_accum,), denom)
+
+            def acc_body(carry, mb):
+                gsum, lsum = carry
+                l, g = jax.value_and_grad(L.loss_fn)(params, mb, cfg)
+                gsum = jax.tree.map(
+                    lambda a, b: a + b.astype(a.dtype), gsum, g)
+                return (gsum, lsum + l), None
+
+            # Accumulator in the params dtype: an f32 copy of a bf16
+            # model's grads would cost 2 extra bytes/param of HBM — the
+            # very budget grad_accum exists to free.
+            gzero = jax.tree.map(jnp.zeros_like, params)
+            (grads, loss), _ = jax.lax.scan(
+                acc_body, (gzero, jnp.zeros((), jnp.float32)), micro)
+        else:
+            loss, grads = jax.value_and_grad(L.loss_fn)(params, batch, cfg)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         gnorm = optax.global_norm(grads)

@@ -149,12 +149,18 @@ class EnvRunner:
         for t in range(num_steps):
             self._key, sub = jax.random.split(self._key)
             if self.recurrent:
-                actions, logp, values, new_state = self._explore_rec(
-                    self.params, self._obs, self._rec_state, sub)
-                self._rec_state = np.asarray(new_state)
-                if not self.explore:
+                if self.explore:
+                    actions, logp, values, new_state = self._explore_rec(
+                        self.params, self._obs, self._rec_state, sub)
+                else:
+                    # Greedy, like the non-recurrent forward_inference
+                    # contract for evaluation runners.
+                    logits, _v, new_state = self._step_fn(
+                        self.params, self._obs, self._rec_state)
+                    actions = np.argmax(np.asarray(logits), axis=-1)
                     logp = np.zeros(n, np.float32)
                     values = np.zeros(n, np.float32)
+                self._rec_state = np.asarray(new_state)
             elif self.explore:
                 actions, logp, values = self._explore_fn(
                     self.params, self._obs, sub)

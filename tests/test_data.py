@@ -516,11 +516,16 @@ class TestArrowBlocks:
                                              batch_format="pyarrow")]
         assert all(isinstance(b, pa.Table) for b in blocks)
         assert sum(b.num_rows for b in blocks) == 1000
-        # Batch slices are views over the SAME parquet read buffers —
-        # no copies anywhere between the scan and the consumer.
-        src = t.column("x").chunks[0].buffers()[1]
-        got = blocks[0].column("x").chunks[0].buffers()[1]
-        assert got.address is not None  # buffer-backed, not rebuilt
+        # Zero-copy property (checked driver-locally, where buffer
+        # identity survives): batch slices of a Table-block dataset
+        # share the SOURCE table's buffers — same address, no copies.
+        local = rd.from_arrow(t)
+        batches = list(local.iter_batches(batch_size=300,
+                                          batch_format="pyarrow"))
+        src_addr = t.column("x").chunks[0].buffers()[1].address
+        for b in batches:
+            assert b.column("x").chunks[0].buffers()[1].address \
+                == src_addr
 
     def test_numpy_only_at_consumer_boundary(self, ray_start, arrow_ctx,
                                              tmp_path):

@@ -146,3 +146,58 @@ class TestShardedTrainStep:
         l_ring = self._run_steps(MeshSpec(dp=2, sp=4), cfg_ring, n=2, B=8)
         l_ref = self._run_steps(MeshSpec(dp=8), cfg_ref, n=2, B=8)
         np.testing.assert_allclose(l_ring, l_ref, rtol=2e-4)
+
+
+class TestTrainStepOptions:
+    def _setup(self, **cfg_kw):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models.llama import llama_tiny
+        from ray_tpu.parallel import MeshSpec, build_mesh
+        from ray_tpu.parallel.spmd import make_lm_train_step
+
+        cfg = llama_tiny().replace(dtype=jnp.float32, remat=False,
+                                   attention_impl="reference", **cfg_kw)
+        mesh = build_mesh(MeshSpec(dp=-1))   # all (virtual) devices
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 512, (8, 256))
+        mask = np.ones((8, 256), np.float32)
+        mask[:2, 10:] = 0.0          # uneven masking across microbatches
+        batch = {"tokens": jnp.asarray(tokens, jnp.int32),
+                 "loss_mask": jnp.asarray(mask)}
+        return cfg, mesh, batch
+
+    def _run(self, cfg, mesh, batch, **step_kw):
+        import jax
+
+        from ray_tpu.parallel.spmd import make_lm_train_step
+
+        init_fn, step_fn, place = make_lm_train_step(
+            cfg, mesh, learning_rate=1e-3, **step_kw)
+        params, opt = init_fn(jax.random.key(0))
+        for _ in range(3):
+            params, opt, m = step_fn(params, opt, place(dict(batch)))
+        return float(m["loss"]), float(m["grad_norm"])
+
+    def test_grad_accum_matches_single_step(self):
+        """grad_accum is a pure memory trade: losses and grads equal the
+        unaccumulated step exactly, including uneven loss masking (every
+        microbatch normalizes by the FULL batch's token count)."""
+        cfg, mesh, batch = self._setup()
+        l1, g1 = self._run(cfg, mesh, batch)
+        l3, g3 = self._run(cfg, mesh, batch, grad_accum=4)
+        assert abs(l1 - l3) < 1e-4
+        assert abs(g1 - g3) / g1 < 1e-3
+
+    def test_chunked_ce_matches_fused(self):
+        """loss_chunks computes the lm_head in sequence chunks under
+        remat: same loss and grads as the fused logits path, with only
+        one chunk's f32 logits ever resident."""
+        cfg, mesh, batch = self._setup()
+        l0, g0 = self._run(cfg, mesh, batch)
+        cfg8, _, _ = self._setup(loss_chunks=8)
+        l8, g8 = self._run(cfg8, mesh, batch)
+        assert abs(l0 - l8) < 1e-4
+        assert abs(g0 - g8) / g0 < 1e-3
