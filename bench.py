@@ -21,6 +21,31 @@ import sys
 import time
 
 
+_TELEMETRY_DOC: dict = {"phases": {}}
+
+
+def _dump_telemetry(phase: str) -> None:
+    """Write the built-in telemetry (Prometheus text + goodput summary)
+    accumulated so far to BENCH_telemetry.json next to this file, one
+    entry per bench phase — the perf trajectory carries the system
+    metrics alongside the headline JSON line."""
+    try:
+        from ray_tpu.util import metrics as _m
+        from ray_tpu.util import telemetry as _t
+        _TELEMETRY_DOC["phases"][phase] = {
+            "time": time.time(),
+            "prometheus": _m.prometheus_text(),
+            "goodput": _t.goodput_summary(),
+        }
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_telemetry.json")
+        with open(path, "w") as f:
+            json.dump(_TELEMETRY_DOC, f, indent=1)
+        print(f"# telemetry[{phase}] -> {path}", file=sys.stderr)
+    except Exception as e:  # telemetry must never sink the headline
+        print(f"# telemetry dump failed ({phase}): {e!r}", file=sys.stderr)
+
+
 PEAK_BF16_FLOPS = {
     # per chip, from published specs
     "v4": 275e12,
@@ -244,6 +269,8 @@ def main() -> None:
         warmup, iters = 1, 3
         param_dtype = None
 
+    from ray_tpu.util import telemetry
+    goodput = telemetry.GoodputTracker(initial_phase="init")
     mesh = build_mesh(MeshSpec(dp=n_dev))
     init_fn, step_fn, place = make_lm_train_step(cfg, mesh,
                                                  learning_rate=1e-4,
@@ -262,15 +289,20 @@ def main() -> None:
     # platforms where block_until_ready returns early.
     float(metrics["loss"])
 
+    goodput.enter("step")
     t0 = time.perf_counter()
     for i in range(iters):
         params, opt, metrics = step_fn(params, opt, batch)
     float(metrics["loss"])
     dt = time.perf_counter() - t0
+    goodput.finish()
 
     tokens_per_step = batch_size * seq
     tokens_per_sec = tokens_per_step * iters / dt
     tokens_per_sec_per_chip = tokens_per_sec / n_dev
+    telemetry.observe("ray_tpu_train_step_seconds", dt / iters)
+    telemetry.inc("ray_tpu_train_tokens_total", tokens_per_step * iters)
+    _dump_telemetry("train")
 
     p = num_params(cfg)
     mfu = 6.0 * p * tokens_per_sec / (PEAK_BF16_FLOPS[gen] * n_dev)
@@ -292,6 +324,7 @@ def main() -> None:
                                   num_pages=64, chunk=4)
     except Exception as e:  # decode bench must never sink the headline
         print(f"# decode bench failed: {e!r}", file=sys.stderr)
+    _dump_telemetry("decode")
 
     line = {
         "metric": f"llama_{p/1e6:.0f}M_sft_tokens_per_sec_per_chip_{gen}",

@@ -795,6 +795,14 @@ class WorkerLoop:
                 # BEFORE TaskDone or the owner could free first (FIFO
                 # outbox preserves the order).
                 rt.report_retained_borrows(borrows)
+        # Metrics recorded by this task must be at the driver before the
+        # task is observed complete (FIFO outbox orders the push ahead of
+        # TaskDone); no-op unless something was recorded since last flush.
+        try:
+            from ..util.metrics import flush_on_task_done
+            flush_on_task_done()
+        except Exception:
+            pass
         aid = spec.actor_id or spec.create_actor_id
         frame = wire.encode_task_done(
             spec.task_id.binary(), rt.worker_id.binary(),
@@ -903,6 +911,13 @@ class WorkerLoop:
                     traceback.print_exc()
         try:
             self._executor.shutdown()
+            # Final metrics push rides the outbox drain below (fire and
+            # forget: the recv loop that would deliver a reply is gone).
+            try:
+                from ..util.metrics import flush_on_task_done
+                flush_on_task_done()
+            except Exception:
+                pass
             rt.flush_and_close()
         finally:
             os._exit(0)

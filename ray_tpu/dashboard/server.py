@@ -11,7 +11,8 @@ Endpoints (reference: dashboard/modules/*):
     GET /api/placement_groups   — PG table
     GET /api/jobs               — job table
     GET /api/timeline           — chrome-trace events
-    GET /metrics                — Prometheus exposition (user metrics)
+    GET /api/metrics/summary    — built-in telemetry by subsystem + goodput
+    GET /metrics                — Prometheus exposition (user + built-in)
     GET /-/healthz              — liveness
 """
 
@@ -32,6 +33,7 @@ async function refresh(){
   const nodes = await (await fetch('/api/nodes')).json();
   const actors = await (await fetch('/api/actors')).json();
   const summary = await (await fetch('/api/tasks/summary')).json();
+  const telem = await (await fetch('/api/metrics/summary')).json();
   let h = '<h2>cluster</h2><table>';
   for (const [k,v] of Object.entries(c.total_resources))
     h += `<tr><td>${k}</td><td>${c.available_resources[k]??0} / ${v}</td></tr>`;
@@ -43,6 +45,25 @@ async function refresh(){
   for (const [name,states] of Object.entries(summary))
     h += `<tr><td>${name}</td><td>${JSON.stringify(states)}</td></tr>`;
   h += '</table>';
+  // Built-in system telemetry: serving / training / llm / data metrics.
+  h += '<h2>system telemetry</h2>';
+  if (telem.goodput)
+    h += `<p>train goodput: ${telem.goodput.goodput_ratio.toFixed(3)} `
+      + `(productive ${telem.goodput.productive_s.toFixed(1)}s / `
+      + `total ${telem.goodput.total_s.toFixed(1)}s)</p>`;
+  for (const [sub, metrics] of Object.entries(telem.subsystems || {})) {
+    h += `<h3>${sub}</h3><table><tr><th>metric</th><th>tags</th>`
+      + '<th>value</th></tr>';
+    for (const [name, m] of Object.entries(metrics))
+      for (const s of m.samples) {
+        const unit = name.endsWith('_seconds') ? 's' : '';
+        const v = m.type === 'histogram'
+          ? `n=${s.count} mean=${s.mean.toFixed(4)}${unit}` : s.value;
+        h += `<tr><td title="${m.description}">${name}</td>`
+          + `<td>${JSON.stringify(s.tags)}</td><td>${v}</td></tr>`;
+      }
+    h += '</table>';
+  }
   document.getElementById('out').innerHTML = h;
 }
 refresh(); setInterval(refresh, 2000);
@@ -131,6 +152,10 @@ class DashboardServer:
             return web.Response(text=prometheus_text(),
                                 content_type="text/plain")
 
+        async def metrics_summary(req):
+            from ..util import telemetry
+            return self._json(telemetry.summary())
+
         async def healthz(req):
             return web.Response(text="ok")
 
@@ -144,6 +169,7 @@ class DashboardServer:
         app.router.add_get("/api/placement_groups", pgs)
         app.router.add_get("/api/jobs", jobs)
         app.router.add_get("/api/timeline", timeline)
+        app.router.add_get("/api/metrics/summary", metrics_summary)
         app.router.add_get("/api/node_views", node_views)
         app.router.add_get("/api/logs", logs)
         app.router.add_get("/api/logs/{fname}", log_tail)

@@ -80,16 +80,38 @@ def _block_nbytes(ref) -> int:
     return 0
 
 
+def _note_op_block(operator: str, t0: float, block) -> None:
+    """Built-in data-pipeline metrics for one processed block (worker
+    side: they reach the driver via the metrics flush at task end)."""
+    import time
+    try:
+        from ..util import telemetry
+        rows = BlockAccessor(block).num_rows()
+    except Exception:
+        return
+    tags = {"operator": operator}
+    telemetry.observe("ray_tpu_data_block_seconds",
+                      time.perf_counter() - t0, tags=tags)
+    telemetry.inc("ray_tpu_data_blocks_total", tags=tags)
+    if rows:
+        telemetry.inc("ray_tpu_data_rows_total", rows, tags=tags)
+
+
 def _apply_chain(fns, block_or_read):
     """Worker-side: resolve a read marker, then run the fused stage chain."""
-    if isinstance(block_or_read, tuple) and len(block_or_read) == 3 \
-            and block_or_read[0] == "__read__":
+    import time
+    is_read = isinstance(block_or_read, tuple) and len(block_or_read) == 3 \
+        and block_or_read[0] == "__read__"
+    t0 = time.perf_counter()
+    if is_read:
         _tag, loader, path = block_or_read
         block = loader(path)
     else:
         block = block_or_read
     for fn in fns:
         block = fn(block)
+    if fns or is_read:  # bare pass-throughs (fetch) aren't operator work
+        _note_op_block("map", t0, block)
     return block
 
 
@@ -111,6 +133,8 @@ def _split_block(seed: Optional[int], n_out: int, randomize: bool,
 
 def _merge_parts(seed: Optional[int], randomize: bool, *parts):
     """Shuffle reduce side: merge partition j from every map task."""
+    import time
+    t0 = time.perf_counter()
     merged = BlockAccessor.concat(list(parts))
     if not merged and parts:
         # All parts empty: keep the schema (zero-row columns), don't
@@ -120,6 +144,7 @@ def _merge_parts(seed: Optional[int], randomize: bool, *parts):
         acc = BlockAccessor(merged)
         rng = np.random.default_rng(seed)
         merged = acc.take(rng.permutation(acc.num_rows()))
+    _note_op_block("reduce", t0, merged)
     return merged
 
 
@@ -312,6 +337,8 @@ def _key_split(key: str, boundaries, n_out: int, fns, block_or_read):
 
 
 def _merge_key_parts(key: str, descending: bool, do_sort: bool, *parts):
+    import time
+    t0 = time.perf_counter()
     merged = BlockAccessor.concat(list(parts))
     if not merged and parts:
         merged = parts[0]
@@ -320,6 +347,7 @@ def _merge_key_parts(key: str, descending: bool, do_sort: bool, *parts):
         if descending:
             order = order[::-1]
         merged = BlockAccessor(merged).take(order)
+    _note_op_block("reduce", t0, merged)
     return merged
 
 

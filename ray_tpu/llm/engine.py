@@ -15,12 +15,14 @@ from __future__ import annotations
 import itertools
 import math
 import threading
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..util import telemetry
 from ._cache import PagePool
 
 
@@ -44,6 +46,8 @@ class Request:
     pages: List[int] = field(default_factory=list)
     finished: bool = False
     finish_reason: str = ""
+    # Telemetry: submission time (perf_counter) for TTFT.
+    t_submit: float = 0.0
 
 
 class InferenceEngine:
@@ -125,11 +129,38 @@ class InferenceEngine:
     def add_request(self, prompt_tokens: List[int],
                     params: Optional[SamplingParams] = None) -> int:
         params = params or SamplingParams()
-        req = Request(next(self._req_ids), list(prompt_tokens), params)
+        req = Request(next(self._req_ids), list(prompt_tokens), params,
+                      t_submit=time.perf_counter())
         with self._lock:
             self.waiting.append(req)
             self.running[req.request_id] = req
+            self._update_gauges()
         return req.request_id
+
+    # -- telemetry ----------------------------------------------------------
+
+    def _update_gauges(self) -> None:
+        """Occupancy/queue-depth gauges; callers hold the engine lock."""
+        telemetry.set_gauge("ray_tpu_llm_active_slots",
+                            int(self.slot_active.sum()))
+        telemetry.set_gauge("ray_tpu_llm_kv_page_occupancy",
+                            1.0 - self.pool.num_free
+                            / max(self.pool.num_pages, 1))
+        telemetry.set_gauge("ray_tpu_llm_waiting_requests",
+                            len(self.waiting))
+
+    def _note_finish(self, req: Request, preempted: bool = False) -> None:
+        telemetry.inc("ray_tpu_llm_requests_finished_total",
+                      tags={"reason": req.finish_reason or "unknown"})
+        if preempted:
+            telemetry.inc("ray_tpu_llm_preemptions_total")
+
+    def _note_decode(self, wall_s: float, steps: int) -> None:
+        """One decode dispatch ran ``steps`` model steps in ``wall_s``
+        seconds; per-token latency is the per-step wall time."""
+        if steps > 0:
+            telemetry.observe("ray_tpu_llm_decode_token_seconds",
+                              wall_s / steps)
 
     def _bucket_for(self, n: int) -> Optional[int]:
         for b in self.prefill_buckets:
@@ -165,6 +196,7 @@ class InferenceEngine:
                 self.waiting.pop(0)
                 self.running.pop(req.request_id, None)
                 self._admission_finished.append(req)
+                self._note_finish(req)
                 continue
             bucket = self._bucket_for(n)
             if bucket is None:
@@ -173,6 +205,7 @@ class InferenceEngine:
                 self.waiting.pop(0)
                 self.running.pop(req.request_id, None)
                 self._admission_finished.append(req)
+                self._note_finish(req)
                 continue
             n_pages = math.ceil(total / self.page_size)
             if n_pages > self.pool.num_pages - 1:
@@ -183,6 +216,7 @@ class InferenceEngine:
                 self.waiting.pop(0)
                 self.running.pop(req.request_id, None)
                 self._admission_finished.append(req)
+                self._note_finish(req)
                 continue
             pages = self.pool.alloc(n_pages)
             if pages is None:
@@ -193,8 +227,13 @@ class InferenceEngine:
             # Prefill on the padded bucket; returns last logits + K/V.
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :n] = req.prompt_tokens
-            logits, ks, vs = self._prefills[bucket](
-                self.params, jnp.asarray(toks), jnp.asarray(n))
+            with telemetry.profile_span(
+                    "engine_prefill", "llm",
+                    extra={"request_id": req.request_id, "prompt_len": n}):
+                logits, ks, vs = self._prefills[bucket](
+                    self.params, jnp.asarray(toks), jnp.asarray(n))
+            telemetry.inc("ray_tpu_llm_tokens_total", n,
+                          tags={"kind": "prompt"})
             # Scatter prompt K/V into this request's pages: ONE jitted
             # device program for all layers (bucket-static shape; padding
             # positions land in reserved page 0, which no block table
@@ -222,17 +261,22 @@ class InferenceEngine:
             staged.append((req, slot, logits))
 
         if not staged:
+            self._update_gauges()
             return
         self._dev_state = None  # new slots: host mirrors are authoritative
         all_logits = np.asarray(self._jax.numpy.stack(
             [lg for _r, _s, lg in staged]))       # ONE host sync
+        now = time.perf_counter()
         for (req, slot, _lg), logits in zip(staged, all_logits):
             first_tok = self._sample_host(logits, req.params)
             req.output_tokens.append(int(first_tok))
+            telemetry.observe("ray_tpu_llm_ttft_seconds",
+                              max(0.0, now - req.t_submit))
             self.slot_tokens[slot] = first_tok
             self._maybe_finish(req, int(first_tok))
             if req.finished:
                 self._admission_finished.append(req)
+        self._update_gauges()
 
     def _sample_host(self, logits: np.ndarray,
                      params: SamplingParams) -> int:
@@ -259,6 +303,7 @@ class InferenceEngine:
                 self.pool.free(req.pages)
                 req.pages = []
             self.running.pop(req.request_id, None)
+            self._note_finish(req)
 
     def cancel(self, request_id: int) -> None:
         """Abandon a request: free its slot/pages (timeouts, disconnects)."""
@@ -268,13 +313,17 @@ class InferenceEngine:
                 return
             if req in self.waiting:
                 self.waiting.remove(req)
-            if req.slot is not None and self.slot_req[req.slot] is req:
+            preempted = req.slot is not None \
+                and self.slot_req[req.slot] is req
+            if preempted:
                 self.slot_active[req.slot] = False
                 self.slot_req[req.slot] = None
             self.pool.free(req.pages)
             req.pages = []
             req.finished = True
             req.finish_reason = "cancelled"
+            self._note_finish(req, preempted=preempted)
+            self._update_gauges()
 
     # -- stepping -----------------------------------------------------------
 
@@ -296,24 +345,34 @@ class InferenceEngine:
             self._admission_finished.clear()
             if not any(self.slot_active):
                 return finished
-            self._dev_state = None  # per-token path mutates host mirrors
-            logits, self.kv_pages = self._decode(
-                self.params, self.kv_pages,
-                jnp.asarray(self.slot_tokens), jnp.asarray(self.slot_pos),
-                jnp.asarray(self.block_tables),
-                jnp.asarray(self.slot_active))
-            logits = np.asarray(logits)
+            t0 = time.perf_counter()
+            with telemetry.profile_span("engine_step", "llm"):
+                self._dev_state = None  # per-token path mutates mirrors
+                logits, self.kv_pages = self._decode(
+                    self.params, self.kv_pages,
+                    jnp.asarray(self.slot_tokens),
+                    jnp.asarray(self.slot_pos),
+                    jnp.asarray(self.block_tables),
+                    jnp.asarray(self.slot_active))
+                logits = np.asarray(logits)
+            decoded = 0
             for slot in range(self.max_slots):
                 if not self.slot_active[slot]:
                     continue
                 req = self.slot_req[slot]
                 tok = self._sample_host(logits[slot], req.params)
                 req.output_tokens.append(tok)
+                decoded += 1
                 self.slot_pos[slot] += 1
                 self.slot_tokens[slot] = tok
                 self._maybe_finish(req, tok)
                 if req.finished:
                     finished.append(req)
+            self._note_decode(time.perf_counter() - t0, steps=1)
+            if decoded:
+                telemetry.inc("ray_tpu_llm_tokens_total", decoded,
+                              tags={"kind": "decode"})
+            self._update_gauges()
             return finished
 
     def step_chunk(self, max_steps: int = 32) -> List[Request]:
@@ -332,12 +391,31 @@ class InferenceEngine:
             self._admit()
             finished = list(self._admission_finished)
             self._admission_finished.clear()
+            # Clock starts AFTER admission: prefill time is not decode
+            # latency (step() excludes it the same way).
+            t0 = time.perf_counter()
             d = self._dispatch_chunk(max_steps)
         if d is None:
             return finished
         if d == "incompatible":
             return finished + self.step()
-        return finished + self._process_chunk(*d)
+        with telemetry.profile_span("engine_step_chunk", "llm",
+                                    extra={"steps": d[1]}):
+            out = self._process_chunk(*d)
+        self._note_decode(time.perf_counter() - t0, steps=d[1])
+        return finished + out
+
+    def _process_pending(self, pending, t_mark: float) -> List[Request]:
+        """Pipelined-path chunk application with the same telemetry as
+        step_chunk: one timeline span per chunk, and iteration cadence
+        (t_mark -> apply complete, overlap included) as the per-token
+        decode latency."""
+        with telemetry.profile_span("engine_step_chunk", "llm",
+                                    extra={"steps": pending[1],
+                                           "pipelined": True}):
+            out = self._process_chunk(*pending, keep_dev_state=True)
+        self._note_decode(time.perf_counter() - t_mark, steps=pending[1])
+        return out
 
     def _dispatch_chunk(self, max_steps: int):
         """Dispatch one chunk (async — no host sync).  Caller holds the
@@ -413,6 +491,7 @@ class InferenceEngine:
         finished: List[Request] = []
         with self._lock:
             any_finished = False
+            applied = 0
             for slot, req in enumerate(snap):
                 if req is None or req.finished:
                     continue
@@ -421,6 +500,7 @@ class InferenceEngine:
                 for i in range(steps):
                     tok = int(out[i, slot])
                     req.output_tokens.append(tok)
+                    applied += 1
                     self.slot_pos[slot] += 1
                     self.slot_tokens[slot] = tok
                     self._maybe_finish(req, tok)
@@ -432,6 +512,10 @@ class InferenceEngine:
                         break
             if any_finished and not keep_dev_state:
                 self._dev_state = None  # host mirrors changed
+            if applied:
+                telemetry.inc("ray_tpu_llm_tokens_total", applied,
+                              tags={"kind": "decode"})
+            self._update_gauges()
         return finished
 
     def run_pipelined(self, max_steps: int = 64,
@@ -450,6 +534,7 @@ class InferenceEngine:
         page 0.  Returns every finished request."""
         done: List[Request] = []
         pending = None
+        t_mark = time.perf_counter()
         for _ in range(max_chunks):
             d = None
             with self._lock:
@@ -486,15 +571,15 @@ class InferenceEngine:
                     d = self._dispatch_chunk(max_steps)
             if d == "incompatible":
                 if pending is not None:
-                    done.extend(self._process_chunk(
-                        *pending, keep_dev_state=True))
+                    done.extend(self._process_pending(pending, t_mark))
                     pending = None
                 done.extend(self.step_chunk(max_steps))
+                t_mark = time.perf_counter()
                 continue
             if pending is not None:
-                done.extend(self._process_chunk(
-                    *pending, keep_dev_state=True))
+                done.extend(self._process_pending(pending, t_mark))
             pending = d
+            t_mark = time.perf_counter()
             if pending is None:
                 with self._lock:
                     if not self.waiting and not self.slot_active.any():

@@ -415,12 +415,21 @@ class _Router:
     def note_start(self, hexid: str) -> None:
         with self._lock:
             self._inflight[hexid] = self._inflight.get(hexid, 0) + 1
+            # Under the lock: an out-of-order set after release could
+            # leave a stale in-flight count on a quiescent deployment.
+            self._set_ongoing_gauge(sum(self._inflight.values()))
         self._ensure_metrics_thread()
 
     def note_done(self, hexid: str) -> None:
         with self._lock:
             if hexid in self._inflight:
                 self._inflight[hexid] = max(0, self._inflight[hexid] - 1)
+            self._set_ongoing_gauge(sum(self._inflight.values()))
+
+    def _set_ongoing_gauge(self, total: int) -> None:
+        from ..util import telemetry
+        telemetry.set_gauge("ray_tpu_serve_ongoing_requests", total,
+                            tags={"deployment": self.name})
 
     def total_inflight(self) -> int:
         with self._lock:
@@ -502,6 +511,14 @@ class DeploymentHandle:
                                 self._stream)
 
     def remote(self, *args, **kwargs):
+        from ..util import telemetry
+        t_route = time.perf_counter()
+        tags = {"deployment": self._name}
+
+        def _note_latency():
+            telemetry.observe("ray_tpu_serve_request_latency_seconds",
+                              time.perf_counter() - t_route, tags=tags)
+
         router = _router_for(self._name)
         router._refresh()
         # A reconcile may briefly leave zero replicas (all died at once);
@@ -513,11 +530,14 @@ class DeploymentHandle:
             if picked is not None:
                 break
             if time.monotonic() > deadline:
+                telemetry.inc("ray_tpu_serve_request_errors_total",
+                              tags=tags)
                 raise RuntimeError(
                     f"deployment {self._name!r} has no live replicas")
             time.sleep(0.05)
             router._refresh(force=True)
         hexid, replica = picked
+        telemetry.inc("ray_tpu_serve_requests_total", tags=tags)
         router.note_start(hexid)
         if self._model_id is not None:
             router.affinity.note(hexid, self._model_id)
@@ -540,11 +560,13 @@ class DeploymentHandle:
                         yield item_ref
                 finally:
                     router.note_done(hexid)
+                    _note_latency()
             return _stream_refs()
 
         def _done():
             _wait_quiet(ref)
             router.note_done(hexid)
+            _note_latency()
         # Decrement when the result materializes.
         threading.Thread(target=_done, daemon=True).start()
         return ref
@@ -677,11 +699,20 @@ def build_ingress_app():
                              + "\n").encode())
                     await resp.write_eof()
                     return resp
-                ref = handle_.remote(body)
+                try:
+                    ref = handle_.remote(body)
+                except Exception as e:  # noqa: BLE001
+                    # Handle-level failure (e.g. no live replicas):
+                    # remote() already counted it — don't double-count.
+                    return web.json_response({"error": repr(e)},
+                                             status=500)
                 result = await loop.run_in_executor(
                     None, lambda: ray_tpu.get(ref, timeout=300))
                 return web.json_response({"result": result})
             except Exception as e:  # noqa: BLE001
+                from ..util import telemetry
+                telemetry.inc("ray_tpu_serve_request_errors_total",
+                              tags={"deployment": name})
                 return web.json_response({"error": repr(e)}, status=500)
 
     app = web.Application()

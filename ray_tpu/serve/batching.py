@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Callable, List
 
 
@@ -18,6 +19,7 @@ class _Batcher:
         self._lock = threading.Lock()
         self._items: List[Any] = []
         self._events: List[threading.Event] = []
+        self._enqueued: List[float] = []  # perf_counter at submit
         self._results: List[Any] = []
         self._flush_timer: threading.Timer = None  # type: ignore
 
@@ -26,10 +28,11 @@ class _Batcher:
         with self._lock:
             self._items.append(item)
             self._events.append(ev)
+            self._enqueued.append(time.perf_counter())
             idx = len(self._items) - 1
             if len(self._items) >= self.max_batch_size:
-                batch, events = self._take()
-                self._run(instance, batch, events)
+                batch, events, enq = self._take()
+                self._run(instance, batch, events, enq)
             elif self._flush_timer is None:
                 t = threading.Timer(
                     self.timeout, self._flush_due, args=(instance,))
@@ -42,26 +45,42 @@ class _Batcher:
     def _take(self):
         batch, self._items = self._items, []
         events, self._events = self._events, []
+        enq, self._enqueued = self._enqueued, []
         if self._flush_timer is not None:
             self._flush_timer.cancel()
             self._flush_timer = None
-        return batch, events
+        return batch, events, enq
 
     def _flush_due(self, instance):
         with self._lock:
             if not self._items:
                 self._flush_timer = None
                 return
-            batch, events = self._take()
-        self._run_outside(instance, batch, events)
+            batch, events, enq = self._take()
+        self._run_outside(instance, batch, events, enq)
 
-    def _run(self, instance, batch, events):
+    def _run(self, instance, batch, events, enq):
         # Called with lock held for the size-trigger path; do the work
         # outside the lock.
         threading.Thread(target=self._run_outside,
-                         args=(instance, batch, events), daemon=True).start()
+                         args=(instance, batch, events, enq),
+                         daemon=True).start()
 
-    def _run_outside(self, instance, batch, events):
+    def _note_batch(self, batch, enq) -> None:
+        try:
+            from ..util import telemetry
+        except Exception:
+            return
+        tags = {"method": getattr(self.fn, "__name__", "batch")}
+        now = time.perf_counter()
+        for t in enq:
+            telemetry.observe("ray_tpu_serve_queue_wait_seconds",
+                              max(0.0, now - t), tags=tags)
+        telemetry.observe("ray_tpu_serve_batch_size", len(batch),
+                          tags=tags)
+
+    def _run_outside(self, instance, batch, events, enq):
+        self._note_batch(batch, enq)
         try:
             outs = (self.fn(instance, batch) if instance is not None
                     else self.fn(batch))

@@ -230,6 +230,9 @@ class ServeController:
     def _publish(self, state) -> None:
         with state._lock:
             snapshot = list(state.replicas)
+        from ..util import telemetry
+        telemetry.set_gauge("ray_tpu_serve_replicas", len(snapshot),
+                            tags={"deployment": state.deployment.name})
         self.broker.publish(state.deployment.name, snapshot)
         # Cross-process push: versioned replica-set snapshot in the
         # cluster KV (reference: LongPollHost snapshots keyed by
@@ -314,6 +317,9 @@ class ServeControllerActor:
 
     @staticmethod
     def _clear_kv(name: str) -> None:
+        from ..util import telemetry
+        telemetry.set_gauge("ray_tpu_serve_replicas", 0,
+                            tags={"deployment": name})
         try:
             from .._private.api import _control
             _control("kv_del", REPLICA_KV_PREFIX + name)

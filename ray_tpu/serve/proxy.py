@@ -99,12 +99,21 @@ class _ProxyImpl:
                 streaming = service_name.endswith(".stream")
 
                 def unary(request: bytes, ctx):
+                    ref = None
                     try:
                         body = json.loads(request or b"{}")
                         h = serve_api.get_deployment_handle(deployment)
-                        result = ray_tpu.get(h.remote(body), timeout=300)
+                        # remote() counts its own errors (no live
+                        # replicas) — only count past that point.
+                        ref = h.remote(body)
+                        result = ray_tpu.get(ref, timeout=300)
                         return json.dumps({"result": result}).encode()
                     except Exception as e:  # noqa: BLE001
+                        if ref is not None:
+                            from ..util import telemetry
+                            telemetry.inc(
+                                "ray_tpu_serve_request_errors_total",
+                                tags={"deployment": deployment})
                         ctx.set_code(grpc.StatusCode.INTERNAL)
                         ctx.set_details(repr(e))
                         return b"{}"

@@ -58,6 +58,7 @@ def save_pytree(tree: Any, path: str, use_orbax: bool = False) -> None:
     """Device arrays -> host numpy -> disk."""
     import jax
     import numpy as np
+    t0 = time.perf_counter()
     host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
     if use_orbax:
         import orbax.checkpoint as ocp
@@ -66,15 +67,30 @@ def save_pytree(tree: Any, path: str, use_orbax: bool = False) -> None:
     else:
         with open(os.path.join(path, "pytree.pkl"), "wb") as f:
             pickle.dump(host, f, protocol=5)
+    _note_ckpt("save", time.perf_counter() - t0)
 
 
 def load_pytree(path: str, use_orbax: bool = False) -> Any:
+    t0 = time.perf_counter()
     if use_orbax:
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
-        return ckptr.restore(os.path.join(path, "orbax"))
-    with open(os.path.join(path, "pytree.pkl"), "rb") as f:
-        return pickle.load(f)
+        out = ckptr.restore(os.path.join(path, "orbax"))
+    else:
+        with open(os.path.join(path, "pytree.pkl"), "rb") as f:
+            out = pickle.load(f)
+    _note_ckpt("restore", time.perf_counter() - t0)
+    return out
+
+
+def _note_ckpt(op: str, seconds: float) -> None:
+    try:
+        from ..util import telemetry
+    except Exception:
+        return
+    telemetry.observe("ray_tpu_train_checkpoint_seconds", seconds,
+                      tags={"op": op})
+    telemetry.note_checkpoint_seconds(seconds)
 
 
 class CheckpointManager:

@@ -39,6 +39,8 @@ class TrainContext:
         # failure-recovery restarts (seq restarts at 0 in a fresh worker).
         import uuid as _uuid
         self._incarnation = _uuid.uuid4().hex[:8]
+        # Telemetry: report-to-report interval = one observed step.
+        self._last_report_wall = time.time()
 
     def get_world_rank(self) -> int:
         return self._rank
@@ -76,14 +78,46 @@ def report(metrics: Dict[str, Any],
     ctx = get_context()
     ctx._report_seq += 1
     from .._private.api import _control
+    from ..util import telemetry
+    now = time.time()
+    ckpt_s = telemetry.pop_checkpoint_seconds()
     payload = {
         "metrics": dict(metrics),
         "rank": ctx.get_world_rank(),
         "seq": ctx._report_seq,
-        "time": time.time(),
+        "time": now,
         "checkpoint_dir": checkpoint.path if checkpoint else None,
+        # Checkpoint seconds inside this report window (goodput
+        # reattribution at the controller).
+        "ckpt_seconds": ckpt_s,
     }
+    _note_step(ctx, now, metrics)
     _control("kv_put",
              f"train/{ctx.run_id}/report/{ctx.get_world_rank()}/"
              f"{ctx._incarnation}/{ctx._report_seq}",
              pickle.dumps(payload))
+
+
+def _note_step(ctx: "TrainContext", now: float,
+               metrics: Dict[str, Any]) -> None:
+    """Built-in train metrics from the report stream: each rank-0
+    report-to-report interval is one step (histogram + timeline span);
+    token counts ride along when the user metrics carry a tokens key."""
+    from ..util import telemetry
+    telemetry.inc("ray_tpu_train_reports_total")
+    for key in ("tokens", "num_tokens", "tokens_per_step"):
+        v = metrics.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            telemetry.inc("ray_tpu_train_tokens_total", v)
+            break
+    # seq 1 measures from context construction — that window is
+    # init/JIT compile, not a step (the controller's goodput tracker
+    # accounts it as "init"); report-to-report starts at seq 2.
+    if ctx.get_world_rank() == 0 and ctx._report_seq > 1:
+        dur = now - ctx._last_report_wall
+        if dur > 0:
+            telemetry.observe("ray_tpu_train_step_seconds", dur)
+            telemetry._emit_span(
+                "train_step", "train", ctx._last_report_wall, now,
+                extra={"seq": ctx._report_seq, "run_id": ctx.run_id})
+    ctx._last_report_wall = now

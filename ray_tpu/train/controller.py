@@ -128,6 +128,11 @@ class TrainController:
             num_to_keep=run_config.checkpoint_config.num_to_keep)
         self._reports: List[Dict[str, Any]] = []
         self._seen_report_keys: set = set()
+        # Goodput accounting (reference analog: MegaScale-style wall-time
+        # partitioning): init/step/checkpoint/restart/idle phases; the
+        # ratio lands on the ray_tpu_train_goodput_ratio gauge live.
+        from ..util.telemetry import GoodputTracker
+        self.goodput = GoodputTracker(initial_phase="init")
 
     # -- worker group -------------------------------------------------------
 
@@ -215,9 +220,14 @@ class TrainController:
                 continue
             payload = pickle.loads(data)
             self._reports.append(payload)
-            if payload["rank"] == 0 and payload.get("checkpoint_dir"):
-                self.manager.register(payload["checkpoint_dir"],
-                                      payload["metrics"])
+            if payload["rank"] == 0:
+                # Worker-measured checkpoint time happened inside what
+                # the driver observes as the "step" phase: reattribute.
+                self.goodput.reattribute(
+                    "checkpoint", payload.get("ckpt_seconds", 0.0) or 0.0)
+                if payload.get("checkpoint_dir"):
+                    self.manager.register(payload["checkpoint_dir"],
+                                          payload["metrics"])
 
     # -- main loop ----------------------------------------------------------
 
@@ -231,6 +241,11 @@ class TrainController:
         carry_target: Optional[int] = None
         self.world_size_history: List[int] = []
         while True:
+            # First group formation is "init"; every re-formation after a
+            # failure is "restart" overhead (resizes count as restart too:
+            # the world re-forms and resumes from the checkpoint).
+            self.goodput.enter(
+                "init" if not self.world_size_history else "restart")
             decision = self.policy.initial_decision(prefer=carry_target)
             carry_target = None
             world = decision.num_workers
@@ -246,6 +261,8 @@ class TrainController:
             group.run_refs = [
                 w.run.remote(fn_blob, self.train_loop_config, ctx_info)
                 for w in group.workers]
+            self.goodput.enter("step")
+            t_step = time.monotonic()
             error = None
             resize_to: Optional[int] = None
             last_elastic_check = time.monotonic()
@@ -285,7 +302,16 @@ class TrainController:
                         if error is None:
                             resize_to = d.num_workers
                         pending = []
+            # Drain reports while still in the "step" phase so their
+            # ckpt_seconds reattribution has step time to pull from.
             self._poll_reports()
+            if error is not None:
+                # This incarnation's step time produced no surviving work
+                # (it restarts from the last checkpoint): badput, not
+                # goodput (MegaScale-style lost-work accounting).
+                self.goodput.reattribute(
+                    "lost", time.monotonic() - t_step)
+            self.goodput.enter("idle")
             self._teardown_group(group)
             if resize_to is not None:
                 carry_target = resize_to
@@ -295,6 +321,8 @@ class TrainController:
             failures += 1
             if failures > self.run_config.failure_config.max_failures:
                 break
+            from ..util import telemetry
+            telemetry.inc("ray_tpu_train_worker_restarts_total", world)
             # Restart: fresh group resumes from the latest committed
             # checkpoint (reference: controller failure policy ->
             # group teardown -> re-create -> resume, SURVEY §3.4 step 6).
@@ -303,6 +331,7 @@ class TrainController:
             # under-sizing on the first partial fit.
             carry_target = world
 
+        self.goodput.finish()
         rank0 = sorted((r for r in self._reports if r["rank"] == 0),
                        key=lambda r: r["time"])
         last_metrics = rank0[-1]["metrics"] if rank0 else {}
@@ -313,4 +342,5 @@ class TrainController:
             error=error,
             all_reports=self._reports,
             num_failures=failures,
-            world_size_history=self.world_size_history)
+            world_size_history=self.world_size_history,
+            goodput=self.goodput.summary())

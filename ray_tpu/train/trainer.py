@@ -85,6 +85,9 @@ class Result:
     # World size of each group incarnation (len > 1 = elastic resizes /
     # failure restarts happened).
     world_size_history: List[int] = field(default_factory=list)
+    # Goodput accounting for this run: {goodput_ratio, total_s,
+    # productive_s, phases_s} (telemetry.GoodputTracker.summary()).
+    goodput: Optional[Dict[str, Any]] = None
 
 
 class JaxTrainer:
@@ -106,8 +109,14 @@ class JaxTrainer:
 
     def fit(self) -> Result:
         import ray_tpu
+        from ..util import telemetry
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         controller = TrainController(
             self._train_fn, self._config, self._scaling, self._run_config)
-        return controller.run()
+        with telemetry.profile_span(
+                "train_fit", "train",
+                extra={"experiment": self._run_config.name,
+                       "num_workers": self._scaling.num_workers}):
+            result = controller.run()
+        return result

@@ -24,6 +24,9 @@ DEFAULT_HISTOGRAM_BOUNDARIES = [
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
 _flusher_started = False
+# Set by every record, cleared by flush: lets the per-task flush hook
+# skip the push entirely when nothing changed since the last one.
+_dirty = False
 
 
 def _tags_key(tags: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -104,11 +107,13 @@ class Counter(Metric):
 
     def inc(self, value: float = 1.0,
             tags: Optional[Dict[str, str]] = None) -> None:
+        global _dirty
         if value <= 0:
             raise ValueError("Counter.inc() value must be positive")
         key = _tags_key(self._merge_tags(tags))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
+        _dirty = True
 
     def _samples(self):
         return [(self._name, dict(k), v) for k, v in self._values.items()]
@@ -118,9 +123,11 @@ class Gauge(Metric):
     metric_type = "gauge"
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        global _dirty
         key = _tags_key(self._merge_tags(tags))
         with self._lock:
             self._values[key] = float(value)
+        _dirty = True
 
     def _samples(self):
         return [(self._name, dict(k), v) for k, v in self._values.items()]
@@ -161,6 +168,8 @@ class Histogram(Metric):
             state["buckets"][idx] += 1
             state["sum"] += value
             state["count"] += 1
+        global _dirty
+        _dirty = True
 
     def _samples(self):
         out = []
@@ -192,16 +201,47 @@ def local_snapshots() -> List[Dict[str, Any]]:
 def flush() -> None:
     """Push this process's metrics to the driver (no-op on the driver: its
     registry is read directly)."""
+    global _dirty
     from ray_tpu._private import runtime as rt_mod
     rt = rt_mod.current_runtime()
     if rt is None or rt_mod.driver_runtime() is rt:
         return
     source = getattr(rt, "worker_id", None)
     source_id = source.hex() if source is not None else "unknown"
+    _dirty = False
     try:
         rt.control("push_metrics", source_id, local_snapshots())
     except Exception:
         pass  # driver shutting down; metrics are best-effort
+
+
+def flush_on_task_done() -> None:
+    """Deterministic flush at worker task completion/teardown.
+
+    The periodic flusher wakes every 2 s, so metrics a task records in
+    its final moments would otherwise be lost if the worker (or driver
+    read) wins the race.  Called by the worker loop just BEFORE the
+    TaskDone frame is queued: the push is a fire-and-forget control frame
+    (request id 0 is never in the pending-reply table, so the head's
+    reply is dropped) sharing the FIFO outbox with TaskDone — by the time
+    the caller observes the task finished, its metrics are at the driver.
+    Skips the push when nothing was recorded since the last flush, so
+    metric-free tasks pay only a bool check."""
+    global _dirty
+    if not _dirty:
+        return
+    from ray_tpu._private import runtime as rt_mod
+    rt = rt_mod.current_runtime()
+    if rt is None or rt_mod.driver_runtime() is rt \
+            or not hasattr(rt, "send") or not hasattr(rt, "worker_id"):
+        return
+    _dirty = False
+    try:
+        from ray_tpu._private.protocol import RpcCall
+        rt.send(RpcCall(0, rt.worker_id, "push_metrics",
+                        (rt.worker_id.hex(), local_snapshots()), {}))
+    except Exception:
+        _dirty = True  # next completion retries
 
 
 def _ensure_flusher() -> None:
@@ -315,17 +355,35 @@ def start_metrics_server(port: int = 0):
         def log_message(self, *a):
             pass
 
+    stop_metrics_server()  # a leftover server would serve the old registry
     _server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
     threading.Thread(target=_server.serve_forever, daemon=True,
                      name="ray_tpu-metrics-http").start()
     return _server.server_address[1]
 
 
+def stop_metrics_server() -> None:
+    """Shut down the scrape server started by start_metrics_server()
+    (closes the listening socket and stops its thread)."""
+    global _server
+    srv, _server = _server, None
+    if srv is not None:
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except Exception:
+            pass
+
+
 def _reset_for_tests() -> None:
-    global _flusher_started
+    global _flusher_started, _dirty
+    stop_metrics_server()  # don't leak a ThreadingHTTPServer per test
     with _registry_lock:
         _registry.clear()
     _flusher_started = False
+    _dirty = False
+    from . import telemetry
+    telemetry._reset_for_tests()
 
 
 def export_otlp_json(path: str) -> str:

@@ -1,0 +1,447 @@
+"""Built-in system telemetry: the canonical catalog of framework metrics.
+
+Reference: the dashboard-agent's built-in metric export
+(python/ray/_private/metrics_agent.py — tasks, serve request latency,
+autoscaler state — and src/ray/observability/open_telemetry_metric_recorder.h).
+User code defines its own metrics through ``util/metrics.py``; the
+framework's OWN hot paths (serve routing, the LLM engine, the train
+controller, the data executor) record through this module instead, so a
+single ``GET /metrics`` scrape or ``export_otlp_json`` carries both.
+
+Three pieces:
+
+* ``CATALOG`` — every built-in metric, named ``ray_tpu_<subsystem>_<what>``,
+  with type/description/tags declared in ONE place.  Instrumentation sites
+  call ``counter(name)`` / ``gauge(name)`` / ``histogram(name)``, which
+  lazily instantiate against the catalog — a typo'd or undeclared name
+  raises instead of silently minting a new series
+  (tests/test_telemetry_catalog.py locks the naming scheme down).
+* ``profile_span(name, category)`` — a cheap span recorder feeding the
+  chrome-trace timeline buffer (``_private/events.py``).  On the driver
+  it is a direct buffer append; in a worker it is a FIRE-AND-FORGET
+  control frame (no reply round-trip — safe on per-decode-step hot
+  paths); with no runtime at all it is a no-op, so library code (the
+  inference engine under bench.py) can stay instrumented unconditionally.
+* ``GoodputTracker`` — partitions a training run's wall time into
+  productive-step vs init/checkpoint/restart/idle (MegaScale-style
+  goodput accounting) and exposes ``ray_tpu_train_goodput_ratio``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from . import metrics as _metrics
+
+# Bucket sets tuned per family: latencies are sub-second-centric; batch
+# sizes / step times are coarser.
+_LATENCY_BUCKETS = [0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
+_SIZE_BUCKETS = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0]
+_STEP_BUCKETS = [0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0,
+                 120.0, 300.0, 600.0]
+
+#: name -> {"type", "description", "tag_keys", "boundaries"?}
+CATALOG: Dict[str, Dict[str, Any]] = {
+    # -- serve -------------------------------------------------------------
+    "ray_tpu_serve_requests_total": {
+        "type": "counter", "tag_keys": ("deployment",),
+        "description": "Requests routed to a deployment replica."},
+    "ray_tpu_serve_request_errors_total": {
+        "type": "counter", "tag_keys": ("deployment",),
+        "description": "Requests that raised at the ingress/handle layer."},
+    "ray_tpu_serve_request_latency_seconds": {
+        "type": "histogram", "tag_keys": ("deployment",),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "End-to-end handle request latency (route -> "
+                       "result materialized)."},
+    "ray_tpu_serve_queue_wait_seconds": {
+        "type": "histogram", "tag_keys": ("method",),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Time a @serve.batch item waited in the queue "
+                       "before its batch started executing."},
+    "ray_tpu_serve_batch_size": {
+        "type": "histogram", "tag_keys": ("method",),
+        "boundaries": _SIZE_BUCKETS,
+        "description": "Items per executed @serve.batch batch."},
+    "ray_tpu_serve_replicas": {
+        "type": "gauge", "tag_keys": ("deployment",),
+        "description": "Live replica count per deployment (controller "
+                       "view)."},
+    "ray_tpu_serve_ongoing_requests": {
+        "type": "gauge", "tag_keys": ("deployment",),
+        "description": "This process's in-flight requests per deployment "
+                       "(router view)."},
+    # -- llm ---------------------------------------------------------------
+    "ray_tpu_llm_ttft_seconds": {
+        "type": "histogram", "tag_keys": (),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Time to first token: request add -> first output "
+                       "token sampled (includes queueing + prefill)."},
+    "ray_tpu_llm_decode_token_seconds": {
+        "type": "histogram", "tag_keys": (),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Per-token decode latency (batched step wall time; "
+                       "chunked steps attribute wall/steps per token)."},
+    "ray_tpu_llm_tokens_total": {
+        "type": "counter", "tag_keys": ("kind",),
+        "description": "Tokens processed by the engine "
+                       "(kind=prompt|decode)."},
+    "ray_tpu_llm_kv_page_occupancy": {
+        "type": "gauge", "tag_keys": (),
+        "description": "Fraction of KV-cache pages allocated (0..1)."},
+    "ray_tpu_llm_active_slots": {
+        "type": "gauge", "tag_keys": (),
+        "description": "Decode slots with a running request."},
+    "ray_tpu_llm_requests_finished_total": {
+        "type": "counter", "tag_keys": ("reason",),
+        "description": "Engine requests finished, by finish_reason "
+                       "(stop|length|prompt_too_long|"
+                       "kv_capacity_exceeded|cancelled)."},
+    "ray_tpu_llm_preemptions_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Requests evicted mid-flight (cancel/timeout "
+                       "releasing an occupied slot)."},
+    "ray_tpu_llm_waiting_requests": {
+        "type": "gauge", "tag_keys": (),
+        "description": "Requests queued for admission (KV/slot "
+                       "backpressure depth)."},
+    # -- train -------------------------------------------------------------
+    "ray_tpu_train_step_seconds": {
+        "type": "histogram", "tag_keys": (),
+        "boundaries": _STEP_BUCKETS,
+        "description": "Wall time between consecutive rank-0 "
+                       "train.report() calls (one reporting step)."},
+    "ray_tpu_train_tokens_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Training tokens, from report() metrics carrying "
+                       "a tokens/num_tokens/tokens_per_step key."},
+    "ray_tpu_train_reports_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "train.report() calls across all ranks."},
+    "ray_tpu_train_checkpoint_seconds": {
+        "type": "histogram", "tag_keys": ("op",),
+        "boundaries": _STEP_BUCKETS,
+        "description": "Checkpoint pytree save/restore duration "
+                       "(op=save|restore)."},
+    "ray_tpu_train_worker_restarts_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Train workers torn down and restarted after a "
+                       "failure."},
+    "ray_tpu_train_goodput_ratio": {
+        "type": "gauge", "tag_keys": (),
+        "description": "Productive-step wall time over total run wall "
+                       "time (goodput accounting; see GoodputTracker)."},
+    # -- data --------------------------------------------------------------
+    "ray_tpu_data_block_seconds": {
+        "type": "histogram", "tag_keys": ("operator",),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Per-block processing time in the streaming "
+                       "executor (operator=map|reduce)."},
+    "ray_tpu_data_rows_total": {
+        "type": "counter", "tag_keys": ("operator",),
+        "description": "Rows produced by data-pipeline operators."},
+    "ray_tpu_data_blocks_total": {
+        "type": "counter", "tag_keys": ("operator",),
+        "description": "Blocks processed by data-pipeline operators."},
+}
+
+_instances_lock = threading.Lock()
+_instances: Dict[str, _metrics.Metric] = {}
+
+
+def _get(name: str, expect_type: str) -> _metrics.Metric:
+    spec = CATALOG.get(name)
+    if spec is None:
+        raise KeyError(f"{name!r} is not in the built-in telemetry catalog")
+    if spec["type"] != expect_type:
+        raise TypeError(f"{name!r} is a {spec['type']}, not a {expect_type}")
+    inst = _instances.get(name)
+    if inst is not None:
+        return inst
+    with _instances_lock:
+        inst = _instances.get(name)
+        if inst is None:
+            if spec["type"] == "counter":
+                inst = _metrics.Counter(name, spec["description"],
+                                        tag_keys=spec["tag_keys"])
+            elif spec["type"] == "gauge":
+                inst = _metrics.Gauge(name, spec["description"],
+                                      tag_keys=spec["tag_keys"])
+            else:
+                inst = _metrics.Histogram(name, spec["description"],
+                                          boundaries=spec.get("boundaries"),
+                                          tag_keys=spec["tag_keys"])
+            _instances[name] = inst
+    return inst
+
+
+def counter(name: str) -> _metrics.Counter:
+    return _get(name, "counter")  # type: ignore[return-value]
+
+
+def gauge(name: str) -> _metrics.Gauge:
+    return _get(name, "gauge")  # type: ignore[return-value]
+
+
+def histogram(name: str) -> _metrics.Histogram:
+    return _get(name, "histogram")  # type: ignore[return-value]
+
+
+# Exception-safe record helpers: telemetry is never allowed to fail the
+# instrumented path (e.g. a user metric squatting on a catalog name makes
+# instantiation raise), so framework call sites use these instead of
+# hand-rolling try/except around every counter/gauge/histogram call.
+
+def inc(name: str, value: float = 1.0,
+        tags: Optional[Dict[str, str]] = None) -> None:
+    try:
+        counter(name).inc(value, tags=tags)
+    except Exception:
+        pass
+
+
+def observe(name: str, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+    try:
+        histogram(name).observe(value, tags=tags)
+    except Exception:
+        pass
+
+
+def set_gauge(name: str, value: float,
+              tags: Optional[Dict[str, str]] = None) -> None:
+    try:
+        gauge(name).set(value, tags=tags)
+    except Exception:
+        pass
+
+
+def _reset_for_tests() -> None:
+    """Drop cached instances (called from metrics._reset_for_tests: the
+    registry they were registered in is being cleared, and a stale cached
+    instance would record into an orphaned state dict)."""
+    global _goodput_latest
+    with _instances_lock:
+        _instances.clear()
+    _goodput_latest = None
+
+
+# -- profile spans ---------------------------------------------------------
+
+
+def _emit_span(name: str, category: str, start_s: float, end_s: float,
+               extra: Optional[Dict[str, Any]] = None) -> None:
+    """Record one finished span into the driver's timeline buffer.
+
+    Driver: direct append.  Worker: fire-and-forget control frame (request
+    id 0 is never in the pending-reply table, so the head's reply is
+    dropped harmlessly) — no round-trip on hot paths.  No runtime: no-op.
+    """
+    from ray_tpu._private import runtime as rtmod
+    rt = rtmod.current_runtime()
+    if rt is None:
+        return
+    pid = category
+    tid = f"pid:{os.getpid()}"
+    try:
+        if hasattr(rt, "ctl_add_profile_span"):
+            rt.ctl_add_profile_span(name, category, start_s, end_s,
+                                    pid, tid, extra)
+        elif hasattr(rt, "send") and hasattr(rt, "worker_id"):
+            from ray_tpu._private.protocol import RpcCall
+            rt.send(RpcCall(0, rt.worker_id, "add_profile_span",
+                            (name, category, start_s, end_s, pid, tid,
+                             extra), {}))
+        elif hasattr(rt, "control"):
+            rt.control("add_profile_span", name, category, start_s, end_s,
+                       pid, tid, extra)
+    except Exception:
+        pass  # telemetry is never allowed to fail the instrumented path
+
+
+class profile_span:
+    """Cheap system-span context manager for framework hot paths.
+
+    Unlike ``util.state.profile_span`` (the user API, which requires a
+    runtime and does a blocking control call), this one no-ops without a
+    runtime and never waits on a reply — safe inside the engine decode
+    loop or a bench process that never called ``ray_tpu.init()``.
+    """
+
+    __slots__ = ("name", "category", "extra", "_start")
+
+    def __init__(self, name: str, category: str = "system",
+                 extra: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.category = category
+        self.extra = extra
+
+    def __enter__(self) -> "profile_span":
+        self._start = time.time()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _emit_span(self.name, self.category, self._start, time.time(),
+                   self.extra)
+        return False
+
+
+# -- goodput accounting ----------------------------------------------------
+
+_goodput_latest: Optional["GoodputTracker"] = None
+
+# Checkpoint seconds accrued in THIS process since the last report():
+# save_pytree notes them, train._context.report() pops them into the
+# report payload, and the driver-side GoodputTracker reattributes that
+# slice of the observed "step" window to the "checkpoint" phase.
+_pending_ckpt_lock = threading.Lock()
+_pending_ckpt_s = 0.0
+
+
+def note_checkpoint_seconds(seconds: float) -> None:
+    global _pending_ckpt_s
+    if seconds > 0:
+        with _pending_ckpt_lock:
+            _pending_ckpt_s += seconds
+
+
+def pop_checkpoint_seconds() -> float:
+    global _pending_ckpt_s
+    with _pending_ckpt_lock:
+        s, _pending_ckpt_s = _pending_ckpt_s, 0.0
+    return s
+
+
+class GoodputTracker:
+    """Partitions wall time into named phases; goodput = productive/total.
+
+    The productive phase is ``"step"``; everything else (init, restart,
+    checkpoint, idle, ...) is overhead.  ``enter(phase)`` switches phase;
+    ``reattribute(phase, seconds)`` moves already-elapsed seconds out of
+    the current phase (used for worker-reported checkpoint time that
+    happened inside a driver-observed "step" window).  Each transition
+    refreshes the ``ray_tpu_train_goodput_ratio`` gauge, so the scrape
+    endpoint shows live goodput mid-run (MegaScale-style accounting:
+    at 10k-chip scale the difference between 0.95 and 0.85 is a
+    thousand wasted chips)."""
+
+    PRODUCTIVE = "step"
+
+    def __init__(self, initial_phase: str = "init",
+                 update_gauge: bool = True):
+        global _goodput_latest
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._phase = initial_phase
+        self._since = self._t0
+        self._finished = False
+        self.seconds: Dict[str, float] = {}
+        self._update_gauge = update_gauge
+        _goodput_latest = self
+
+    def _accumulate_locked(self, now: float) -> None:
+        dt = max(0.0, now - self._since)
+        self.seconds[self._phase] = self.seconds.get(self._phase, 0.0) + dt
+        self._since = now
+
+    def enter(self, phase: str) -> None:
+        with self._lock:
+            if self._finished:
+                return
+            now = time.monotonic()
+            self._accumulate_locked(now)
+            self._phase = phase
+        self._refresh_gauge()
+
+    def reattribute(self, phase: str, seconds: float) -> None:
+        """Move ``seconds`` of already-elapsed current-phase time into
+        ``phase`` (clamped to what the current phase has actually
+        accrued, including the open interval)."""
+        if seconds <= 0 or phase == self._phase:
+            return
+        with self._lock:
+            if self._finished:
+                return
+            self._accumulate_locked(time.monotonic())
+            avail = self.seconds.get(self._phase, 0.0)
+            moved = min(seconds, avail)
+            self.seconds[self._phase] = avail - moved
+            self.seconds[phase] = self.seconds.get(phase, 0.0) + moved
+        self._refresh_gauge()
+
+    def finish(self) -> Dict[str, Any]:
+        with self._lock:
+            if not self._finished:
+                self._accumulate_locked(time.monotonic())
+                self._finished = True
+        self._refresh_gauge()
+        return self.summary()
+
+    def ratio(self) -> float:
+        with self._lock:
+            now = time.monotonic()
+            open_dt = 0.0 if self._finished else max(0.0, now - self._since)
+            total = sum(self.seconds.values()) + open_dt
+            productive = self.seconds.get(self.PRODUCTIVE, 0.0) + (
+                open_dt if self._phase == self.PRODUCTIVE else 0.0)
+        if total <= 0:
+            return 0.0
+        return productive / total
+
+    def _refresh_gauge(self) -> None:
+        if self._update_gauge:
+            set_gauge("ray_tpu_train_goodput_ratio", self.ratio())
+
+    def summary(self) -> Dict[str, Any]:
+        r = self.ratio()
+        with self._lock:
+            phases = dict(self.seconds)
+            if not self._finished:
+                phases[self._phase] = phases.get(self._phase, 0.0) + max(
+                    0.0, time.monotonic() - self._since)
+        total = sum(phases.values())
+        return {
+            "goodput_ratio": r,
+            "total_s": total,
+            "productive_s": phases.get(self.PRODUCTIVE, 0.0),
+            "phases_s": phases,
+        }
+
+
+def goodput_summary() -> Optional[Dict[str, Any]]:
+    """The most recent GoodputTracker's summary (None before any run)."""
+    return _goodput_latest.summary() if _goodput_latest is not None else None
+
+
+# -- dashboard summary -----------------------------------------------------
+
+
+def summary() -> Dict[str, Any]:
+    """Cluster-merged built-in metrics grouped by subsystem, for
+    ``GET /api/metrics/summary``.  Counters/gauges flatten to scalar
+    samples; histograms report count/sum/mean per tag set."""
+    by_name, acc = _metrics._aggregate_snapshots()
+    subsystems: Dict[str, Dict[str, Any]] = {}
+    for name, spec in CATALOG.items():
+        subsystem = name.split("_")[2]  # ray_tpu_<subsystem>_...
+        if spec["type"] == "histogram":
+            sums = acc.get(name + "_sum", {})
+            counts = acc.get(name + "_count", {})
+            samples = []
+            for key, (tags, total) in sorted(sums.items()):
+                n = counts.get(key, (tags, 0.0))[1]
+                samples.append({"tags": tags, "count": n, "sum": total,
+                                "mean": (total / n) if n else 0.0})
+        else:
+            samples = [{"tags": tags, "value": v}
+                       for _k, (tags, v) in sorted(acc.get(name, {}).items())]
+        if not samples:
+            continue
+        subsystems.setdefault(subsystem, {})[name] = {
+            "type": spec["type"], "description": spec["description"],
+            "samples": samples}
+    return {"subsystems": subsystems, "goodput": goodput_summary()}

@@ -167,6 +167,32 @@ class TestMetrics:
             f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
         assert "test_http_gauge 1.5" in body
 
+    def test_stop_metrics_server_releases_listener(self, ray_start):
+        metrics_mod.Gauge("test_stop_gauge").set(2.0)
+        port = metrics_mod.start_metrics_server(0)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "test_stop_gauge 2.0" in body
+        metrics_mod.stop_metrics_server()
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=2)
+        # Idempotent (and safe from the reset path).
+        metrics_mod.stop_metrics_server()
+
+    def test_task_final_metrics_flush_deterministic(self, ray_start):
+        """Metrics recorded just before a task finishes are at the driver
+        the moment the task is observed complete — no 2 s flusher race,
+        no explicit flush() in the task."""
+        @ray_tpu.remote
+        def last_gasp():
+            from ray_tpu.util import metrics
+            metrics.Counter("test_last_gasp_total").inc(3.0)
+            return True  # exits well inside the flusher's 2 s window
+
+        assert ray_tpu.get(last_gasp.remote(), timeout=60)
+        assert "test_last_gasp_total 3.0" in metrics_mod.prometheus_text()
+
     def test_worker_metrics_flow_to_driver(self, ray_start):
         @ray_tpu.remote
         def work():
@@ -235,6 +261,59 @@ class TestTracing:
         # The tree renders with every span on its own line.
         txt = tracing.render_trace(spans[0]["trace_id"])
         assert txt.count("- ") >= 4
+
+    def test_actor_method_cascade_shares_trace(self, ray_start_isolated):
+        """Actor-method calls propagate the W3C context exactly like plain
+        tasks: driver -> actor method -> nested task is ONE trace tree."""
+        import ray_tpu
+        from ray_tpu.util import tracing
+
+        @ray_tpu.remote
+        def leaf(x):
+            return x + 1
+
+        @ray_tpu.remote
+        class Middle:
+            def call(self, x):
+                return ray_tpu.get(leaf.remote(x)) * 2
+
+        tracing.enable()
+        try:
+            h = Middle.remote()
+            assert ray_tpu.get(h.call.remote(1), timeout=60) == 4
+        finally:
+            tracing.disable()
+
+        import time as _t
+        deadline = _t.monotonic() + 20
+        spans = []
+        while _t.monotonic() < deadline:
+            ids = tracing.list_traces()
+            for tid in ids:
+                got = tracing.get_trace(tid)
+                if any("Middle.call" in s["name"] for s in got):
+                    spans = got
+            if len(spans) >= 4:
+                break
+            _t.sleep(0.2)
+        names = [s["name"] for s in spans]
+        assert "submit Middle.call" in names, names
+        assert "execute Middle.call" in names
+        assert "submit leaf" in names and "execute leaf" in names
+        # The whole cascade shares one trace id.
+        assert len({s["trace_id"] for s in spans}) == 1
+        exec_call = next(s for s in spans
+                         if s["name"] == "execute Middle.call")
+        sub_call = next(s for s in spans
+                        if s["name"] == "submit Middle.call")
+        sub_leaf = next(s for s in spans if s["name"] == "submit leaf")
+        exec_leaf = next(s for s in spans if s["name"] == "execute leaf")
+        # Nested submit inside the actor method chains to its execute
+        # span; the method execute chains to the driver's submit.
+        assert sub_leaf["parent_span_id"] == exec_call["span_id"]
+        assert exec_call["parent_span_id"] == sub_call["span_id"]
+        assert exec_leaf["parent_span_id"] == sub_leaf["span_id"]
+        assert sub_call["parent_span_id"] is None
 
     def test_otlp_json_export(self, ray_start_isolated, tmp_path):
         import json
