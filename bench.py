@@ -222,17 +222,90 @@ def bench_decode(params, cfg, *, max_slots: int, prompt_len: int,
             "p99_ms": float(np.percentile(lat, 99))}
 
 
+def bench_watchdog_overhead(steps: int = 30,
+                            step_sleep_s: float = 0.02) -> None:
+    """Train steps/s with the hang/straggler watchdog on vs. off.
+
+    The watchdog is a driver-side monitor thread fed by the report
+    stream, so its cost on the step path should be ~zero; this measures
+    it honestly (report-to-report throughput, excluding worker startup)
+    and records the result in BENCH_diagnostics.json so a regression
+    that puts work on the hot path is caught by the perf trajectory.
+    """
+    import shutil
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.train import (JaxTrainer, RunConfig, ScalingConfig,
+                               WatchdogConfig)
+
+    def fn(config):
+        import time as _t
+
+        import ray_tpu.train as train
+        for _ in range(config["steps"]):
+            _t.sleep(config["sleep"])
+            train.report({"loss": 1.0})
+
+    ray_tpu.init(num_cpus=2)
+    doc: dict = {"steps": steps, "step_sleep_s": step_sleep_s}
+    try:
+        for label, wd in (
+                ("watchdog_off", WatchdogConfig(enabled=False)),
+                ("watchdog_on", WatchdogConfig(poll_interval_s=0.2,
+                                               hang_deadline_s=30.0))):
+            store = tempfile.mkdtemp(prefix="bench_wd_")
+            try:
+                res = JaxTrainer(
+                    fn,
+                    train_loop_config={"steps": steps,
+                                       "sleep": step_sleep_s},
+                    scaling_config=ScalingConfig(num_workers=1),
+                    run_config=RunConfig(name=f"bench_{label}",
+                                         storage_path=store,
+                                         watchdog=wd)).fit()
+                if res.error is not None:
+                    raise res.error
+                times = sorted(r["time"] for r in res.all_reports
+                               if r["rank"] == 0)
+                span = times[-1] - times[0]
+                doc[label] = {
+                    "steps_per_s": (len(times) - 1) / span if span > 0
+                    else 0.0,
+                    "report_span_s": span,
+                }
+            finally:
+                shutil.rmtree(store, ignore_errors=True)
+        off = doc["watchdog_off"]["steps_per_s"]
+        on = doc["watchdog_on"]["steps_per_s"]
+        doc["overhead_pct"] = round((off - on) / off * 100.0, 3) \
+            if off > 0 else None
+    finally:
+        ray_tpu.shutdown()
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_diagnostics.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# watchdog overhead {doc.get('overhead_pct')}% -> {path}",
+          file=sys.stderr)
+
+
 def main() -> None:
     import argparse
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--spec", default="auto", choices=["auto", "7b"],
+    ap.add_argument("--spec", default="auto",
+                    choices=["auto", "7b", "diagnostics"],
                     help="auto: timed bench on local chip(s); "
                          "7b: AOT shape-verify of the Llama-2-7B "
-                         "north-star on a virtual 8-device mesh")
+                         "north-star on a virtual 8-device mesh; "
+                         "diagnostics: watchdog-overhead bench only")
     args = ap.parse_args()
     if args.spec == "7b":
         shape_verify_7b()
+        return
+    if args.spec == "diagnostics":
+        bench_watchdog_overhead()
         return
 
     import jax
@@ -340,6 +413,12 @@ def main() -> None:
     print(f"# loss={float(metrics['loss']):.4f} mfu={mfu:.3f} "
           f"params={p/1e6:.0f}M devices={n_dev} step_ms={dt/iters*1e3:.1f}",
           file=sys.stderr)
+
+    # Diagnostics overhead (after the headline so it can never sink it).
+    try:
+        bench_watchdog_overhead()
+    except Exception as e:  # noqa: BLE001
+        print(f"# watchdog overhead bench failed: {e!r}", file=sys.stderr)
 
 
 if __name__ == "__main__":

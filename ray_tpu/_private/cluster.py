@@ -247,6 +247,28 @@ class UpActorState:
     worker_id: WorkerID
 
 
+@dataclass
+class StackDumpAll:
+    """head -> node server: forward a StackDumpRequest to every live
+    worker on the node (cluster half of ``ctl_stack_dump``)."""
+    dump_id: int
+
+
+@dataclass
+class UpStackReply:
+    """node server -> head: one worker's StackDumpReply."""
+    msg: Any  # protocol.StackDumpReply
+
+
+@dataclass
+class UpStackExpect:
+    """node server -> head: the worker set a StackDumpAll was fanned out
+    to — lets the head account a wedged REMOTE worker as unresponsive
+    instead of silently omitting it from the dump."""
+    dump_id: int
+    worker_ids: List[WorkerID]
+
+
 # --------------------------------------------------------------------------
 # descriptor location tagging
 # --------------------------------------------------------------------------
@@ -590,6 +612,14 @@ class RemoteNodeProxy:
 
     def send_to_worker(self, worker_id: WorkerID, msg) -> None:
         self.send(ToWorker(worker_id, msg))
+
+    def broadcast_stack_dump(self, dump_id: int) -> list:
+        """Forward the dump to the remote node; replies flow back as
+        UpStackReply.  The head cannot enumerate remote workers, so the
+        expected-reply set is empty — the collector waits out its timeout
+        instead (see Runtime.ctl_stack_dump)."""
+        self.send(StackDumpAll(dump_id))
+        return []
 
     def kill_actor_worker(self, worker_id: WorkerID,
                           force: bool = True) -> None:
@@ -1003,6 +1033,10 @@ class HeadServer:
             rt.submit_spec(msg.spec)
         elif isinstance(msg, UpActorState):
             rt.on_actor_state(msg.msg, nid, msg.worker_id)
+        elif isinstance(msg, UpStackReply):
+            rt.on_stack_reply(msg.msg, nid)
+        elif isinstance(msg, UpStackExpect):
+            rt.on_stack_expect(msg.dump_id, msg.worker_ids)
         elif isinstance(msg, GetRequest):
             rt.on_get_request(proxy, msg)
         elif isinstance(msg, WaitRequest):
@@ -1130,6 +1164,10 @@ class _NodeServerRuntime:
 
     def on_rpc_call(self, node, msg: RpcCall) -> None:
         self._server.send_up(msg)
+
+    def on_stack_reply(self, msg, node_id=None) -> None:
+        # A worker's stack snapshot: route it up to the head's collector.
+        self._server.send_up(UpStackReply(msg))
 
     def mark_escaped(self, oid) -> None:
         # Borrow escalation from a worker on this node: the owner (head)
@@ -1445,6 +1483,9 @@ class NodeServer:
             self._dispatch_q.put(msg)
         elif isinstance(msg, ToWorker):
             self._to_worker_q.put(msg)
+        elif isinstance(msg, StackDumpAll):
+            ids = self.node.broadcast_stack_dump(msg.dump_id)
+            self.send_up(UpStackExpect(msg.dump_id, ids))
         elif isinstance(msg, KillActorWorker):
             self.node.kill_actor_worker(msg.worker_id, msg.force)
         elif isinstance(msg, Ping):

@@ -193,6 +193,17 @@ D("visible_accelerator_env", str, "TPU_VISIBLE_CHIPS",
 D("task_events_max_num_task_in_gcs", int, 10000,
   "Bounded task-event history size (reference: ray_config_def.h "
   "task_events_max_num_task_in_gcs).")
+D("stack_dump_timeout_s", float, 5.0,
+  "How long a cluster-wide stack capture (`ray-tpu stack`, "
+  "state.list_stacks) waits for worker replies; non-responders are "
+  "reported as unresponsive — itself a diagnostic signal.")
+D("debug_bundle_on_worker_death", bool, True,
+  "Write a flight-recorder bundle under <session>/debug/ when a worker "
+  "dies while running tasks (rate-limited; see "
+  "debug_bundle_min_interval_s).")
+D("debug_bundle_min_interval_s", float, 60.0,
+  "Minimum seconds between automatic worker-death debug bundles, so a "
+  "crash loop cannot fill the disk with forensics.")
 
 # --- Syncer ----------------------------------------------------------------
 D("syncer_period_s", float, 1.0,

@@ -1,0 +1,162 @@
+"""Live diagnostics: process stack capture + postmortem flight recorder.
+
+Two halves of the active-observability story (the passive half — metric
+catalog, goodput, timeline — lives in ``util/telemetry.py``):
+
+* **Stack capture** (reference: ``ray stack`` in
+  python/ray/scripts/scripts.py, and the py-spy dump the dashboard's hang
+  investigation triggers): ``capture_process_stacks`` snapshots
+  ``sys._current_frames()`` in the calling process and annotates each
+  thread with the task/actor it is executing.  Workers run it on their
+  receive thread when a ``StackDumpRequest`` lands, so a worker whose
+  executor threads are wedged in user code still answers — which is the
+  whole point of the diagnostic.
+
+* **Flight recorder** (reference: the debug-state dumps raylets write on
+  SIGTERM plus the GCS task-event history a postmortem pulls):
+  ``write_debug_bundle`` collects everything a human attaches to a bug
+  report — captured stacks, the task-event tail, the last export-event
+  lines, a Prometheus metrics snapshot, and the goodput breakdown — into
+  one directory under ``<session>/debug/<timestamp>-<reason>/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+#: events.jsonl lines / task events captured into a bundle.
+EVENT_TAIL_LINES = 200
+TASK_EVENT_TAIL = 500
+
+
+def capture_process_stacks(worker_id: str,
+                           actor_id: Optional[str] = None,
+                           thread_tasks: Optional[Dict[int, tuple]] = None,
+                           is_driver: bool = False) -> Dict[str, Any]:
+    """Snapshot every thread's Python stack in THIS process.
+
+    ``thread_tasks`` maps thread idents to ``(task_id_hex, task_name)``
+    for threads currently executing a task (maintained by the worker's
+    ``_run_task_inner``), so the dump names what each thread is running,
+    not just where it is.
+    """
+    names: Dict[int, tuple] = {}
+    for t in threading.enumerate():
+        if t.ident is not None:
+            names[t.ident] = (t.name, t.daemon)
+    threads: List[Dict[str, Any]] = []
+    for tid, frame in sys._current_frames().items():
+        name, daemon = names.get(tid, ("<unknown>", True))
+        task_id, task_name = (thread_tasks or {}).get(tid, (None, None))
+        frames = [ln.rstrip("\n")
+                  for ln in traceback.format_stack(frame)]
+        threads.append({
+            "thread_id": tid, "name": name, "daemon": daemon,
+            "task_id": task_id, "task_name": task_name,
+            "frames": frames,
+        })
+    threads.sort(key=lambda t: (t["daemon"], t["name"]))
+    return {
+        "worker_id": worker_id,
+        "pid": os.getpid(),
+        "is_driver": is_driver,
+        "actor_id": actor_id,
+        "time": time.time(),
+        "threads": threads,
+    }
+
+
+def format_stack_dump(dump: Dict[str, Any]) -> str:
+    """Human-readable rendering of a ``ctl_stack_dump`` result (the
+    ``ray-tpu stack`` CLI output)."""
+    lines: List[str] = [f"=== cluster stack dump @ {dump.get('time')} ==="]
+    for rec in dump.get("stacks", ()):
+        who = "driver" if rec.get("is_driver") else f"worker {rec['worker_id'][:12]}"
+        head = f"--- {who} pid={rec.get('pid')}"
+        if rec.get("actor_id"):
+            head += f" actor={rec['actor_id'][:12]}"
+        if rec.get("node_id"):
+            head += f" node={rec['node_id'][:12]}"
+        lines.append(head + " ---")
+        for th in rec.get("threads", ()):
+            tag = f"thread {th['name']} (id={th['thread_id']})"
+            if th.get("task_name"):
+                tag += f" running task {th['task_name']} [{th['task_id']}]"
+            lines.append(tag)
+            lines.extend("  " + f for f in th.get("frames", ()))
+    missing = dump.get("unresponsive") or ()
+    if missing:
+        lines.append(f"unresponsive workers (no reply in time): "
+                     f"{', '.join(w[:12] for w in missing)}")
+    return "\n".join(lines)
+
+
+def _slug(reason: str, maxlen: int = 48) -> str:
+    out = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)
+    return out[:maxlen] or "dump"
+
+
+def write_debug_bundle(rt, reason: str,
+                       stacks: Optional[Dict[str, Any]] = None,
+                       capture_stacks: bool = True,
+                       stack_timeout_s: float = 2.0,
+                       extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write a postmortem bundle for the given driver Runtime; returns the
+    bundle directory path.  Every section is best-effort: a broken
+    subsystem must never stop the remaining forensics from landing."""
+    ts = time.strftime("%Y%m%d-%H%M%S")
+    frac = int((time.time() % 1) * 1e6)
+    path = os.path.join(rt.session_dir, "debug",
+                        f"{ts}-{frac:06d}-{_slug(reason)}")
+    os.makedirs(path, exist_ok=True)
+    contents: List[str] = []
+
+    def section(fname: str, produce) -> None:
+        try:
+            data = produce()
+            if data is None:
+                return
+            with open(os.path.join(path, fname), "w") as f:
+                f.write(data)
+            contents.append(fname)
+        except Exception:  # noqa: BLE001 — forensics are best-effort
+            pass
+
+    if stacks is None and capture_stacks:
+        try:
+            stacks = rt.ctl_stack_dump(timeout_s=stack_timeout_s)
+        except Exception:  # noqa: BLE001
+            stacks = None
+    if stacks is not None:
+        section("stacks.json",
+                lambda: json.dumps(stacks, indent=1, default=str))
+    section("task_events.json", lambda: json.dumps(
+        rt.events.snapshot(limit=TASK_EVENT_TAIL), indent=1, default=str))
+    section("events_tail.jsonl", lambda: "\n".join(
+        rt.log_monitor.tail("events.jsonl", EVENT_TAIL_LINES)) + "\n")
+
+    def _metrics():
+        from ray_tpu.util.metrics import prometheus_text
+        return prometheus_text()
+    section("metrics.prom", _metrics)
+
+    def _goodput():
+        from ray_tpu.util.telemetry import goodput_summary
+        g = goodput_summary()
+        return json.dumps(g, indent=1) if g is not None else None
+    section("goodput.json", _goodput)
+
+    section("manifest.json", lambda: json.dumps({
+        "reason": reason,
+        "time": time.time(),
+        "session_dir": rt.session_dir,
+        "extra": extra or {},
+        "contents": sorted(contents),
+    }, indent=1, default=str))
+    return path

@@ -39,8 +39,9 @@ from .object_store import NativeArenaStore, create_store
 from .protocol import (ActorStateMsg, AllocReply, AllocRequest,
                        BorrowRetained, ContainedRefs, GetRequest,
                        KillWorker, PutFromWorker, ReadDone, RpcCall,
-                       RunTask, SealObject, SubmitFromWorker, TaskDone,
-                       TaskSpec, WaitRequest, WorkerReady)
+                       RunTask, SealObject, StackDumpReply, StackDumpRequest,
+                       SubmitFromWorker, TaskDone, TaskSpec, WaitRequest,
+                       WorkerReady)
 from .resources import ResourceSet, TPU
 
 IDLE = "idle"
@@ -913,6 +914,20 @@ class NodeManager:
         if handle is not None and handle.state != DEAD:
             self._send(handle, msg)
 
+    def broadcast_stack_dump(self, dump_id: int) -> List[WorkerID]:
+        """Ship a StackDumpRequest to every registered live worker;
+        returns the worker ids a reply is expected from.  Workers that
+        have not finished registering are skipped — their pending-message
+        queue would hold the request until boot completes, stalling the
+        dump on an interpreter that is not running anything yet."""
+        with self._lock:
+            handles = [h for h in self._workers.values()
+                       if h.state != DEAD and h.ready.is_set()
+                       and h.conn is not None]
+        for h in handles:
+            self._send(h, StackDumpRequest(dump_id))
+        return [h.worker_id for h in handles]
+
     # -- receive ------------------------------------------------------------
 
     def _handle_msg(self, handle: WorkerHandle, msg) -> None:
@@ -1004,6 +1019,8 @@ class NodeManager:
                 rt.mark_escaped(oid)
         elif isinstance(msg, ContainedRefs):
             rt.note_contained(msg.outer, msg.inner)
+        elif isinstance(msg, StackDumpReply):
+            rt.on_stack_reply(msg, self.info.node_id)
         elif isinstance(msg, RpcCall):
             rt.on_rpc_call(self, msg)
 

@@ -226,6 +226,26 @@ class ReadDone:
 
 
 @dataclass
+class StackDumpRequest:
+    """node -> worker: snapshot every thread's Python stack (reference:
+    ``ray stack`` / the py-spy dump the dashboard triggers).  Handled on
+    the worker's receive thread — NOT the executor pool — so a worker
+    whose task threads are wedged still answers; that is the whole point
+    of the diagnostic."""
+    dump_id: int
+
+
+@dataclass
+class StackDumpReply:
+    """worker -> node: the ``sys._current_frames()`` snapshot plus the
+    task/actor identity each thread was executing (see
+    diagnostics.capture_process_stacks for the record shape)."""
+    dump_id: int
+    worker_id: WorkerID
+    record: Dict
+
+
+@dataclass
 class RpcCall:
     """worker -> node: generic control-plane call (KV, actor lookup, ...)."""
     request_id: int

@@ -384,6 +384,15 @@ class Runtime:
         # process (see ray_tpu.util.metrics).
         self.metrics_snapshots: Dict[str, list] = {}
 
+        # -- live diagnostics (reference: `ray stack` + the debug-state
+        # dump; see diagnostics.py) ------------------------------------- #
+        # dump_id -> {"replies": {worker_hex: record}, "event", "want"}
+        self._stack_lock = threading.Lock()
+        self._stack_dump_seq = 0
+        self._stack_dumps: Dict[int, Dict[str, Any]] = {}
+        # Rate limiter for the worker-death flight recorder.
+        self._last_death_bundle = 0.0
+
         # -- multi-node cluster plane (reference: gcs_node_manager.h node
         # registration + object_manager pull/push; see cluster.py) -------- #
         self.head_server = None
@@ -1665,6 +1674,10 @@ class Runtime:
                     pass
                 self.events.record(msg.task_id.hex(), FAILED,
                                    error_message=err)
+                self._export_event("EXPORT_TASK", {
+                    "task_id": msg.task_id.hex(), "state": FAILED,
+                    "name": spec.name if spec else None,
+                    "error_message": err})
                 for oid in (spec.return_ids if spec
                             else [r[0] for r in msg.results]):
                     self.mark_ready(oid, msg.error)
@@ -1782,6 +1795,9 @@ class Runtime:
                 ast.classic_inflight.discard(spec.task_id)
         self.events.record(spec.task_id.hex(), FAILED, name=spec.name,
                            error_message=repr(exc))
+        self._export_event("EXPORT_TASK", {
+            "task_id": spec.task_id.hex(), "state": FAILED,
+            "name": spec.name, "error_message": repr(exc)})
         self._release_deps(spec.task_id)
         desc = ("err", serialization.pack_payload(exc))
         for oid in spec.return_ids:
@@ -1817,6 +1833,27 @@ class Runtime:
                 if rt is not None:
                     specs.append(rt.spec)
         oom = reason.startswith("OOM-killed")
+        # Direct actor calls bypass the running table (submit_actor_direct):
+        # count them so a busy actor's death still registers as unexpected.
+        n_direct = 0
+        if actor_id is not None:
+            with self._direct_lock:
+                n_direct = sum(1 for (aid, _r, _n)
+                               in self._direct_inflight.values()
+                               if aid == actor_id)
+        self._export_event("EXPORT_WORKER", {
+            "worker_id": worker_id.hex(), "node_id": node_id.hex(),
+            "state": "DEAD", "reason": reason or None,
+            "actor_id": actor_id.hex() if actor_id is not None else None,
+            "num_running_tasks": len(specs) + n_direct})
+        if specs or n_direct:
+            # Dying WHILE running tasks is the unexpected case worth
+            # forensics (clean pool reaping and idle actor kills are not).
+            self._maybe_death_bundle(
+                f"worker_death_{worker_id.hex()[:8]}",
+                {"worker_id": worker_id.hex(), "reason": reason,
+                 "running_tasks": [t.hex() for t in running_tasks],
+                 "direct_calls_inflight": n_direct})
         for spec in specs:
             if spec.task_id in self._pipelined:
                 # Pipelined task: no booking to release; the resubmit
@@ -2081,7 +2118,10 @@ class Runtime:
 
     # ctl_* methods that may block (long-poll style): handled off the
     # reader thread so one waiting worker can't stall its node connection.
-    _BLOCKING_CTL = frozenset({"kv_wait", "pubsub_poll"})
+    # stack_dump/debug_dump wait for StackDumpReplies that arrive ON the
+    # poller thread — running them there would deadlock the collection.
+    _BLOCKING_CTL = frozenset({"kv_wait", "pubsub_poll", "stack_dump",
+                               "debug_dump"})
 
     def on_rpc_call(self, node, msg: RpcCall) -> None:
         def run():
@@ -2214,11 +2254,21 @@ class Runtime:
                                            _version=-1, _ts=time.time())
         return out
 
-    def ctl_list_actors(self):
-        return [{"actor_id": a.actor_id.hex(), "state": a.state,
-                 "name": a.name, "class_name": a.class_name,
-                 "num_restarts": a.num_restarts}
-                for a in self.controller.actors.values()]
+    def ctl_list_actors(self, filters=None, limit=10000):
+        """Actor table view; ``filters`` is an equality dict applied
+        server-side so point lookups (state.get_actor) don't ship the
+        whole table (mirrors ctl_list_tasks' filter pushdown)."""
+        out = []
+        for a in self.controller.actors.values():
+            rec = {"actor_id": a.actor_id.hex(), "state": a.state,
+                   "name": a.name, "class_name": a.class_name,
+                   "num_restarts": a.num_restarts}
+            if filters and any(rec.get(k) != v for k, v in filters.items()):
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
 
     # -- state API feeds (reference: dashboard/modules/state/state_head.py
     #    backed by GcsTaskManager; here the buffers live in-process) ----- #
@@ -2284,6 +2334,149 @@ class Runtime:
 
     def ctl_get_fn_blob(self, fn_id: bytes):
         return self._fn_table.get(fn_id)
+
+    # -- live diagnostics (reference: `ray stack`, scripts.py; the debug
+    #    state dump a postmortem attaches) ------------------------------- #
+
+    def on_stack_reply(self, msg, node_id: Optional[NodeID] = None) -> None:
+        """A worker's StackDumpReply landed (local poller thread or a
+        remote node's UpStackReply): file it under its dump id."""
+        with self._stack_lock:
+            entry = self._stack_dumps.get(msg.dump_id)
+            if entry is None:
+                return  # collector already timed out and left
+            record = dict(msg.record)
+            record["node_id"] = node_id.hex() if node_id is not None else None
+            entry["replies"][msg.worker_id.hex()] = record
+            evt = entry["event"]
+        evt.set()
+
+    def on_stack_expect(self, dump_id: int, worker_ids: List) -> None:
+        """A remote node answered StackDumpAll with the worker set it
+        fanned out to: widen the expected-reply set so a wedged remote
+        worker surfaces as 'unresponsive' instead of silently missing."""
+        with self._stack_lock:
+            entry = self._stack_dumps.get(dump_id)
+            if entry is None:
+                return
+            entry["want"].update(w.hex() for w in worker_ids)
+            entry["expects_pending"] -= 1
+            evt = entry["event"]
+        evt.set()
+
+    def ctl_stack_dump(self,
+                       timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Snapshot every live worker's thread stacks plus the driver's
+        own (cluster-wide ``ray stack``).  Returns ``{"time", "stacks",
+        "unresponsive"}``; a worker that cannot answer within the timeout
+        is itself a diagnostic signal and is listed by id.
+
+        Blocking: listed in _BLOCKING_CTL so a worker-originated call
+        never runs on the node poller thread that must route the replies.
+        """
+        from .diagnostics import capture_process_stacks
+        if timeout_s is None:
+            timeout_s = Config.get("stack_dump_timeout_s")
+        nodes = list(self.nodes.values())
+        remote_nodes = [n for n in nodes if getattr(n, "is_remote", False)]
+        with self._stack_lock:
+            self._stack_dump_seq += 1
+            dump_id = self._stack_dump_seq
+            # Each remote node answers the broadcast with an UpStackExpect
+            # naming its worker set; until every expect has landed the
+            # collection can't know it has seen all wanted replies.
+            entry: Dict[str, Any] = {"replies": {}, "want": set(),
+                                     "expects_pending": len(remote_nodes),
+                                     "event": threading.Event()}
+            self._stack_dumps[dump_id] = entry
+        expected: List[WorkerID] = []
+        for node in nodes:
+            try:
+                ids = node.broadcast_stack_dump(dump_id)
+                if not getattr(node, "is_remote", False):
+                    expected.extend(ids)
+            except Exception:  # noqa: BLE001 — a dead node can't stop a dump
+                with self._stack_lock:
+                    if getattr(node, "is_remote", False):
+                        entry["expects_pending"] -= 1
+        with self._stack_lock:
+            entry["want"].update(w.hex() for w in expected)
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        # A node server that dies before answering with its expect set
+        # would otherwise hold the collection to the full timeout; the
+        # settle window closes it shortly after replies stop arriving.
+        settle_s = 0.5
+        last_change = time.monotonic()
+        prev_progress = -1
+        while time.monotonic() < deadline:
+            with self._stack_lock:
+                have = set(entry["replies"])
+                want = set(entry["want"])
+                expects_pending = entry["expects_pending"]
+            progress = len(have) + len(want)
+            if progress != prev_progress:
+                prev_progress = progress
+                last_change = time.monotonic()
+            if want <= have and (
+                    expects_pending <= 0
+                    or time.monotonic() - last_change >= settle_s):
+                break
+            entry["event"].clear()
+            entry["event"].wait(min(0.05, max(
+                0.0, deadline - time.monotonic())))
+        with self._stack_lock:
+            self._stack_dumps.pop(dump_id, None)
+            replies = dict(entry["replies"])
+            want = set(entry["want"])
+        driver = capture_process_stacks("driver", is_driver=True)
+        driver["node_id"] = self.node_id.hex()
+        stacks = [driver] + [replies[k] for k in sorted(replies)]
+        return {"time": time.time(), "stacks": stacks,
+                "unresponsive": sorted(want - set(replies))}
+
+    def ctl_debug_dump(self, reason: str = "manual",
+                       capture_stacks: bool = True,
+                       extra: Optional[Dict[str, Any]] = None) -> str:
+        """Write a postmortem bundle under <session>/debug/; returns its
+        path (flight recorder, `ray-tpu debug dump`)."""
+        from .diagnostics import write_debug_bundle
+        return write_debug_bundle(self, reason,
+                                  capture_stacks=capture_stacks, extra=extra)
+
+    def ctl_export_event(self, source_type: str, event: Dict[str, Any]):
+        """Append a structured record to <session>/logs/events.jsonl on
+        behalf of any process (train watchdog, user tooling)."""
+        self._export_event(source_type, dict(event))
+        return True
+
+    def _export_event(self, source_type: str, event: Dict[str, Any]) -> None:
+        try:
+            self.export_events.write(source_type, event)
+        except Exception:  # noqa: BLE001 — forensics never fail the caller
+            pass
+
+    def _maybe_death_bundle(self, reason: str,
+                            extra: Dict[str, Any]) -> None:
+        """Rate-limited flight-recorder capture on unexpected worker death
+        (no stack broadcast: the dead worker can't answer, and the bundle
+        must stay cheap on the failure path)."""
+        if self._shutdown or not Config.get("debug_bundle_on_worker_death"):
+            return
+        now = time.monotonic()
+        if now - self._last_death_bundle < Config.get(
+                "debug_bundle_min_interval_s"):
+            return
+        self._last_death_bundle = now
+
+        def run():
+            try:
+                from .diagnostics import write_debug_bundle
+                write_debug_bundle(self, reason, capture_stacks=False,
+                                   extra=extra)
+            except Exception:  # noqa: BLE001
+                pass
+        threading.Thread(target=run, name="death-bundle",
+                         daemon=True).start()
 
     # -- pubsub (reference: src/ray/pubsub/ long-poll publisher) ----------
 

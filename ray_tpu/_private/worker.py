@@ -27,8 +27,9 @@ from .object_store import ArenaReader, RemoteObjectReader
 from .protocol import (ActorStateMsg, AllocReply, AllocRequest,
                        BorrowRetained, GetReply, GetRequest, KillWorker,
                        PutFromWorker, ReadDone, RpcCall, RpcReply, RunTask,
-                       SealObject, SubmitFromWorker, TaskDone, WaitReply,
-                       WaitRequest, WorkerReady)
+                       SealObject, StackDumpReply, StackDumpRequest,
+                       SubmitFromWorker, TaskDone, WaitReply, WaitRequest,
+                       WorkerReady)
 
 
 def _materialize(desc, keepalives: List, rt=None) -> Any:
@@ -101,6 +102,10 @@ class WorkerRuntime:
         self._pending: Dict[int, queue.Queue] = {}
         self.current_task_id: Optional[TaskID] = None
         self.current_actor_id: Optional[ActorID] = None
+        # thread ident -> (task_id_hex, task_name) while that thread runs a
+        # task: lets a StackDumpRequest name what each thread executes
+        # (concurrent actor methods make the single current_task_id racy).
+        self.thread_tasks: Dict[int, Tuple[str, str]] = {}
         self._obj_index_lock = threading.Lock()
         self._obj_index = 1 << 20  # put-objects live above return indices
         self.arena_segment = os.environ.get("RAY_TPU_ARENA_SEG") or None
@@ -667,6 +672,8 @@ class WorkerLoop:
         spec = msg.spec
         rt = self.runtime
         rt.current_task_id = spec.task_id
+        _tident = threading.get_ident()
+        rt.thread_tasks[_tident] = (spec.task_id.hex(), spec.name)
         # Actor tasks may stash zero-copy arg views in actor state, so their
         # backing shm segments live as long as the actor.
         is_actor_task = (spec.create_actor_id is not None
@@ -785,6 +792,7 @@ class WorkerLoop:
             args = kwargs = value_list = wrapped = None  # noqa: F841
         finally:
             rt.current_task_id = None
+            rt.thread_tasks.pop(_tident, None)
             if not is_actor_task:
                 # Results are serialized (copied) by now; arg/get views are
                 # dead, so release their arena pins before TaskDone.
@@ -880,6 +888,18 @@ class WorkerLoop:
             self._executor.submit(self._run_task, msg)
         elif isinstance(msg, (GetReply, WaitReply, RpcReply, AllocReply)):
             rt.deliver_reply(msg.request_id, msg)
+        elif isinstance(msg, StackDumpRequest):
+            # Runs on THIS (receive) thread, never the executor pool: a
+            # worker wedged in user code must still answer the dump.
+            try:
+                from .diagnostics import capture_process_stacks
+                record = capture_process_stacks(
+                    rt.worker_id.hex(),
+                    actor_id=self.actor_id.hex() if self.actor_id else None,
+                    thread_tasks=rt.thread_tasks)
+                rt.send(StackDumpReply(msg.dump_id, rt.worker_id, record))
+            except Exception:  # noqa: BLE001 — diagnostics must not kill us
+                traceback.print_exc()
         elif isinstance(msg, KillWorker):
             return False
         return True

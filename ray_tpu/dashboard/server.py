@@ -12,6 +12,8 @@ Endpoints (reference: dashboard/modules/*):
     GET /api/jobs               — job table
     GET /api/timeline           — chrome-trace events
     GET /api/metrics/summary    — built-in telemetry by subsystem + goodput
+    GET /api/stacks             — cluster-wide stack capture (`ray stack`)
+    POST /api/debug/dump        — write a flight-recorder bundle
     GET /metrics                — Prometheus exposition (user + built-in)
     GET /-/healthz              — liveness
 """
@@ -156,6 +158,31 @@ class DashboardServer:
             from ..util import telemetry
             return self._json(telemetry.summary())
 
+        async def stacks(req):
+            # Cluster-wide stack capture (reference: `ray stack`).  The
+            # collection blocks up to its timeout — exactly when a worker
+            # is hung — so it runs in an executor: /-/healthz and the
+            # other routes must stay live during a hang investigation.
+            import asyncio
+            timeout = req.query.get("timeout_s")
+            try:
+                t = float(timeout) if timeout else None
+            except ValueError:
+                return web.Response(status=400, text="bad timeout_s")
+            dump = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: rt.ctl_stack_dump(t))
+            return self._json(dump)
+
+        async def debug_dump(req):
+            # Flight recorder on demand: writes <session>/debug/<ts>/.
+            # Off-loop for the same reason as /api/stacks (it embeds a
+            # stack capture).
+            import asyncio
+            reason = req.query.get("reason", "manual")
+            path = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: rt.ctl_debug_dump(reason))
+            return self._json({"path": path})
+
         async def healthz(req):
             return web.Response(text="ok")
 
@@ -170,6 +197,8 @@ class DashboardServer:
         app.router.add_get("/api/jobs", jobs)
         app.router.add_get("/api/timeline", timeline)
         app.router.add_get("/api/metrics/summary", metrics_summary)
+        app.router.add_get("/api/stacks", stacks)
+        app.router.add_post("/api/debug/dump", debug_dump)
         app.router.add_get("/api/node_views", node_views)
         app.router.add_get("/api/logs", logs)
         app.router.add_get("/api/logs/{fname}", log_tail)

@@ -87,6 +87,23 @@ class JobServer:
                 return web.json_response({"error": "not found"}, status=404)
             return web.json_response({"stopped": stopped})
 
+        def _goodput():
+            from ray_tpu.util import telemetry
+            return telemetry.goodput_summary()
+
+        def _watchdog_verdict():
+            import json as _json
+
+            from ray_tpu._private.api import _control
+            from ray_tpu.train.watchdog import VERDICT_KV_KEY
+            raw = _control("kv_get", VERDICT_KV_KEY)
+            if not raw:
+                return None
+            try:
+                return _json.loads(raw)
+            except Exception:  # noqa: BLE001
+                return None
+
         async def cluster_status(request):
             from ray_tpu._private.api import _control
             import ray_tpu
@@ -97,8 +114,32 @@ class JobServer:
                     await call(ray_tpu.available_resources),
                 "actors": await call(_control, "list_actors"),
                 "task_summary": await call(_control, "summarize_tasks"),
+                # Operator health at a glance (`ray-tpu status`): live
+                # goodput ratio + the watchdog's last verdict.
+                "goodput": await call(_goodput),
+                "watchdog": await call(_watchdog_verdict),
             }
             return web.json_response(payload)
+
+        async def cluster_stacks(request):
+            from ray_tpu._private.api import _control
+            timeout = request.query.get("timeout_s")
+            if timeout:
+                try:
+                    timeout_f = float(timeout)
+                except ValueError:
+                    return web.json_response(
+                        {"error": "bad timeout_s"}, status=400)
+                dump = await call(_control, "stack_dump", timeout_f)
+            else:
+                dump = await call(_control, "stack_dump")
+            return web.json_response(dump)
+
+        async def cluster_debug_dump(request):
+            from ray_tpu._private.api import _control
+            reason = request.query.get("reason", "manual")
+            path = await call(_control, "debug_dump", reason)
+            return web.json_response({"path": path})
 
         async def timeline(request):
             from ray_tpu._private.api import _control
@@ -119,6 +160,9 @@ class JobServer:
             app.router.add_post("/api/jobs/{sid}/stop", job_stop)
             app.router.add_get("/api/cluster/status", cluster_status)
             app.router.add_get("/api/cluster/timeline", timeline)
+            app.router.add_get("/api/cluster/stacks", cluster_stacks)
+            app.router.add_post("/api/cluster/debug_dump",
+                                cluster_debug_dump)
             app.router.add_get("/metrics", metrics)
             app.router.add_get(
                 "/-/healthz", lambda r: web.json_response({"ok": True}))

@@ -179,6 +179,25 @@ def status(address):
         for name, states in sorted(s["task_summary"].items()):
             parts = ", ".join(f"{k}={v}" for k, v in sorted(states.items()))
             click.echo(f"  {name}: {parts}")
+    # Operator health at a glance: live goodput + last watchdog verdict
+    # (no dashboard curl needed).
+    g = s.get("goodput")
+    if g:
+        click.echo(f"train goodput: {g['goodput_ratio']:.3f} "
+                   f"(productive {g['productive_s']:.1f}s / "
+                   f"total {g['total_s']:.1f}s)")
+    else:
+        click.echo("train goodput: n/a (no training run observed)")
+    w = s.get("watchdog")
+    if w:
+        if w.get("status") == "ok":
+            click.echo("watchdog: ok")
+        else:
+            click.echo(f"watchdog: {w['status']} rank={w.get('rank')} "
+                       f"(stragglers={w.get('straggler_total', 0)}, "
+                       f"hangs={w.get('hang_total', 0)})")
+    else:
+        click.echo("watchdog: n/a (no watchdog verdict recorded)")
 
 
 @cli.group()
@@ -254,6 +273,51 @@ def timeline(address, output):
     with open(output, "w") as f:
         json.dump(trace, f)
     click.echo(f"wrote {len(trace)} events to {output}")
+
+
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--timeout", type=float, default=None,
+              help="Seconds to wait for worker stack replies.")
+@click.option("--output", "-o", default=None,
+              help="Write the raw JSON dump to a file instead of "
+                   "pretty-printing.")
+def stack(address, timeout, output):
+    """Print every live worker's Python stacks (reference: `ray stack`) —
+    the first thing to run when a job looks stuck: it names the rank, the
+    task, and the exact line each thread is blocked on."""
+    client = _client(address)
+    path = "/api/cluster/stacks"
+    if timeout is not None:
+        path += f"?timeout_s={timeout}"
+    dump = client._request("GET", path)
+    if output:
+        with open(output, "w") as f:
+            json.dump(dump, f, indent=1)
+        click.echo(f"wrote {len(dump.get('stacks', []))} process records "
+                   f"to {output}")
+        return
+    from ray_tpu._private.diagnostics import format_stack_dump
+    click.echo(format_stack_dump(dump))
+
+
+@cli.group()
+def debug():
+    """Failure forensics (flight recorder)."""
+
+
+@debug.command("dump")
+@click.option("--address", default=None)
+@click.option("--reason", default="manual", show_default=True)
+def debug_dump(address, reason):
+    """Write a postmortem bundle on the head — captured stacks, the task
+    event tail, export events, a metrics snapshot, and the goodput
+    breakdown — under <session>/debug/, and print the bundle path."""
+    from urllib.parse import quote
+    client = _client(address)
+    out = client._request(
+        "POST", f"/api/cluster/debug_dump?reason={quote(reason, safe='')}")
+    click.echo(out["path"])
 
 
 def main():

@@ -5,9 +5,11 @@ from ._checkpoint import (Checkpoint, CheckpointManager, load_pytree,
 from ._context import TrainContext, get_context, report
 from .trainer import (CheckpointConfig, FailureConfig, JaxTrainer, Result,
                       RunConfig, ScalingConfig)
+from .watchdog import TrainWatchdog, WatchdogConfig
 
 __all__ = [
     "JaxTrainer", "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Result", "Checkpoint", "CheckpointManager",
     "get_context", "report", "TrainContext", "save_pytree", "load_pytree",
+    "WatchdogConfig", "TrainWatchdog",
 ]

@@ -86,6 +86,9 @@ def report(metrics: Dict[str, Any],
         "rank": ctx.get_world_rank(),
         "seq": ctx._report_seq,
         "time": now,
+        # Worker pid: lets the watchdog's stack auto-capture mark which
+        # process record belongs to a flagged rank.
+        "pid": os.getpid(),
         "checkpoint_dir": checkpoint.path if checkpoint else None,
         # Checkpoint seconds inside this report window (goodput
         # reattribution at the controller).

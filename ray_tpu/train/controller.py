@@ -133,6 +133,11 @@ class TrainController:
         # ratio lands on the ray_tpu_train_goodput_ratio gauge live.
         from ..util.telemetry import GoodputTracker
         self.goodput = GoodputTracker(initial_phase="init")
+        # Hang/straggler watchdog over the per-rank report stream
+        # (watchdog.py); fed from _poll_reports, polled on its own thread.
+        from .watchdog import TrainWatchdog
+        self.watchdog = TrainWatchdog(
+            self.run_id, getattr(run_config, "watchdog", None))
 
     # -- worker group -------------------------------------------------------
 
@@ -220,6 +225,8 @@ class TrainController:
                 continue
             payload = pickle.loads(data)
             self._reports.append(payload)
+            self.watchdog.note_report(payload["rank"], payload["time"],
+                                      payload.get("pid"))
             if payload["rank"] == 0:
                 # Worker-measured checkpoint time happened inside what
                 # the driver observes as the "step" phase: reattribute.
@@ -240,6 +247,7 @@ class TrainController:
         error: Optional[Exception] = None
         carry_target: Optional[int] = None
         self.world_size_history: List[int] = []
+        self.watchdog.start()
         while True:
             # First group formation is "init"; every re-formation after a
             # failure is "restart" overhead (resizes count as restart too:
@@ -250,6 +258,9 @@ class TrainController:
             carry_target = None
             world = decision.num_workers
             self.world_size_history.append(world)
+            # Fresh incarnation: stale rank clocks must not trip on the
+            # re-formed group.
+            self.watchdog.reset_ranks()
             group = self._start_group(world)
             fn_blob = serialization.dumps_control(self.train_fn)
             ctx_info = {
@@ -272,6 +283,12 @@ class TrainController:
                     pending, num_returns=1, timeout=0.5)
                 self._poll_reports()
                 for ref in done:
+                    # A finished rank legitimately stops reporting — tell
+                    # the watchdog before its hang deadline can fire.
+                    try:
+                        self.watchdog.note_done(group.run_refs.index(ref))
+                    except ValueError:
+                        pass
                     try:
                         ray_tpu.get(ref)
                     except Exception as e:  # noqa: BLE001
@@ -331,6 +348,7 @@ class TrainController:
             # under-sizing on the first partial fit.
             carry_target = world
 
+        self.watchdog.stop()
         self.goodput.finish()
         rank0 = sorted((r for r in self._reports if r["rank"] == 0),
                        key=lambda r: r["time"])

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ._checkpoint import Checkpoint
 from .controller import TrainController
+from .watchdog import WatchdogConfig
 
 
 @dataclass
@@ -67,6 +68,9 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)
+    # Hang/straggler watchdog knobs (straggler multiple, hang deadline;
+    # see train/watchdog.py).
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
 
     def __post_init__(self):
         if not self.storage_path:

@@ -63,17 +63,39 @@ def summarize_actors(**_: Any) -> Dict[str, Dict[str, int]]:
 
 
 def get_task(task_id: str) -> Optional[Dict[str, Any]]:
-    for t in list_tasks():
-        if t["task_id"] == task_id:
-            return t
-    return None
+    """Point lookup: the id is pushed down as an equality filter so the
+    control plane never ships the full task table to the client."""
+    matches = _control("list_tasks", {"task_id": task_id}, 1)
+    return matches[-1] if matches else None
 
 
 def get_actor(actor_id: str) -> Optional[Dict[str, Any]]:
-    for a in list_actors():
-        if a["actor_id"] == actor_id:
-            return a
-    return None
+    """Point lookup via the server-side actor filter (see get_task)."""
+    matches = _control("list_actors", {"actor_id": actor_id}, 1)
+    return matches[-1] if matches else None
+
+
+def list_stacks(timeout_s: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Cluster-wide stack capture (reference: ``ray stack``): every live
+    worker (plus the driver) snapshots ``sys._current_frames()`` and the
+    task each thread is executing.  Returns one record per process; use
+    ``stack_dump()`` for the full result including unresponsive workers.
+    """
+    return stack_dump(timeout_s)["stacks"]
+
+
+def stack_dump(timeout_s: Optional[float] = None) -> Dict[str, Any]:
+    """Raw cluster stack dump: ``{"time", "stacks", "unresponsive"}``."""
+    if timeout_s is None:
+        return _control("stack_dump")
+    return _control("stack_dump", timeout_s)
+
+
+def debug_dump(reason: str = "manual") -> str:
+    """Write a postmortem flight-recorder bundle (captured stacks, task
+    event tail, export events, metrics snapshot, goodput breakdown) under
+    ``<session>/debug/`` and return the bundle path."""
+    return _control("debug_dump", reason)
 
 
 class profile_span:
@@ -99,13 +121,18 @@ class profile_span:
 
     def __enter__(self):
         import time
+        # Wall clock anchors the span's position on the timeline; the
+        # DURATION comes from the monotonic clock so an NTP step mid-span
+        # cannot produce a negative/garbage length.
         self._start = time.time()
+        self._start_mono = time.monotonic()
         return self
 
     def __exit__(self, *exc):
         import time
+        end = self._start + (time.monotonic() - self._start_mono)
         _control("add_profile_span", self.name, self.category, self._start,
-                 time.time(), self.pid, self.tid, self.extra)
+                 end, self.pid, self.tid, self.extra)
         return False
 
 

@@ -135,6 +135,16 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "type": "gauge", "tag_keys": (),
         "description": "Productive-step wall time over total run wall "
                        "time (goodput accounting; see GoodputTracker)."},
+    "ray_tpu_train_straggler_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Watchdog straggler verdicts: a rank's step time "
+                       "exceeded the configured multiple of the "
+                       "across-rank median (one per incident)."},
+    "ray_tpu_train_hang_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Watchdog hang verdicts: a rank produced no "
+                       "report within the hang deadline (one per "
+                       "incident)."},
     # -- data --------------------------------------------------------------
     "ray_tpu_data_block_seconds": {
         "type": "histogram", "tag_keys": ("operator",),
@@ -272,7 +282,7 @@ class profile_span:
     loop or a bench process that never called ``ray_tpu.init()``.
     """
 
-    __slots__ = ("name", "category", "extra", "_start")
+    __slots__ = ("name", "category", "extra", "_start", "_start_mono")
 
     def __init__(self, name: str, category: str = "system",
                  extra: Optional[Dict[str, Any]] = None):
@@ -281,12 +291,15 @@ class profile_span:
         self.extra = extra
 
     def __enter__(self) -> "profile_span":
+        # Wall clock positions the span; monotonic measures its length so
+        # an NTP step mid-span can't yield a negative/garbage duration.
         self._start = time.time()
+        self._start_mono = time.monotonic()
         return self
 
     def __exit__(self, *exc) -> bool:
-        _emit_span(self.name, self.category, self._start, time.time(),
-                   self.extra)
+        end = self._start + (time.monotonic() - self._start_mono)
+        _emit_span(self.name, self.category, self._start, end, self.extra)
         return False
 
 

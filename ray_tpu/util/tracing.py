@@ -131,6 +131,7 @@ class task_span:
         self._prev = None
         self._ctx = None
         self._t0 = 0.0
+        self._t0_mono = 0.0
 
     def __enter__(self):
         if self._parent is None:
@@ -138,7 +139,10 @@ class task_span:
         self._prev = current()
         self._ctx = SpanContext(self._parent.trace_id, _rand_hex(8))
         set_current(self._ctx)
+        # Wall clock anchors the span; duration is monotonic so an NTP
+        # step during execution can't produce a negative span.
         self._t0 = time.time()
+        self._t0_mono = time.monotonic()
         return self
 
     def __exit__(self, exc_type, _exc, _tb):
@@ -149,7 +153,8 @@ class task_span:
             "trace_id": self._ctx.trace_id, "span_id": self._ctx.span_id,
             "parent_span_id": self._parent.span_id,
             "name": f"execute {self._name}", "kind": "CONSUMER",
-            "start_s": self._t0, "end_s": time.time(),
+            "start_s": self._t0,
+            "end_s": self._t0 + (time.monotonic() - self._t0_mono),
             "attributes": {"task_id": self._task_id, "op": "execute",
                            "error": exc_type.__name__ if exc_type else None},
         })

@@ -156,6 +156,91 @@ class TestClusterBasics:
         ray_tpu.remove_placement_group(pg)
 
 
+class TestClusterStackCapture:
+    def test_remote_node_workers_answer_stack_dump(self, cluster,
+                                                   tmp_path):
+        """Cluster half of `ray-tpu stack`: the head (0 CPUs, so every
+        task lands on a remote node) broadcasts StackDumpAll; replies
+        ride UpStackReply back and carry the remote node's id."""
+        @ray_tpu.remote(num_cpus=1)
+        def remote_stack_probe(flag, marker):
+            open(marker, "w").close()
+            import time as _t
+            while not os.path.exists(flag):
+                _t.sleep(0.05)
+            return "ok"
+
+        flag = str(tmp_path / "release")
+        marker = str(tmp_path / "started")
+        ref = remote_stack_probe.remote(flag, marker)
+        deadline = time.monotonic() + 30
+        while not os.path.exists(marker):
+            assert time.monotonic() < deadline, "probe never started"
+            time.sleep(0.05)
+        try:
+            dump = cluster.runtime.ctl_stack_dump(timeout_s=10.0)
+            head_nid = cluster.runtime.node_id.hex()
+            probed = [
+                rec for rec in dump["stacks"]
+                if any(any("remote_stack_probe" in f for f in th["frames"])
+                       for th in rec["threads"])]
+            assert probed, "no remote worker stack names the probe"
+            assert all(not r.get("is_driver") for r in probed)
+            # The record is attributed to the remote node, not the head.
+            assert any(r.get("node_id") and r["node_id"] != head_nid
+                       for r in probed)
+        finally:
+            open(flag, "w").close()
+        assert ray_tpu.get(ref, timeout=60) == "ok"
+
+    def test_wedged_remote_worker_reported_unresponsive(self, cluster,
+                                                        tmp_path):
+        """A remote worker that cannot answer (SIGSTOP stands in for a
+        C-extension wedge) must show up in `unresponsive` — the node
+        server reports its fan-out set via UpStackExpect so the head can
+        account for remote non-responders, not silently omit them."""
+        import signal
+
+        @ray_tpu.remote(num_cpus=1)
+        def wedge_probe(flag, pid_file):
+            with open(pid_file, "w") as f:
+                f.write(str(os.getpid()))
+            import time as _t
+            while not os.path.exists(flag):
+                _t.sleep(0.05)
+            return "ok"
+
+        flag = str(tmp_path / "wedge_release")
+        pid_file = str(tmp_path / "wedge_pid")
+        ref = wedge_probe.remote(flag, pid_file)
+        deadline = time.monotonic() + 30
+        while not (os.path.exists(pid_file)
+                   and open(pid_file).read().strip()):
+            assert time.monotonic() < deadline, "probe never started"
+            time.sleep(0.05)
+        pid = int(open(pid_file).read())
+        os.kill(pid, signal.SIGSTOP)
+        try:
+            dump = cluster.runtime.ctl_stack_dump(timeout_s=3.0)
+            assert dump["unresponsive"], (
+                "stopped remote worker missing from unresponsive: "
+                f"{[r['worker_id'][:8] for r in dump['stacks']]}")
+            # And its stack is genuinely absent (no silent stale copy).
+            assert not any(
+                any(any("wedge_probe" in f for f in th["frames"])
+                    for th in r["threads"]) for r in dump["stacks"])
+        finally:
+            try:
+                os.kill(pid, signal.SIGCONT)
+            except ProcessLookupError:
+                # The stopped worker can be reaped out from under us (e.g.
+                # external memory pressure); the retry below still
+                # completes the task on a fresh worker.
+                pass
+        open(flag, "w").close()
+        assert ray_tpu.get(ref, timeout=60) == "ok"
+
+
 class TestClusterFailover:
     def test_task_infeasible_until_node_joins(self, cluster):
         @ray_tpu.remote(resources={"gadget": 1})

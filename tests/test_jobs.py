@@ -83,7 +83,13 @@ class TestJobServerAndClient:
                         "\"import time\nfor i in range(100):\n"
                         "    print('tick', i, flush=True)\n"
                         "    time.sleep(0.1)\""))
-        time.sleep(1.0)
+        # Wait for output rather than a fixed sleep: under load the
+        # interpreter can take >1s to boot, and stopping before the first
+        # tick makes the log assertion racy.
+        deadline = time.monotonic() + 30
+        while "tick" not in client.get_job_logs(sid):
+            assert time.monotonic() < deadline, "job never produced output"
+            time.sleep(0.2)
         assert client.stop_job(sid)
         assert client.get_job_status(sid) == "STOPPED"
         assert "tick" in client.get_job_logs(sid)
@@ -91,6 +97,22 @@ class TestJobServerAndClient:
     def test_cluster_status(self, client):
         s = client.cluster_status()
         assert s["nodes"] and "CPU" in s["total_resources"]
+        # Operator-health fields for `ray-tpu status` (watchdog/goodput
+        # are None until a training run has been observed, but the keys
+        # are always present).
+        assert "goodput" in s and "watchdog" in s
+
+    def test_cluster_stacks_and_debug_dump(self, client):
+        # `ray-tpu stack` surface: the driver record is always there.
+        dump = client._request("GET", "/api/cluster/stacks?timeout_s=3")
+        assert any(r.get("is_driver") for r in dump["stacks"])
+        assert "unresponsive" in dump
+        # `ray-tpu debug dump` surface: writes a bundle, returns its path.
+        out = client._request("POST",
+                              "/api/cluster/debug_dump?reason=resttest")
+        assert os.path.isdir(out["path"])
+        assert "resttest" in os.path.basename(out["path"])
+        assert "manifest.json" in os.listdir(out["path"])
 
     def test_missing_job_404(self, client):
         with pytest.raises(RuntimeError, match="404"):

@@ -57,6 +57,22 @@ class TestCatalog:
         with pytest.raises(TypeError):
             telemetry.counter("ray_tpu_train_goodput_ratio")
 
+    def test_watchdog_diagnostics_series_registered(self):
+        """The watchdog's verdict counters follow the catalog naming
+        scheme (PR 2 diagnostics series ride the same lint as PR 1's)."""
+        for name in ("ray_tpu_train_straggler_total",
+                     "ray_tpu_train_hang_total"):
+            assert name in telemetry.CATALOG, name
+            spec = telemetry.CATALOG[name]
+            assert spec["type"] == "counter", name
+            assert name.endswith("_total"), name
+            assert _NAME_RE.match(name), name
+            assert name.split("_")[2] == "train", name
+            assert spec["description"].strip()
+        # The exception-safe helper records them without raising.
+        telemetry.inc("ray_tpu_train_straggler_total", 0.0)
+        telemetry.inc("ray_tpu_train_hang_total", 0.0)
+
 
 def _base_series(prom_text):
     """Distinct catalog-level metric names present in an exposition."""
