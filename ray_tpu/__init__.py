@@ -25,8 +25,17 @@ lazily so that worker processes and non-jax users never pay jax import cost.
 
 from __future__ import annotations
 
+import os as _os
 import threading
 from typing import Any, Dict, Optional
+
+# Opt-in runtime lock-order detector (devtools/lockdebug.py).  Installed
+# BEFORE the _private imports so the wrappers catch module-level framework
+# locks too, not just ones created after init().  Workers inherit the env
+# var, so the whole cluster is instrumented consistently.
+if _os.environ.get("RAY_TPU_DEBUG_LOCKS") == "1":
+    from .devtools import lockdebug as _lockdebug
+    _lockdebug.install()
 
 from ._private import runtime as _runtime_mod
 from ._private.api import (ActorClass, ActorHandle, ActorMethod, ObjectRef,

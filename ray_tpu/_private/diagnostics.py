@@ -152,6 +152,17 @@ def write_debug_bundle(rt, reason: str,
         return json.dumps(g, indent=1) if g is not None else None
     section("goodput.json", _goodput)
 
+    def _locks():
+        # Lock-order detector findings (RAY_TPU_DEBUG_LOCKS=1): written
+        # whenever the detector is active or has recorded anything, so a
+        # deadlock bundle carries the acquisition-order story.
+        from ray_tpu.devtools import lockdebug
+        rep = lockdebug.report()
+        if not rep["installed"] and not rep["findings"]:
+            return None
+        return json.dumps(rep, indent=1, default=str)
+    section("lock_findings.json", _locks)
+
     section("manifest.json", lambda: json.dumps({
         "reason": reason,
         "time": time.time(),

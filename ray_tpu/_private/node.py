@@ -43,6 +43,7 @@ from .protocol import (ActorStateMsg, AllocReply, AllocRequest,
                        SubmitFromWorker, TaskDone, TaskSpec, WaitRequest,
                        WorkerReady)
 from .resources import ResourceSet, TPU
+from ..util import telemetry
 
 IDLE = "idle"
 BUSY = "busy"
@@ -188,7 +189,7 @@ class NodeManager:
             if self._closed:
                 try:
                     conn.close()
-                except Exception:  # noqa: BLE001
+                except Exception:  # ray-tpu: noqa[RT202] — teardown close
                     pass
                 return
             try:
@@ -497,14 +498,14 @@ class NodeManager:
         try:
             if handle.proc.poll() is None:
                 handle.proc.kill()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:
+            telemetry.note_swallowed("node.kill_worker", e)
 
         def _reap(h=handle):
             try:
                 h.proc.wait(timeout=60)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:
+                telemetry.note_swallowed("node.reap_worker", e)
             time.sleep(1.0)
             if h.state != DEAD:
                 self._on_worker_death(h)
@@ -980,8 +981,9 @@ class NodeManager:
                         if h.proc.poll() is None:
                             try:
                                 h.proc.terminate()
-                            except Exception:
-                                pass
+                            except Exception as e:
+                                telemetry.note_swallowed(
+                                    "node.ensure_dead", e)
                     t = threading.Timer(2.0, _ensure_dead)
                     t.daemon = True
                     t.start()
@@ -1150,12 +1152,12 @@ class NodeManager:
             snap = self.memory_monitor.snapshot()
             view["memory_used_bytes"] = snap.used_bytes
             view["memory_total_bytes"] = snap.total_bytes
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:
+            telemetry.note_swallowed("node.local_view", e)
         try:
             view["store_bytes_used"] = int(self.store.stats()["used_bytes"])
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:
+            telemetry.note_swallowed("node.local_view", e)
         return view
 
     def prestart_workers(self, n: int) -> None:
@@ -1189,7 +1191,7 @@ class NodeManager:
         self._poller.join(timeout=3.0)
         try:
             self._listener.close()
-        except Exception:
+        except Exception:  # ray-tpu: noqa[RT202] — best-effort teardown
             pass
         try:
             os.close(self._poll_wake_w)
@@ -1204,7 +1206,7 @@ class NodeManager:
             try:
                 if h.conn is not None:
                     h.conn.close()
-            except Exception:
+            except Exception:  # ray-tpu: noqa[RT202] — best-effort teardown
                 pass
             if h.proc.poll() is None:
                 h.proc.terminate()

@@ -18,7 +18,7 @@ Value descriptors (how an argument/return travels):
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from .ids import ActorID, ObjectID, PlacementGroupID, TaskID, WorkerID
@@ -155,20 +155,9 @@ class KillWorker:
 
 
 @dataclass
-class CancelTask:
-    task_id: TaskID
-    force: bool = False
-
-
-@dataclass
 class WorkerReady:
     worker_id: WorkerID
     pid: int
-
-
-@dataclass
-class FreeObjects:
-    object_ids: List[ObjectID] = field(default_factory=list)
 
 
 @dataclass

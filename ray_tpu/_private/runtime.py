@@ -44,6 +44,7 @@ from .protocol import (ActorStateMsg, GetReply, GetRequest, PutFromWorker,
                        WaitRequest)
 from .resources import CPU, TPU, ResourceSet
 from .scheduler import ClusterScheduler
+from ..util import telemetry
 
 _runtime_lock = threading.Lock()
 _global_runtime: Optional["Runtime"] = None
@@ -391,7 +392,9 @@ class Runtime:
         self._stack_dump_seq = 0
         self._stack_dumps: Dict[int, Dict[str, Any]] = {}
         # Rate limiter for the worker-death flight recorder.
-        self._last_death_bundle = 0.0
+        # None = no bundle written yet (0.0 would suppress the first
+        # bundle on a freshly booted host: monotonic ~= uptime).
+        self._last_death_bundle: Optional[float] = None
 
         # -- multi-node cluster plane (reference: gcs_node_manager.h node
         # registration + object_manager pull/push; see cluster.py) -------- #
@@ -746,14 +749,14 @@ class Runtime:
                 # A pulled copy may be cached in the head store too.
                 try:
                     self.node.store.delete(oid)
-                except Exception:
-                    pass
+                except Exception as e:
+                    telemetry.note_swallowed("runtime.free_object", e)
             shm = self._mapped_segments.pop(oid, None)
             if shm is not None:
                 try:
                     shm.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    telemetry.note_swallowed("runtime.free_object", e)
             if st is not None and st.desc and st.desc[0] == "shma":
                 if oid in self._arena_pins:
                     self._arena_pins.discard(oid)
@@ -817,13 +820,13 @@ class Runtime:
                 else:
                     try:
                         self._view_dead(it[1])
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        telemetry.note_swallowed("runtime.ref_gc", e)
             if drops:
                 try:
                     self._apply_ref_drops(drops)
-                except Exception:
-                    pass
+                except Exception as e:
+                    telemetry.note_swallowed("runtime.ref_gc", e)
             if done or self._shutdown:
                 return
 
@@ -1007,18 +1010,18 @@ class Runtime:
             if shm is not None:
                 try:
                     shm.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    telemetry.note_swallowed("runtime.reconstruct_cleanup", e)
             if rid in self._arena_pins:
                 self._arena_pins.discard(rid)
                 try:
                     self.node.store.unpin_key(rid.binary())
-                except Exception:
-                    pass
+                except Exception as e:
+                    telemetry.note_swallowed("runtime.reconstruct_cleanup", e)
             try:
                 self.node.store.delete(rid)
-            except Exception:
-                pass
+            except Exception as e:
+                telemetry.note_swallowed("runtime.reconstruct_cleanup", e)
             self._state(rid).reset()
         with self._ref_lock:
             self._escaped.add(oid)  # recovered objects stay pinned
@@ -1576,8 +1579,8 @@ class Runtime:
             err_repr = None
             try:
                 err_repr = repr(serialization.unpack_payload(error[1]))
-            except Exception:
-                pass
+            except Exception as e:
+                telemetry.note_swallowed("runtime.error_repr", e)
             self.events.record(TaskID(t[1]).hex(), FAILED, name=name,
                                task_type="ACTOR_TASK", actor_id=aid.hex(),
                                error_message=err_repr)
@@ -1670,8 +1673,8 @@ class Runtime:
                 err = None
                 try:
                     err = repr(serialization.unpack_payload(msg.error[1]))
-                except Exception:
-                    pass
+                except Exception as e:
+                    telemetry.note_swallowed("runtime.error_repr", e)
                 self.events.record(msg.task_id.hex(), FAILED,
                                    error_message=err)
                 self._export_event("EXPORT_TASK", {
@@ -1991,8 +1994,8 @@ class Runtime:
                     exc = serialization.unpack_payload(msg.error[1])
                     inner = getattr(exc, "cause", exc)
                     cause = f"creation failed: {type(inner).__name__}: {inner}"
-                except Exception:
-                    pass
+                except Exception as e:
+                    telemetry.note_swallowed("runtime.error_repr", e)
             self.controller.set_actor_state(msg.actor_id, DEAD,
                                             death_cause=cause)
             ast = self._actor_state(msg.actor_id)
@@ -2452,8 +2455,8 @@ class Runtime:
     def _export_event(self, source_type: str, event: Dict[str, Any]) -> None:
         try:
             self.export_events.write(source_type, event)
-        except Exception:  # noqa: BLE001 — forensics never fail the caller
-            pass
+        except Exception as e:  # forensics never fail the caller
+            telemetry.note_swallowed("runtime.export_event", e)
 
     def _maybe_death_bundle(self, reason: str,
                             extra: Dict[str, Any]) -> None:
@@ -2463,7 +2466,8 @@ class Runtime:
         if self._shutdown or not Config.get("debug_bundle_on_worker_death"):
             return
         now = time.monotonic()
-        if now - self._last_death_bundle < Config.get(
+        if self._last_death_bundle is not None and \
+                now - self._last_death_bundle < Config.get(
                 "debug_bundle_min_interval_s"):
             return
         self._last_death_bundle = now
@@ -2473,8 +2477,8 @@ class Runtime:
                 from .diagnostics import write_debug_bundle
                 write_debug_bundle(self, reason, capture_stacks=False,
                                    extra=extra)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:
+                telemetry.note_swallowed("runtime.death_bundle", e)
         threading.Thread(target=run, name="death-bundle",
                          daemon=True).start()
 
@@ -2569,8 +2573,8 @@ class Runtime:
                 # Compact so the next start replays a snapshot instead of
                 # the whole WAL.
                 self.state_store.compact(self.controller.snapshot_records())
-            except Exception:
-                pass
+            except Exception as e:
+                telemetry.note_swallowed("runtime.shutdown_compact", e)
             self.state_store.close()
         self.log_monitor.stop()
         self.log_monitor.poll_once()  # flush buffered worker output
@@ -2578,7 +2582,7 @@ class Runtime:
         for shm in self._mapped_segments.values():
             try:
                 shm.close()
-            except Exception:
+            except Exception:  # ray-tpu: noqa[RT202] — best-effort teardown
                 pass
         self._mapped_segments.clear()
         self.controller.finish_job(self.job_id)

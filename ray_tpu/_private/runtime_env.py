@@ -214,7 +214,10 @@ def _ensure_pip_env(requirements: List[str], session_dir: str) -> str:
     dest = os.path.join(session_dir, "runtime_env", f"venv_{sig}")
     with _PIP_LOCKS_GUARD:
         lock = _PIP_LOCKS.setdefault(sig, threading.Lock())
-    with lock, _file_lock(dest + ".lock"):
+    # The venv build intentionally runs under the per-signature lock:
+    # holding it IS the dedup (only same-env requests convoy, and they
+    # must — the alternative is N racing builds of one venv).
+    with lock, _file_lock(dest + ".lock"):  # ray-tpu: noqa[RT201]
         if os.path.isdir(dest):
             return dest
         tmp = dest + ".tmp"

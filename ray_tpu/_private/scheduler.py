@@ -30,6 +30,7 @@ from .controller import (Controller, NodeInfo, PlacementGroupInfo, PG_CREATED,
 from .ids import NodeID, ObjectID, PlacementGroupID, TaskID
 from .protocol import TaskSpec
 from .resources import ResourceSet
+from ..util import telemetry
 
 PACK = "PACK"
 SPREAD = "SPREAD"
@@ -222,8 +223,8 @@ class ClusterScheduler:
             if self.on_dispatch_error is not None:
                 try:
                     self.on_dispatch_error(spec, exc)
-                except Exception:
-                    pass
+                except Exception as e:
+                    telemetry.note_swallowed("scheduler.on_dispatch_error", e)
 
     def exchange_finished(self, node_id: NodeID,
                           spec: TaskSpec) -> Optional[_PendingTask]:

@@ -62,8 +62,11 @@ class Instance:
     ray_node_id: str = ""       # head's node id once joined
     os_pid: int = 0             # join matching (fake/subprocess providers)
     version: int = 0            # bumps on every persisted transition
-    updated_at: float = field(default_factory=time.time)
-    history: List[Tuple[str, float]] = field(default_factory=list)
+    # Monotonic: feeds the request-timeout interval math in
+    # _sync_cloud_state (an NTP step must not expire a launch early).
+    # Never persisted; a restarted process re-stamps on load.
+    updated_at: float = field(default_factory=time.monotonic)
+    history: List[Tuple[str, float]] = field(default_factory=list)  # wall
 
 
 @dataclass
@@ -142,7 +145,7 @@ class InstanceStore:
                 inst.history.append((inst.status, time.time()))
                 inst.status = status
             inst.version += 1
-            inst.updated_at = time.time()
+            inst.updated_at = time.monotonic()
             self._instances[inst.instance_id] = inst
             if self._path:
                 rec = {"instance_id": inst.instance_id,
@@ -229,7 +232,7 @@ class InstanceManager:
             by_cloud_id[ci.cloud_id] = ci
         live_ids = {cid for cid, ci in by_cloud_id.items()
                     if ci.status not in ("terminated", "failed")}
-        now = time.time()
+        now = time.monotonic()
         for inst in self.store.all():
             if inst.status in _TERMINAL:
                 continue
@@ -392,10 +395,10 @@ class FakeCloudProvider(CloudProvider):
                 cid = f"{request_id}-{i}"
                 self._instances[cid] = CloudInstance(
                     cid, request_id, node_type, "queued", os_pid=0)
-                self._created_at[cid] = time.time()
+                self._created_at[cid] = time.monotonic()
 
     def describe(self) -> List[CloudInstance]:
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             out = []
             for cid, ci in self._instances.items():

@@ -1,0 +1,28 @@
+"""Framework-aware developer tooling: static analysis + lock diagnostics.
+
+Two halves (reference: the semgrep/pyrefly CI rules and absl mutex
+annotations the reference repo leans on — here the discipline is in-tree
+and understands ``ray_tpu`` semantics):
+
+* ``ray_tpu.devtools.lint`` — an AST rule engine behind ``ray-tpu lint``.
+  User-code rules (RT1xx) catch the documented anti-patterns — blocking
+  ``get()`` inside a ``@remote`` body, ``get()``-per-item loops, large or
+  unserializable captures, actor self-calls.  Framework-internal rules
+  (RT2xx) enforce invariants over ``ray_tpu/`` itself — no blocking call
+  under a lock, no silently swallowed exceptions in the control plane,
+  monotonic-clock durations, telemetry names from the catalog, protocol
+  messages with registered handlers.  ``tests/test_lint.py`` keeps the
+  tree self-lint-clean (tier-1 gate).
+
+* ``ray_tpu.devtools.lockdebug`` — an opt-in runtime lock-order detector
+  (``RAY_TPU_DEBUG_LOCKS=1``): instrumented ``threading.Lock``/``RLock``
+  wrappers build a per-process acquisition-order graph, flag cycles
+  (AB/BA potential deadlocks) and sleeps under a held lock, and feed the
+  findings into the flight-recorder debug bundle.
+"""
+
+from .lint import (Finding, LintResult, Rule, iter_rules, lint_paths,
+                   lint_source)
+
+__all__ = ["Finding", "LintResult", "Rule", "iter_rules", "lint_paths",
+           "lint_source"]

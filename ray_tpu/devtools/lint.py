@@ -1,0 +1,286 @@
+"""AST rule engine behind ``ray-tpu lint``.
+
+The engine is deliberately small: a rule is an object with an ``id``, a
+``scope`` and a ``check(ctx)`` generator over one parsed module.  Rules
+self-register at import (``rules_user`` / ``rules_internal`` at the
+bottom of this file), findings are suppressible per line with
+``# ray-tpu: noqa[RT201]`` (or a bare ``# ray-tpu: noqa`` for all
+rules), and output is text or JSON.
+
+Scopes:
+
+* ``user`` rules understand ``ray_tpu`` *usage* (anti-patterns from the
+  docs: nested blocking ``get``, ``get``-in-a-loop, bad captures) and
+  run over every linted file.
+* ``internal`` rules are invariants of the framework's own source
+  (locks, swallowed exceptions, monotonic clocks, telemetry catalog,
+  protocol completeness) and only run on files inside the ``ray_tpu``
+  package tree (auto-detected from the path; override with
+  ``internal=``).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+_NOQA_RE = re.compile(
+    r"#\s*ray-tpu:\s*noqa(?:\[(?P<rules>[A-Za-z0-9_,\s]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    #: Additional lines where a ``# ray-tpu: noqa`` suppresses this
+    #: finding (e.g. the ``with`` statement owning a blocking call).
+    anchor_lines: Tuple[int, ...] = ()
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message}"
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    files_checked: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+class ModuleContext:
+    """One parsed module handed to every rule."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str,
+                 internal: bool):
+        self.tree = tree
+        self.source = source
+        self.lines = source.splitlines()
+        self.path = path
+        # Normalized forward-slash path for module-identity checks
+        # (e.g. RT202's control-plane set, RT205's anchor file).
+        self.module_key = path.replace(os.sep, "/")
+        self.internal = internal
+        self._by_type: Optional[Dict[type, List[ast.AST]]] = None
+
+    def nodes(self, *types: type) -> List[ast.AST]:
+        """All nodes of the given AST types, from ONE shared full-tree
+        walk (rules iterating ast.walk() independently dominated lint
+        wall time; the index makes each rule a dict lookup)."""
+        if self._by_type is None:
+            by_type: Dict[type, List[ast.AST]] = {}
+            for node in ast.walk(self.tree):
+                by_type.setdefault(type(node), []).append(node)
+            self._by_type = by_type
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, ()))
+        return out
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str,
+                anchors: Sequence[ast.AST] = ()) -> Finding:
+        return Finding(rule.id, self.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0) + 1, message,
+                       tuple(getattr(a, "lineno", 1) for a in anchors))
+
+
+class Rule:
+    """Base class; subclasses set the metadata and implement check()."""
+
+    id: str = "RT000"
+    summary: str = ""
+    rationale: str = ""
+    scope: str = "user"  # "user" | "internal"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_RULES: List[Rule] = []
+
+
+def register(cls):
+    _RULES.append(cls())
+    return cls
+
+
+def iter_rules() -> List[Rule]:
+    return list(_RULES)
+
+
+# -- shared AST helpers (used by the rule modules) --------------------------
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node`` without descending into nested function/class
+    bodies (code that does not execute in the enclosing scope)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+# -- noqa suppression -------------------------------------------------------
+
+
+def _noqa_map(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> suppressed rule ids (None = all rules)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        if "ray-tpu" not in line:
+            continue
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        rules = m.group("rules")
+        if rules is None:
+            out[i] = None
+        else:
+            ids = {r.strip().upper() for r in rules.split(",") if r.strip()}
+            prev = out.get(i, set())
+            out[i] = None if prev is None else (prev or set()) | ids
+    return out
+
+
+def _suppressed(f: Finding, noqa: Dict[int, Optional[Set[str]]]) -> bool:
+    for line in (f.line,) + f.anchor_lines:
+        if line in noqa:
+            allowed = noqa[line]
+            if allowed is None or f.rule in allowed:
+                return True
+    return False
+
+
+# -- running ----------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<snippet>",
+                internal: bool = False,
+                rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("RT001", path, e.lineno or 1, (e.offset or 0) + 1,
+                        f"syntax error: {e.msg}")]
+    ctx = ModuleContext(tree, source, path, internal)
+    noqa = _noqa_map(source)
+    out: List[Finding] = []
+    for rule in (rules if rules is not None else _RULES):
+        if rule.scope == "internal" and not internal:
+            continue
+        for f in rule.check(ctx):
+            if not _suppressed(f, noqa):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def _is_internal_path(path: str) -> bool:
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    return "ray_tpu" in parts
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__" and not d.startswith("."))
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    yield os.path.join(root, fname)
+
+
+def lint_paths(paths: Sequence[str],
+               internal: Optional[bool] = None,
+               rules: Optional[Sequence[Rule]] = None) -> LintResult:
+    """Lint files/directories.  ``internal=None`` auto-detects per file:
+    internal rules apply to files living under a ``ray_tpu`` package
+    directory."""
+    findings: List[Finding] = []
+    n = 0
+    # A missing input is a loud error, never a green no-op: a typo'd CI
+    # path must not turn the lint gate into `0 findings in 0 files`.
+    for p in paths:
+        if not os.path.exists(p):
+            findings.append(Finding("RT002", p, 1, 1,
+                                    "no such file or directory"))
+    for fpath in iter_python_files(paths):
+        n += 1
+        try:
+            with open(fpath, encoding="utf-8", errors="replace") as f:
+                source = f.read()
+        except OSError as e:
+            findings.append(Finding("RT002", fpath, 1, 1,
+                                    f"unreadable file: {e}"))
+            continue
+        is_internal = _is_internal_path(fpath) if internal is None \
+            else internal
+        findings.extend(lint_source(source, fpath, internal=is_internal,
+                                    rules=rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(findings, n)
+
+
+# -- output -----------------------------------------------------------------
+
+
+def format_text(result: LintResult) -> str:
+    lines = [f.render() for f in result.findings]
+    lines.append(f"{len(result.findings)} finding(s) in "
+                 f"{result.files_checked} file(s)")
+    return "\n".join(lines)
+
+
+def format_json(result: LintResult) -> str:
+    return json.dumps({
+        "version": 1,
+        "files_checked": result.files_checked,
+        "findings": [f.to_dict() for f in result.findings],
+    }, indent=1)
+
+
+def rule_catalog_text() -> str:
+    lines = []
+    for rule in _RULES:
+        lines.append(f"{rule.id} [{rule.scope}] {rule.summary}")
+        if rule.rationale:
+            lines.append(f"    {rule.rationale}")
+    return "\n".join(lines)
+
+
+# Rule modules self-register on import; they import helpers from this
+# module, so this must stay at the bottom.
+from . import rules_internal, rules_user  # noqa: E402,F401

@@ -1,0 +1,339 @@
+"""Opt-in runtime lock-order detector (``RAY_TPU_DEBUG_LOCKS=1``).
+
+Static analysis (RT201) catches blocking calls lexically inside a
+``with lock:`` block; orderings that only exist at runtime — lock A
+taken in one module, lock B in another, reversed on a third path —
+need instrumentation.  ``install()`` replaces ``threading.Lock`` /
+``threading.RLock`` with wrappers that maintain:
+
+* a per-thread stack of currently held locks,
+* a process-wide acquisition-order graph (edge ``A -> B``: some thread
+  acquired B while holding A).  A new edge that closes a cycle is a
+  potential deadlock (the classic AB/BA) and is recorded as a finding
+  with both acquisition sites,
+* a patched ``time.sleep`` that records sleeping while holding any
+  instrumented lock (the runtime twin of RT201).
+
+Findings land in ``report()`` and are picked up by the flight recorder
+(``diagnostics.write_debug_bundle`` writes ``lock_findings.json``), so
+a watchdog-triggered bundle of a wedged run carries the lock story.
+
+The detector is a debugging tool: it is conservative about overhead
+(one dict lookup per acquire; stacks only on *new* edges) but is not
+meant for production hot paths — hence the env-var opt-in.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+_real_Lock = threading.Lock
+_real_RLock = threading.RLock
+_real_sleep = time.sleep
+
+_installed = False
+
+#: Frames of acquisition stack kept per new edge / finding.
+_STACK_DEPTH = 6
+
+
+class _State:
+    def __init__(self):
+        self.mu = _real_Lock()
+        self.seq = 0
+        # edge (holder_name, acquired_name) -> info dict
+        self.edges: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.findings: List[Dict[str, Any]] = []
+        self.seen_cycles: set = set()
+        self.seen_blocking: set = set()
+        # (owner_tid, lock_id) for plain Locks released by a thread
+        # other than their acquirer (legal handoff pattern): the owner's
+        # held list is pruned lazily at its next acquire/sleep so the
+        # phantom entry cannot mint bogus edges or sleep findings.
+        self.foreign_released: set = set()
+
+
+_state = _State()
+_tls = threading.local()
+
+
+def _held() -> List[Tuple["_DebugLockBase", int]]:
+    """This thread's held-lock stack: (lock, depth) entries, pruned of
+    locks another thread has since released on our behalf."""
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    if h and _state.foreign_released:
+        tid = threading.get_ident()
+        with _state.mu:
+            doomed = {lid for t, lid in _state.foreign_released
+                      if t == tid}
+            if doomed:
+                _state.foreign_released -= {(tid, lid) for lid in doomed}
+        if doomed:
+            h[:] = [(l, d) for l, d in h if id(l) not in doomed]
+    return h
+
+
+def _caller_site(skip: int = 2) -> str:
+    """First frame OUTSIDE this module (so with-statement acquires point
+    at the user line, not at __enter__)."""
+    try:
+        f = sys._getframe(skip)
+        while f is not None and f.f_code.co_filename == __file__:
+            f = f.f_back
+        if f is None:
+            return "<unknown>"
+        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+    except Exception:
+        return "<unknown>"
+
+
+def _short_stack() -> List[str]:
+    return [ln.strip().replace("\n", " | ")
+            for ln in traceback.format_stack()[-_STACK_DEPTH - 2:-2]]
+
+
+def _find_cycle(start: str, target: str) -> Optional[List[str]]:
+    """Path ``start -> ... -> target`` through the edge graph (the new
+    edge target->start then closes the cycle)."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in _state.edges:
+        adj.setdefault(a, []).append(b)
+    path = [start]
+    seen = {start}
+
+    def dfs(node: str) -> bool:
+        if node == target:
+            return True
+        for nxt in adj.get(node, ()):
+            if nxt in seen:
+                continue
+            seen.add(nxt)
+            path.append(nxt)
+            if dfs(nxt):
+                return True
+            path.pop()
+        return False
+
+    return path if dfs(start) else None
+
+
+def _note_acquire(lock: "_DebugLockBase") -> None:
+    held = _held()
+    for i, (prev, depth) in enumerate(held):
+        if prev is lock:  # reentrant re-acquire: no new ordering info
+            held[i] = (prev, depth + 1)
+            return
+    site = _caller_site(3)
+    new_edges = []
+    with _state.mu:
+        for prev, _depth in held:
+            key = (prev.name, lock.name)
+            info = _state.edges.get(key)
+            if info is None:
+                _state.edges[key] = {
+                    "holder": prev.name, "acquired": lock.name,
+                    "thread": threading.current_thread().name,
+                    "site": site, "stack": _short_stack(), "count": 1}
+                new_edges.append(key)
+            else:
+                info["count"] += 1
+        for a, b in new_edges:
+            # b already reaches a through older edges? then a->b closes
+            # a cycle: two threads interleaving those orders deadlock.
+            cycle = _find_cycle(b, a)
+            if not cycle:
+                continue
+            cycle_key = frozenset(cycle)
+            if cycle_key in _state.seen_cycles:
+                continue
+            _state.seen_cycles.add(cycle_key)
+            _state.findings.append({
+                "kind": "lock_cycle",
+                "cycle": cycle + [b],
+                "edges": [dict(_state.edges[e])
+                          for e in _state.edges
+                          if e[0] in cycle_key and e[1] in cycle_key],
+                "thread": threading.current_thread().name,
+                "site": site,
+            })
+    held.append((lock, 1))
+
+
+def _note_release(lock: "_DebugLockBase") -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        prev, depth = held[i]
+        if prev is lock:
+            if depth > 1:
+                held[i] = (prev, depth - 1)
+            else:
+                del held[i]
+            return
+
+
+class _DebugLockBase:
+    _kind = "Lock"
+
+    def __init__(self):
+        with _state.mu:
+            _state.seq += 1
+            n = _state.seq
+        self._inner = self._make_inner()
+        self.name = f"{self._kind}#{n}@{_caller_site(2)}"
+
+    def _make_inner(self):
+        return _real_Lock()
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            _note_acquire(self)
+        return got
+
+    def release(self):
+        _note_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        fn = getattr(self._inner, "locked", None)
+        return fn() if fn is not None else False
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class _DebugLock(_DebugLockBase):
+    _kind = "Lock"
+
+    # Unlike RLock, a plain Lock may legally be released by a thread
+    # that did not acquire it (handoff/signal pattern).  Track the
+    # acquiring thread so a foreign release queues a prune of the
+    # owner's held list instead of silently leaving a phantom entry.
+
+    def acquire(self, *args, **kwargs):
+        got = super().acquire(*args, **kwargs)
+        if got:
+            self._owner_ident = threading.get_ident()
+        return got
+
+    def release(self):
+        owner = getattr(self, "_owner_ident", None)
+        self._owner_ident = None
+        if owner is not None and owner != threading.get_ident():
+            with _state.mu:
+                _state.foreign_released.add((owner, id(self)))
+            self._inner.release()
+        else:
+            _note_release(self)
+            self._inner.release()
+
+
+class _DebugRLock(_DebugLockBase):
+    """RLock wrapper: also forwards the protocol Condition uses so
+    ``threading.Condition(rlock)`` keeps exact reentrant semantics."""
+
+    _kind = "RLock"
+
+    def _make_inner(self):
+        return _real_RLock()
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        _note_release(self)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        _note_acquire(self)
+
+
+def _debug_sleep(seconds):
+    held = _held()
+    if held:
+        site = _caller_site(2)
+        key = (site, tuple(l.name for l, _d in held))
+        with _state.mu:
+            if key not in _state.seen_blocking:
+                _state.seen_blocking.add(key)
+                _state.findings.append({
+                    "kind": "blocking_under_lock",
+                    "blocking_call": f"time.sleep({seconds!r})",
+                    "held_locks": [l.name for l, _d in held],
+                    "thread": threading.current_thread().name,
+                    "site": site,
+                    "stack": _short_stack(),
+                })
+    return _real_sleep(seconds)
+
+
+# -- public API -------------------------------------------------------------
+
+
+def install() -> None:
+    """Patch ``threading.Lock``/``RLock`` (locks created from now on are
+    instrumented) and ``time.sleep``.  Idempotent."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    threading.Lock = _DebugLock  # type: ignore[misc]
+    threading.RLock = _DebugRLock  # type: ignore[misc]
+    time.sleep = _debug_sleep
+
+
+def uninstall() -> None:
+    """Restore the real primitives (already-created wrappers keep
+    working: they delegate to real locks)."""
+    global _installed
+    if not _installed:
+        return
+    _installed = False
+    threading.Lock = _real_Lock  # type: ignore[misc]
+    threading.RLock = _real_RLock  # type: ignore[misc]
+    time.sleep = _real_sleep
+
+
+def is_installed() -> bool:
+    return _installed
+
+
+def findings() -> List[Dict[str, Any]]:
+    with _state.mu:
+        return [dict(f) for f in _state.findings]
+
+
+def clear() -> None:
+    with _state.mu:
+        _state.edges.clear()
+        _state.findings.clear()
+        _state.seen_cycles.clear()
+        _state.seen_blocking.clear()
+        _state.foreign_released.clear()
+
+
+def report() -> Dict[str, Any]:
+    """Snapshot for the flight recorder's ``lock_findings.json``."""
+    with _state.mu:
+        return {
+            "installed": _installed,
+            "pid": os.getpid(),
+            "edges": len(_state.edges),
+            "findings": [dict(f) for f in _state.findings],
+        }

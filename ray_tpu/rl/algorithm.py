@@ -144,7 +144,7 @@ class Algorithm:
     def __init__(self, config: AlgorithmConfig):
         self.config = config
         self.iteration = 0
-        self._start = time.time()
+        self._start = time.monotonic()  # duration base: NTP-immune
         self.env_runner_group: Optional[EnvRunnerGroup] = None
         if self._use_env_runner_group:
             self.env_runner_group = EnvRunnerGroup(
@@ -169,15 +169,15 @@ class Algorithm:
 
     def train(self) -> Dict[str, Any]:
         """One iteration (reference: Algorithm.step:1169)."""
-        t0 = time.time()
+        t0 = time.monotonic()
         results = self.training_step()
         self.iteration += 1
         if self.env_runner_group is not None:
             results.setdefault("env_runners",
                                self.env_runner_group.aggregate_metrics())
         results["training_iteration"] = self.iteration
-        results["time_this_iter_s"] = time.time() - t0
-        results["time_total_s"] = time.time() - self._start
+        results["time_this_iter_s"] = time.monotonic() - t0
+        results["time_total_s"] = time.monotonic() - self._start
         return results
 
     def get_weights(self):

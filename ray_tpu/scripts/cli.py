@@ -301,6 +301,42 @@ def stack(address, timeout, output):
     click.echo(format_stack_dump(dump))
 
 
+@cli.command()
+@click.argument("paths", nargs=-1)
+@click.option("--format", "fmt", type=click.Choice(["text", "json"]),
+              default="text", show_default=True)
+@click.option("--list-rules", is_flag=True,
+              help="Print the rule catalog and exit.")
+@click.option("--internal/--no-internal", "internal", default=None,
+              help="Force framework-internal rules on/off (default: "
+                   "auto-detect per file — on for files inside a "
+                   "ray_tpu package tree).")
+def lint(paths, fmt, list_rules, internal):
+    """Framework-aware static analysis (see README "Static analysis").
+
+    Checks user code for ray_tpu anti-patterns (blocking get() inside
+    @remote, get()-in-a-loop, bad captures, actor self-calls) and — on
+    the framework's own tree — internal invariants (no blocking under a
+    lock, no swallowed control-plane exceptions, monotonic durations,
+    telemetry catalog names, protocol handler completeness).  Exits
+    non-zero when findings remain; suppress a line with
+    `# ray-tpu: noqa[RULE]`.
+    """
+    from ray_tpu.devtools import lint as lint_mod
+    if list_rules:
+        click.echo(lint_mod.rule_catalog_text())
+        return
+    if not paths:
+        paths = (".",)
+    result = lint_mod.lint_paths(list(paths), internal=internal)
+    if fmt == "json":
+        click.echo(lint_mod.format_json(result))
+    else:
+        click.echo(lint_mod.format_text(result))
+    if result.findings:
+        raise SystemExit(1)
+
+
 @cli.group()
 def debug():
     """Failure forensics (flight recorder)."""

@@ -39,8 +39,11 @@ class TrainContext:
         # failure-recovery restarts (seq restarts at 0 in a fresh worker).
         import uuid as _uuid
         self._incarnation = _uuid.uuid4().hex[:8]
-        # Telemetry: report-to-report interval = one observed step.
+        # Telemetry: report-to-report interval = one observed step.  The
+        # wall stamp anchors the timeline span; the interval itself is
+        # measured on the monotonic clock (NTP-immune).
         self._last_report_wall = time.time()
+        self._last_report_mono = time.monotonic()
 
     def get_world_rank(self) -> int:
         return self._rank
@@ -80,12 +83,20 @@ def report(metrics: Dict[str, Any],
     from .._private.api import _control
     from ..util import telemetry
     now = time.time()
+    now_mono = time.monotonic()
     ckpt_s = telemetry.pop_checkpoint_seconds()
     payload = {
         "metrics": dict(metrics),
         "rank": ctx.get_world_rank(),
         "seq": ctx._report_seq,
         "time": now,
+        # Same-process monotonic stamp: the watchdog measures this
+        # rank's report-to-report intervals from it (wall time steps
+        # under NTP; deltas of one process's monotonic clock do not).
+        # The incarnation scopes the stamp: a restarted worker's clock
+        # has a different base and must not be differenced.
+        "mono": now_mono,
+        "incarnation": ctx._incarnation,
         # Worker pid: lets the watchdog's stack auto-capture mark which
         # process record belongs to a flagged rank.
         "pid": os.getpid(),
@@ -94,14 +105,14 @@ def report(metrics: Dict[str, Any],
         # reattribution at the controller).
         "ckpt_seconds": ckpt_s,
     }
-    _note_step(ctx, now, metrics)
+    _note_step(ctx, now, now_mono, metrics)
     _control("kv_put",
              f"train/{ctx.run_id}/report/{ctx.get_world_rank()}/"
              f"{ctx._incarnation}/{ctx._report_seq}",
              pickle.dumps(payload))
 
 
-def _note_step(ctx: "TrainContext", now: float,
+def _note_step(ctx: "TrainContext", now: float, now_mono: float,
                metrics: Dict[str, Any]) -> None:
     """Built-in train metrics from the report stream: each rank-0
     report-to-report interval is one step (histogram + timeline span);
@@ -117,10 +128,13 @@ def _note_step(ctx: "TrainContext", now: float,
     # init/JIT compile, not a step (the controller's goodput tracker
     # accounts it as "init"); report-to-report starts at seq 2.
     if ctx.get_world_rank() == 0 and ctx._report_seq > 1:
-        dur = now - ctx._last_report_wall
+        dur = now_mono - ctx._last_report_mono
         if dur > 0:
             telemetry.observe("ray_tpu_train_step_seconds", dur)
+            # Span: wall anchor for position, monotonic length.
             telemetry._emit_span(
-                "train_step", "train", ctx._last_report_wall, now,
+                "train_step", "train", ctx._last_report_wall,
+                ctx._last_report_wall + dur,
                 extra={"seq": ctx._report_seq, "run_id": ctx.run_id})
     ctx._last_report_wall = now
+    ctx._last_report_mono = now_mono

@@ -226,7 +226,9 @@ class TrainController:
             payload = pickle.loads(data)
             self._reports.append(payload)
             self.watchdog.note_report(payload["rank"], payload["time"],
-                                      payload.get("pid"))
+                                      payload.get("pid"),
+                                      report_mono=payload.get("mono"),
+                                      incarnation=payload.get("incarnation"))
             if payload["rank"] == 0:
                 # Worker-measured checkpoint time happened inside what
                 # the driver observes as the "step" phase: reattribute.
@@ -248,108 +250,113 @@ class TrainController:
         carry_target: Optional[int] = None
         self.world_size_history: List[int] = []
         self.watchdog.start()
-        while True:
-            # First group formation is "init"; every re-formation after a
-            # failure is "restart" overhead (resizes count as restart too:
-            # the world re-forms and resumes from the checkpoint).
-            self.goodput.enter(
-                "init" if not self.world_size_history else "restart")
-            decision = self.policy.initial_decision(prefer=carry_target)
-            carry_target = None
-            world = decision.num_workers
-            self.world_size_history.append(world)
-            # Fresh incarnation: stale rank clocks must not trip on the
-            # re-formed group.
-            self.watchdog.reset_ranks()
-            group = self._start_group(world)
-            fn_blob = serialization.dumps_control(self.train_fn)
-            ctx_info = {
-                "storage_path": self.run_config.storage_path,
-                "experiment_name": self.run_config.name,
-                "latest_checkpoint": self.manager.latest(),
-                "num_slices": self.scaling.num_slices,
-            }
-            group.run_refs = [
-                w.run.remote(fn_blob, self.train_loop_config, ctx_info)
-                for w in group.workers]
-            self.goodput.enter("step")
-            t_step = time.monotonic()
-            error = None
-            resize_to: Optional[int] = None
-            last_elastic_check = time.monotonic()
-            pending = list(group.run_refs)
-            while pending:
-                done, pending = ray_tpu.wait(
-                    pending, num_returns=1, timeout=0.5)
+        try:
+            while True:
+                # First group formation is "init"; every re-formation after a
+                # failure is "restart" overhead (resizes count as restart too:
+                # the world re-forms and resumes from the checkpoint).
+                self.goodput.enter(
+                    "init" if not self.world_size_history else "restart")
+                decision = self.policy.initial_decision(prefer=carry_target)
+                carry_target = None
+                world = decision.num_workers
+                self.world_size_history.append(world)
+                # Fresh incarnation: stale rank clocks must not trip on the
+                # re-formed group.
+                self.watchdog.reset_ranks()
+                group = self._start_group(world)
+                fn_blob = serialization.dumps_control(self.train_fn)
+                ctx_info = {
+                    "storage_path": self.run_config.storage_path,
+                    "experiment_name": self.run_config.name,
+                    "latest_checkpoint": self.manager.latest(),
+                    "num_slices": self.scaling.num_slices,
+                }
+                group.run_refs = [
+                    w.run.remote(fn_blob, self.train_loop_config, ctx_info)
+                    for w in group.workers]
+                self.goodput.enter("step")
+                t_step = time.monotonic()
+                error = None
+                resize_to: Optional[int] = None
+                last_elastic_check = time.monotonic()
+                pending = list(group.run_refs)
+                while pending:
+                    done, pending = ray_tpu.wait(
+                        pending, num_returns=1, timeout=0.5)
+                    self._poll_reports()
+                    for ref in done:
+                        # A finished rank legitimately stops reporting — tell
+                        # the watchdog before its hang deadline can fire.
+                        try:
+                            self.watchdog.note_done(group.run_refs.index(ref))
+                        except ValueError:
+                            pass
+                        try:
+                            ray_tpu.get(ref)
+                        except Exception as e:  # noqa: BLE001
+                            error = e
+                            pending = []
+                            break
+                    # Elastic upsize check (reference: elastic.py monitor
+                    # decision): new capacity -> teardown + re-form the world
+                    # at the larger size, resuming from the latest checkpoint.
+                    if pending and error is None and \
+                            time.monotonic() - last_elastic_check >= \
+                            self.scaling.elastic_check_interval_s:
+                        last_elastic_check = time.monotonic()
+                        d = self.policy.monitor_decision(len(group.workers))
+                        if d is not None:
+                            # A crashed worker frees resources that look like
+                            # growth; drain already-failed refs first so a
+                            # crash takes the failure path (and max_failures
+                            # accounting), not the resize path.
+                            done_now, _ = ray_tpu.wait(
+                                pending, num_returns=len(pending), timeout=0)
+                            for ref in done_now:
+                                try:
+                                    ray_tpu.get(ref)
+                                except Exception as e:  # noqa: BLE001
+                                    error = e
+                                    break
+                            if error is None:
+                                resize_to = d.num_workers
+                            pending = []
+                # Drain reports while still in the "step" phase so their
+                # ckpt_seconds reattribution has step time to pull from.
                 self._poll_reports()
-                for ref in done:
-                    # A finished rank legitimately stops reporting — tell
-                    # the watchdog before its hang deadline can fire.
-                    try:
-                        self.watchdog.note_done(group.run_refs.index(ref))
-                    except ValueError:
-                        pass
-                    try:
-                        ray_tpu.get(ref)
-                    except Exception as e:  # noqa: BLE001
-                        error = e
-                        pending = []
-                        break
-                # Elastic upsize check (reference: elastic.py monitor
-                # decision): new capacity -> teardown + re-form the world
-                # at the larger size, resuming from the latest checkpoint.
-                if pending and error is None and \
-                        time.monotonic() - last_elastic_check >= \
-                        self.scaling.elastic_check_interval_s:
-                    last_elastic_check = time.monotonic()
-                    d = self.policy.monitor_decision(len(group.workers))
-                    if d is not None:
-                        # A crashed worker frees resources that look like
-                        # growth; drain already-failed refs first so a
-                        # crash takes the failure path (and max_failures
-                        # accounting), not the resize path.
-                        done_now, _ = ray_tpu.wait(
-                            pending, num_returns=len(pending), timeout=0)
-                        for ref in done_now:
-                            try:
-                                ray_tpu.get(ref)
-                            except Exception as e:  # noqa: BLE001
-                                error = e
-                                break
-                        if error is None:
-                            resize_to = d.num_workers
-                        pending = []
-            # Drain reports while still in the "step" phase so their
-            # ckpt_seconds reattribution has step time to pull from.
-            self._poll_reports()
-            if error is not None:
-                # This incarnation's step time produced no surviving work
-                # (it restarts from the last checkpoint): badput, not
-                # goodput (MegaScale-style lost-work accounting).
-                self.goodput.reattribute(
-                    "lost", time.monotonic() - t_step)
-            self.goodput.enter("idle")
-            self._teardown_group(group)
-            if resize_to is not None:
-                carry_target = resize_to
-                continue  # not a failure: re-run at the new size
-            if error is None:
-                break
-            failures += 1
-            if failures > self.run_config.failure_config.max_failures:
-                break
-            from ..util import telemetry
-            telemetry.inc("ray_tpu_train_worker_restarts_total", world)
-            # Restart: fresh group resumes from the latest committed
-            # checkpoint (reference: controller failure policy ->
-            # group teardown -> re-create -> resume, SURVEY §3.4 step 6).
-            # Prefer the previous size so the policy grace-waits for the
-            # dead group's resources to release instead of greedily
-            # under-sizing on the first partial fit.
-            carry_target = world
+                if error is not None:
+                    # This incarnation's step time produced no surviving work
+                    # (it restarts from the last checkpoint): badput, not
+                    # goodput (MegaScale-style lost-work accounting).
+                    self.goodput.reattribute(
+                        "lost", time.monotonic() - t_step)
+                self.goodput.enter("idle")
+                self._teardown_group(group)
+                if resize_to is not None:
+                    carry_target = resize_to
+                    continue  # not a failure: re-run at the new size
+                if error is None:
+                    break
+                failures += 1
+                if failures > self.run_config.failure_config.max_failures:
+                    break
+                from ..util import telemetry
+                telemetry.inc("ray_tpu_train_worker_restarts_total", world)
+                # Restart: fresh group resumes from the latest committed
+                # checkpoint (reference: controller failure policy ->
+                # group teardown -> re-create -> resume, SURVEY §3.4 step 6).
+                # Prefer the previous size so the policy grace-waits for the
+                # dead group's resources to release instead of greedily
+                # under-sizing on the first partial fit.
+                carry_target = world
 
-        self.watchdog.stop()
-        self.goodput.finish()
+        finally:
+            # Any escape from the fit loop (group-formation
+            # failure, KeyboardInterrupt) must still stop the
+            # monitor thread and join pending bundle writers.
+            self.watchdog.stop()
+            self.goodput.finish()
         rank0 = sorted((r for r in self._reports if r["rank"] == 0),
                        key=lambda r: r["time"])
         last_metrics = rank0[-1]["metrics"] if rank0 else {}

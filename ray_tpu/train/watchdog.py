@@ -57,17 +57,25 @@ class WatchdogConfig:
 
 
 class _RankState:
-    __slots__ = ("last_wall", "last_mono", "intervals", "pid",
-                 "hung", "straggling", "done")
+    __slots__ = ("last_stamp", "last_mono", "intervals", "pid",
+                 "hung", "straggling", "done", "incarnation")
 
     def __init__(self):
-        self.last_wall: Optional[float] = None   # worker-side report time
+        # Worker-side stamp for interval math: the worker's monotonic
+        # clock when available (same-process deltas are NTP-immune),
+        # its wall clock as a fallback for old payloads.
+        self.last_stamp: Optional[float] = None
         self.last_mono: Optional[float] = None   # driver-side receipt time
         self.intervals: deque = deque(maxlen=16)
         self.pid: Optional[int] = None
         self.hung = False
         self.straggling = False
         self.done = False
+        # Worker incarnation the stamps belong to: monotonic clocks are
+        # only comparable within one process, so a stamp from a new
+        # incarnation (restart — possibly on another host) must never be
+        # differenced against the old one.
+        self.incarnation: Optional[str] = None
 
 
 class TrainWatchdog:
@@ -120,23 +128,42 @@ class TrainWatchdog:
     # -- controller feed ---------------------------------------------------
 
     def note_report(self, rank: int, report_time: float,
-                    pid: Optional[int] = None) -> None:
+                    pid: Optional[int] = None,
+                    report_mono: Optional[float] = None,
+                    incarnation: Optional[str] = None) -> None:
         if not self.config.enabled:
             return
         now = time.monotonic()
+        stamp = report_mono if report_mono is not None else report_time
         recovered = False
         with self._lock:
             st = self._ranks.setdefault(rank, _RankState())
-            if st.last_wall is not None:
-                st.intervals.append(max(0.0, report_time - st.last_wall))
-            st.last_wall = report_time
+            if incarnation != st.incarnation:
+                # New worker incarnation (or a stale pre-restart report
+                # replayed from the KV after reset_ranks): its clock has
+                # a different base — drop the interval baseline instead
+                # of producing a cross-process garbage delta.
+                st.last_stamp = None
+                st.intervals.clear()
+                st.incarnation = incarnation
+            if st.last_stamp is not None:
+                st.intervals.append(max(0.0, stamp - st.last_stamp))
+            st.last_stamp = stamp
             st.last_mono = now
             st.pid = pid
             if st.hung:
                 st.hung = False
                 recovered = True
         if recovered:
+            # Refresh the KV verdict too: `ray-tpu status` must stop
+            # saying "hang" once the rank is demonstrably reporting.
+            self.last_verdict = {
+                "status": "recovered", "run_id": self.run_id,
+                "rank": rank, "pid": pid, "time": time.time(),
+                "straggler_total": self.straggler_count,
+                "hang_total": self.hang_count}
             self._export("recovered", rank, {"detail": "report resumed"})
+            self._publish_verdict()
         self._check_straggler(rank)
 
     def note_done(self, rank: int) -> None:

@@ -37,6 +37,12 @@ def report(metrics: Dict[str, Any],
         raise RuntimeError("tune.report() called outside a tune trial")
     from .._private.api import _control
     _trial_ctx["seq"] += 1
+    metrics = dict(metrics)
+    # NTP-immune trial elapsed, injected where schedulers/result rows can
+    # actually read it (reference: tune auto-fills time_total_s).  A user
+    # metric of the same name wins.
+    metrics.setdefault("time_total_s",
+                       time.monotonic() - _trial_ctx["t0_mono"])
     if checkpoint is not None:
         _control("kv_put",
                  f"tune/{_trial_ctx['run_id']}/ckpt/"
@@ -44,9 +50,9 @@ def report(metrics: Dict[str, Any],
     _control("kv_put",
              f"tune/{_trial_ctx['run_id']}/report/{_trial_ctx['trial_id']}/"
              f"{_trial_ctx['seq']}",
-             pickle.dumps({"metrics": dict(metrics),
+             pickle.dumps({"metrics": metrics,
                            "seq": _trial_ctx["seq"],
-                           "time": time.time()}))
+                           "time": time.time()}))  # wall: display only
     stop = _control(
         "kv_get", f"tune/{_trial_ctx['run_id']}/stop/"
                   f"{_trial_ctx['trial_id']}")
@@ -68,6 +74,7 @@ def _run_trial(fn_blob: bytes, config: Dict[str, Any], run_id: str,
     from .._private import serialization
     fn = serialization.loads_control(fn_blob)
     _trial_ctx = {"run_id": run_id, "trial_id": trial_id, "seq": 0,
+                  "t0_mono": time.monotonic(),
                   "initial_checkpoint":
                       pickle.loads(ckpt_blob) if ckpt_blob else None}
     try:

@@ -145,6 +145,12 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "description": "Watchdog hang verdicts: a rank produced no "
                        "report within the hang deadline (one per "
                        "incident)."},
+    # -- internal ----------------------------------------------------------
+    "ray_tpu_internal_swallowed_errors_total": {
+        "type": "counter", "tag_keys": ("where",),
+        "description": "Control-plane exceptions intentionally swallowed "
+                       "(best-effort paths), by call site.  A climbing "
+                       "series names the subsystem eating errors."},
     # -- data --------------------------------------------------------------
     "ray_tpu_data_block_seconds": {
         "type": "histogram", "tag_keys": ("operator",),
@@ -226,6 +232,23 @@ def set_gauge(name: str, value: float,
               tags: Optional[Dict[str, str]] = None) -> None:
     try:
         gauge(name).set(value, tags=tags)
+    except Exception:
+        pass
+
+
+def note_swallowed(where: str, exc: Optional[BaseException] = None) -> None:
+    """Account for an intentionally swallowed control-plane exception.
+
+    The RT202 lint rule forbids bare ``except Exception: pass`` in
+    control-plane modules: a swallowed error must at least leave a
+    debug-log line and bump ``ray_tpu_internal_swallowed_errors_total``
+    so a misbehaving subsystem shows up on the scrape instead of only in
+    a postmortem."""
+    inc("ray_tpu_internal_swallowed_errors_total", tags={"where": where})
+    try:
+        import logging
+        logging.getLogger("ray_tpu").debug(
+            "swallowed error in %s: %r", where, exc)
     except Exception:
         pass
 

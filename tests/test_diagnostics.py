@@ -191,6 +191,30 @@ class TestWatchdogUnit:
         assert wd.straggler_count == 2
         assert wd.last_verdict["status"] == "straggler"
 
+    def test_incarnation_change_resets_interval_baseline(self):
+        """A restarted worker's monotonic clock has a different base
+        (possibly a different host): stamps across incarnations must
+        never be differenced — neither a multi-day bogus interval (false
+        straggler) nor a clamped 0.0 that drags the median down."""
+        wd = self._wd(straggler_multiple=2.0, min_samples=1)
+        # Healthy peers: 1s/step baseline.
+        for step in range(1, 5):
+            for rank in (0, 1):
+                wd.note_report(rank, 0.0, report_mono=100.0 + step,
+                               incarnation="peer")
+        # Rank 2, incarnation A, huge monotonic base (long-lived host).
+        wd.note_report(2, 0.0, report_mono=9_000_000.0, incarnation="a")
+        wd.note_report(2, 0.0, report_mono=9_000_001.0, incarnation="a")
+        assert wd.straggler_count == 0
+        # Restart lands on a freshly booted host: tiny monotonic base.
+        # The cross-incarnation delta (~ -9e6 or +9e6) must be dropped.
+        wd.note_report(2, 0.0, report_mono=5.0, incarnation="b")
+        assert wd.straggler_count == 0
+        assert len(wd._ranks[2].intervals) == 0
+        # Intervals within the new incarnation count normally again.
+        wd.note_report(2, 0.0, report_mono=6.0, incarnation="b")
+        assert list(wd._ranks[2].intervals) == [1.0]
+
     def test_single_rank_has_no_peer_baseline(self):
         wd = self._wd(straggler_multiple=2.0, min_samples=1)
         for i in range(5):

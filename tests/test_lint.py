@@ -1,0 +1,673 @@
+"""Static-analysis suite: per-rule true-positive/clean-negative pairs,
+noqa suppression, the repo self-lint gate, the lint CLI, and the runtime
+lock-order detector (cycle seeding + flight-recorder integration)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu.devtools import lint_paths, lint_source
+from ray_tpu.devtools.lint import format_json, format_text
+
+
+def rule_ids(src, internal=False, path="<snippet>"):
+    return [f.rule for f in lint_source(src, path=path, internal=internal)]
+
+
+# -- user rules (RT1xx) -----------------------------------------------------
+
+
+class TestNestedGetRT101:
+    BAD = """
+import ray_tpu
+
+@ray_tpu.remote
+def outer(ref):
+    return ray_tpu.get(ref) + 1
+"""
+
+    GOOD = """
+import ray_tpu
+
+@ray_tpu.remote
+def outer(x):
+    return x + 1
+
+def driver(ref):
+    return ray_tpu.get(ref)
+"""
+
+    def test_positive(self):
+        findings = lint_source(self.BAD)
+        assert [f.rule for f in findings] == ["RT101"]
+        assert findings[0].line == 6
+        assert "outer" in findings[0].message
+
+    def test_actor_method_positive(self):
+        src = """
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    def m(self, ref):
+        return ray_tpu.get(ref)
+"""
+        assert rule_ids(src) == ["RT101"]
+
+    def test_negative(self):
+        assert rule_ids(self.GOOD) == []
+
+    def test_suppression(self):
+        patched = self.BAD.replace(
+            "return ray_tpu.get(ref) + 1",
+            "return ray_tpu.get(ref) + 1  # ray-tpu: noqa[RT101]")
+        assert rule_ids(patched) == []
+
+    def test_suppression_other_rule_does_not_mask(self):
+        patched = self.BAD.replace(
+            "return ray_tpu.get(ref) + 1",
+            "return ray_tpu.get(ref) + 1  # ray-tpu: noqa[RT102]")
+        assert rule_ids(patched) == ["RT101"]
+
+    def test_bare_noqa_suppresses(self):
+        patched = self.BAD.replace(
+            "return ray_tpu.get(ref) + 1",
+            "return ray_tpu.get(ref) + 1  # ray-tpu: noqa")
+        assert rule_ids(patched) == []
+
+
+class TestGetInLoopRT102:
+    BAD = """
+import ray_tpu
+
+def driver(refs):
+    out = []
+    for r in refs:
+        out.append(ray_tpu.get(r))
+    return out
+"""
+
+    def test_positive(self):
+        findings = lint_source(self.BAD)
+        assert [f.rule for f in findings] == ["RT102"]
+        assert findings[0].line == 7
+
+    def test_subscript_positive(self):
+        src = """
+import ray_tpu
+
+def driver(refs):
+    for i in range(len(refs)):
+        print(ray_tpu.get(refs[i]))
+"""
+        assert rule_ids(src) == ["RT102"]
+
+    def test_wait_derived_negative(self):
+        src = """
+import ray_tpu
+
+def driver(refs):
+    done, pending = ray_tpu.wait(refs, num_returns=len(refs))
+    for r in done:
+        print(ray_tpu.get(r))
+"""
+        assert rule_ids(src) == []
+
+    def test_streaming_generator_negative(self):
+        src = """
+import ray_tpu
+
+def driver(h, x):
+    for item in h.remote(x):
+        print(ray_tpu.get(item))
+"""
+        assert rule_ids(src) == []
+
+
+class TestLargeCaptureRT103:
+    def test_module_array_positive(self):
+        src = """
+import ray_tpu
+import numpy as np
+
+TABLE = np.zeros((1000, 1000))
+
+@ray_tpu.remote
+def f(i):
+    return TABLE[i].sum()
+"""
+        assert rule_ids(src) == ["RT103"]
+
+    def test_large_literal_arg_positive(self):
+        big = "[" + ", ".join("0" for _ in range(80)) + "]"
+        src = f"""
+import ray_tpu
+
+def driver(f):
+    return f.remote({big})
+"""
+        assert rule_ids(src) == ["RT103"]
+
+    def test_put_negative(self):
+        src = """
+import ray_tpu
+import numpy as np
+
+TABLE = np.zeros((1000, 1000))
+
+@ray_tpu.remote
+def f(table, i):
+    return table[i].sum()
+
+def driver():
+    ref = ray_tpu.put(TABLE)
+    return f.remote(ref, 0)
+"""
+        assert rule_ids(src) == []
+
+
+class TestUnserializableCaptureRT104:
+    def test_module_lock_positive(self):
+        src = """
+import ray_tpu
+import threading
+
+LOCK = threading.Lock()
+
+@ray_tpu.remote
+def f():
+    with LOCK:
+        return 1
+"""
+        assert rule_ids(src) == ["RT104"]
+
+    def test_direct_arg_positive(self):
+        src = """
+import ray_tpu
+
+def driver(f):
+    return f.remote(open("/tmp/x"))
+"""
+        assert rule_ids(src) == ["RT104"]
+
+    def test_local_lock_negative(self):
+        src = """
+import ray_tpu
+import threading
+
+@ray_tpu.remote
+def f():
+    lock = threading.Lock()
+    with lock:
+        return 1
+"""
+        assert rule_ids(src) == []
+
+    def test_actor_state_negative(self):
+        # Locks in actor state never cross a process boundary: fine.
+        src = """
+import ray_tpu
+import threading
+
+LOCK = threading.Lock()
+
+@ray_tpu.remote
+class A:
+    def m(self):
+        with LOCK:
+            return 1
+"""
+        assert rule_ids(src) == []
+
+
+class TestActorSelfCallRT105:
+    BAD = """
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    def step(self):
+        return 1
+
+    def run(self):
+        return self.step.remote()
+"""
+
+    def test_positive(self):
+        findings = lint_source(self.BAD)
+        assert [f.rule for f in findings] == ["RT105"]
+        assert "self.step" in findings[0].message
+
+    def test_other_handle_negative(self):
+        src = """
+import ray_tpu
+
+@ray_tpu.remote
+class A:
+    def __init__(self, other):
+        self.other = other
+
+    def run(self):
+        return self.other.step.remote()
+"""
+        assert rule_ids(src) == []
+
+
+# -- internal rules (RT2xx) -------------------------------------------------
+
+
+class TestBlockingUnderLockRT201:
+    BAD = """
+import threading
+import time
+
+lock = threading.Lock()
+
+def f():
+    with lock:
+        time.sleep(1)
+"""
+
+    def test_positive(self):
+        findings = lint_source(self.BAD, internal=True)
+        assert [f.rule for f in findings] == ["RT201"]
+        assert "time.sleep" in findings[0].message
+
+    def test_user_scope_skips_internal_rules(self):
+        assert rule_ids(self.BAD, internal=False) == []
+
+    def test_negative_outside_lock(self):
+        src = """
+import threading
+import time
+
+lock = threading.Lock()
+
+def f():
+    with lock:
+        x = 1
+    time.sleep(1)
+"""
+        assert rule_ids(src, internal=True) == []
+
+    def test_condition_wait_idiom_negative(self):
+        src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+
+    def f(self):
+        with self._lock:
+            self._wake.wait(1.0)
+"""
+        assert rule_ids(src, internal=True) == []
+
+    def test_event_wait_under_lock_positive(self):
+        src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._evt = threading.Event()
+
+    def f(self):
+        with self._lock:
+            self._evt.wait(1.0)
+"""
+        assert rule_ids(src, internal=True) == ["RT201"]
+
+    def test_str_join_negative_thread_join_positive(self):
+        src = """
+import threading
+
+lock = threading.Lock()
+
+def f(parts, t):
+    with lock:
+        s = ",".join(parts)
+        t.join(5)
+    return s
+"""
+        findings = lint_source(src, internal=True)
+        assert [f.rule for f in findings] == ["RT201"]
+        assert ".join()" in findings[0].message
+        assert findings[0].line == 9
+
+    def test_with_line_anchor_suppression(self):
+        patched = self.BAD.replace("with lock:",
+                                   "with lock:  # ray-tpu: noqa[RT201]")
+        assert rule_ids(patched, internal=True) == []
+
+
+class TestSwallowedExceptionRT202:
+    PATH = "ray_tpu/_private/runtime.py"
+    BAD = """
+def f(x):
+    try:
+        x()
+    except Exception:
+        pass
+"""
+
+    def test_positive(self):
+        assert rule_ids(self.BAD, internal=True, path=self.PATH) == ["RT202"]
+
+    def test_non_control_plane_negative(self):
+        assert rule_ids(self.BAD, internal=True,
+                        path="ray_tpu/serve/api.py") == []
+
+    def test_handled_negative(self):
+        src = """
+from ray_tpu.util import telemetry
+
+def f(x):
+    try:
+        x()
+    except Exception as e:
+        telemetry.note_swallowed("runtime.f", e)
+"""
+        assert rule_ids(src, internal=True, path=self.PATH) == []
+
+    def test_narrow_except_negative(self):
+        src = """
+def f(x):
+    try:
+        x()
+    except ValueError:
+        pass
+"""
+        assert rule_ids(src, internal=True, path=self.PATH) == []
+
+
+class TestWallClockDurationRT203:
+    def test_sub_positive(self):
+        src = """
+import time
+
+def f(work):
+    t0 = time.time()
+    work()
+    return time.time() - t0
+"""
+        ids = rule_ids(src, internal=True)
+        assert ids == ["RT203"]
+
+    def test_deadline_compare_positive(self):
+        src = """
+import time
+
+def f(deadline):
+    return time.time() > deadline
+"""
+        assert rule_ids(src, internal=True) == ["RT203"]
+
+    def test_monotonic_negative(self):
+        src = """
+import time
+
+def f(work):
+    t0 = time.monotonic()
+    work()
+    return time.monotonic() - t0
+"""
+        assert rule_ids(src, internal=True) == []
+
+    def test_timestamp_record_negative(self):
+        src = """
+import time
+
+def f():
+    return {"time": time.time()}
+"""
+        assert rule_ids(src, internal=True) == []
+
+
+class TestTelemetrySeriesRT204:
+    def test_unknown_name_positive(self):
+        src = """
+from ray_tpu.util import telemetry
+
+def f():
+    telemetry.inc("ray_tpu_serve_bogus_total")
+"""
+        assert rule_ids(src, internal=True) == ["RT204"]
+
+    def test_catalog_name_negative(self):
+        src = """
+from ray_tpu.util import telemetry
+
+def f():
+    telemetry.inc("ray_tpu_serve_requests_total")
+    telemetry.set_gauge("ray_tpu_llm_active_slots", 1.0)
+"""
+        assert rule_ids(src, internal=True) == []
+
+
+class TestProtocolCoverageRT205:
+    def test_unhandled_message_positive(self, tmp_path):
+        private = tmp_path / "_private"
+        private.mkdir()
+        (private / "protocol.py").write_text(
+            "from dataclasses import dataclass\n\n\n"
+            "@dataclass\nclass Handled:\n    x: int = 0\n\n\n"
+            "@dataclass\nclass Orphan:\n    y: int = 0\n")
+        (private / "worker.py").write_text(
+            "def route(msg):\n"
+            "    if isinstance(msg, Handled):\n"
+            "        return True\n")
+        res = lint_paths([str(private)], internal=True)
+        assert [f.rule for f in res.findings] == ["RT205"]
+        assert "Orphan" in res.findings[0].message
+
+
+# -- repo gates -------------------------------------------------------------
+
+
+class TestSelfLint:
+    def test_ray_tpu_tree_is_clean(self):
+        """The tier-1 self-lint gate: the framework passes its own
+        static analysis with zero findings."""
+        import ray_tpu
+        pkg = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+        res = lint_paths([pkg])
+        assert res.files_checked > 100
+        assert res.ok, "\n" + format_text(res)
+
+    def test_bad_corpus_fails(self):
+        res_findings = lint_source(TestNestedGetRT101.BAD)
+        assert res_findings, "bad corpus must produce findings"
+
+
+class TestOutputAndCli:
+    def test_json_format_roundtrip(self):
+        findings = lint_source(TestGetInLoopRT102.BAD, path="bad.py")
+        from ray_tpu.devtools.lint import LintResult
+        doc = json.loads(format_json(LintResult(findings, 1)))
+        assert doc["version"] == 1
+        assert doc["files_checked"] == 1
+        assert doc["findings"][0]["rule"] == "RT102"
+        assert doc["findings"][0]["path"] == "bad.py"
+        assert doc["findings"][0]["line"] == 7
+
+    def test_cli_exit_codes(self, tmp_path):
+        from click.testing import CliRunner
+        from ray_tpu.scripts.cli import cli
+        bad = tmp_path / "user_code.py"
+        bad.write_text(TestNestedGetRT101.BAD)
+        runner = CliRunner()
+        r = runner.invoke(cli, ["lint", str(bad)])
+        assert r.exit_code == 1
+        assert "RT101" in r.output
+        good = tmp_path / "ok_code.py"
+        good.write_text("x = 1\n")
+        r = runner.invoke(cli, ["lint", str(good)])
+        assert r.exit_code == 0
+        r = runner.invoke(cli, ["lint", "--format", "json", str(bad)])
+        assert r.exit_code == 1
+        assert json.loads(r.output)["findings"][0]["rule"] == "RT101"
+
+    def test_nonexistent_path_is_loud(self, tmp_path):
+        """A typo'd path must not turn the lint gate into a green
+        '0 findings in 0 files' no-op."""
+        res = lint_paths([str(tmp_path / "no_such_dir")])
+        assert [f.rule for f in res.findings] == ["RT002"]
+        from click.testing import CliRunner
+        from ray_tpu.scripts.cli import cli
+        r = CliRunner().invoke(cli, ["lint", str(tmp_path / "nope.py")])
+        assert r.exit_code == 1
+        assert "RT002" in r.output
+
+    def test_cli_list_rules(self):
+        from click.testing import CliRunner
+        from ray_tpu.scripts.cli import cli
+        r = CliRunner().invoke(cli, ["lint", "--list-rules"])
+        assert r.exit_code == 0
+        for rid in ("RT101", "RT102", "RT103", "RT104", "RT105",
+                    "RT201", "RT202", "RT203", "RT204", "RT205"):
+            assert rid in r.output
+
+
+# -- runtime lock-order detector --------------------------------------------
+
+
+@pytest.fixture
+def lockdebug():
+    from ray_tpu.devtools import lockdebug as mod
+    mod.install()
+    mod.clear()
+    try:
+        yield mod
+    finally:
+        mod.clear()
+        mod.uninstall()
+
+
+class TestLockDebug:
+    def test_ab_ba_cycle_reported_and_in_bundle(self, lockdebug, tmp_path):
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        assert type(lock_a).__name__ == "_DebugLock"
+        t1_done = threading.Event()
+
+        def t1():
+            with lock_a:
+                with lock_b:
+                    pass
+            t1_done.set()
+
+        def t2():
+            t1_done.wait(5.0)
+            with lock_b:
+                with lock_a:
+                    pass
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start()
+        th2.start()
+        th1.join(5.0)
+        th2.join(5.0)
+
+        cycles = [f for f in lockdebug.findings()
+                  if f["kind"] == "lock_cycle"]
+        assert len(cycles) == 1, lockdebug.findings()
+        cyc = cycles[0]
+        assert lock_a.name in cyc["cycle"] and lock_b.name in cyc["cycle"]
+        assert cyc["edges"], "cycle finding must carry its edges"
+
+        # The finding reaches the flight recorder bundle.
+        from ray_tpu._private.diagnostics import write_debug_bundle
+
+        class _Rt:
+            session_dir = str(tmp_path)
+        path = write_debug_bundle(_Rt(), "lock_cycle_test",
+                                  capture_stacks=False)
+        with open(os.path.join(path, "lock_findings.json")) as f:
+            doc = json.load(f)
+        assert doc["installed"] is True
+        assert any(f["kind"] == "lock_cycle" for f in doc["findings"])
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert "lock_findings.json" in manifest["contents"]
+
+    def test_consistent_order_no_cycle(self, lockdebug):
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+        assert [f for f in lockdebug.findings()
+                if f["kind"] == "lock_cycle"] == []
+
+    def test_sleep_under_lock_reported(self, lockdebug):
+        lock = threading.Lock()
+        with lock:
+            time.sleep(0.001)
+        blocked = [f for f in lockdebug.findings()
+                   if f["kind"] == "blocking_under_lock"]
+        assert len(blocked) == 1
+        assert lock.name in blocked[0]["held_locks"]
+        # Same site again: deduplicated, not re-reported.
+        with lock:
+            time.sleep(0.001)
+
+    def test_sleep_without_lock_clean(self, lockdebug):
+        time.sleep(0.001)
+        assert [f for f in lockdebug.findings()
+                if f["kind"] == "blocking_under_lock"] == []
+
+    def test_rlock_reentrancy_no_self_cycle(self, lockdebug):
+        r = threading.RLock()
+        with r:
+            with r:
+                pass
+        assert lockdebug.findings() == []
+
+    def test_cross_thread_release_leaves_no_phantom(self, lockdebug):
+        """A plain Lock released by a different thread (legal handoff)
+        must not leave a phantom held entry that mints bogus edges and
+        sleep-under-lock findings for the acquiring thread."""
+        handoff = threading.Lock()
+        other = threading.Lock()
+        handoff.acquire()  # main thread acquires...
+
+        t = threading.Thread(target=handoff.release)  # ...helper releases
+        t.start()
+        t.join(5.0)
+
+        with other:           # would record handoff->other if phantom
+            time.sleep(0.001)  # would record blocking_under_lock twice
+        blocked = [f for f in lockdebug.findings()
+                   if f["kind"] == "blocking_under_lock"]
+        assert len(blocked) == 1
+        assert blocked[0]["held_locks"] == [other.name]
+        assert not any(f["kind"] == "lock_cycle"
+                       for f in lockdebug.findings())
+
+    def test_condition_on_wrapped_lock_works(self, lockdebug):
+        cond = threading.Condition()
+        with cond:
+            cond.wait(timeout=0.01)
+        hit = []
+
+        def waiter():
+            with cond:
+                hit.append(cond.wait(timeout=5.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            cond.notify_all()
+        t.join(5.0)
+        assert hit == [True]

@@ -21,7 +21,7 @@ from ray_tpu.util import metrics as metrics_mod
 from ray_tpu.util import telemetry
 
 _NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
-SUBSYSTEMS = ("serve", "llm", "train", "data")
+SUBSYSTEMS = ("serve", "llm", "train", "data", "internal")
 
 
 class TestCatalog:
@@ -115,7 +115,7 @@ _LLM_CFG_KW = dict(vocab_size=128, hidden=32, layers=2, heads=4, kv_heads=2,
 
 
 class TestSmokeAllSubsystems:
-    def test_metrics_span_four_subsystems(self, ray_start_isolated,
+    def test_metrics_span_all_subsystems(self, ray_start_isolated,
                                           tmp_path):
         metrics_mod._reset_for_tests()
 
@@ -154,6 +154,9 @@ class TestSmokeAllSubsystems:
                               parallelism=4)
         rows = ds.map(lambda r: {"x": r["x"] * 2}).take_all()
         assert len(rows) == 64
+
+        # -- internal: one accounted swallowed error ----------------------
+        telemetry.note_swallowed("test.smoke", RuntimeError("boom"))
 
         # Worker-side metrics flush deterministically at task completion,
         # but serve latency lands from a watcher thread: poll briefly.
