@@ -161,6 +161,31 @@ class SharedMemoryStore:
             if e and e.pinned > 0:
                 e.pinned -= 1
 
+    def try_pin(self, object_id: ObjectID) -> bool:
+        """Pin if the store owns this object (emergency-replica staging:
+        a pinned snapshot is exempt from LRU spill/eviction).  Objects
+        created by worker processes live in their own segments outside
+        this index; those return False and rely on the runtime's
+        escape-mark instead."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                return False
+            e.pinned += 1
+            return True
+
+    def try_unpin(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or e.pinned <= 0:
+                return False
+            e.pinned -= 1
+            return True
+
+    def num_pinned(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._entries.values() if e.pinned > 0)
+
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
             e = self._entries.pop(object_id, None)
@@ -418,6 +443,18 @@ class NativeArenaStore:
 
     def unpin(self, object_id: ObjectID) -> None:
         self.unpin_key(object_id.binary())
+
+    def try_pin(self, object_id: ObjectID) -> bool:
+        """Arena-store counterpart of SharedMemoryStore.try_pin (the
+        emergency-replica pin API): pin when present, report whether the
+        arena actually holds the object."""
+        return self._lookup(object_id.binary(), pin=True) is not None
+
+    def try_unpin(self, object_id: ObjectID) -> bool:
+        if not self.contains(object_id):
+            return False
+        self.unpin_key(object_id.binary())
+        return True
 
     def delete(self, object_id: ObjectID) -> None:
         key = object_id.binary()

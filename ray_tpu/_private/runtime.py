@@ -2145,6 +2145,24 @@ class Runtime:
     # control-plane methods callable from workers (and used by the driver
     # API directly). All arguments/returns must be plain picklable data.
 
+    def ctl_pin_object(self, oid_bytes: bytes) -> bool:
+        """Pin an object against eviction AND reference-count collection
+        (ray_tpu.checkpoint emergency replicas: the newest snapshot must
+        survive object-store pressure and the producer dropping its ref).
+        Returns whether the head store held a pinnable copy; either way
+        the escape-mark keeps the directory entry alive."""
+        oid = ObjectID(oid_bytes)
+        self.mark_escaped(oid)
+        store_pin = getattr(self.node.store, "try_pin", None)
+        return bool(store_pin(oid)) if store_pin is not None else False
+
+    def ctl_unpin_object(self, oid_bytes: bytes) -> bool:
+        oid = ObjectID(oid_bytes)
+        with self._ref_lock:
+            self._escaped.discard(oid)
+        store_unpin = getattr(self.node.store, "try_unpin", None)
+        return bool(store_unpin(oid)) if store_unpin is not None else False
+
     def ctl_kv_put(self, key, value, namespace="default", overwrite=True):
         return self.controller.kv_put(key, value, namespace, overwrite)
 

@@ -1,0 +1,32 @@
+"""ray_tpu.checkpoint — distributed checkpointing subsystem.
+
+Async sharded saves (the train step blocks only for the device->host
+snapshot), per-rank shard layout with an atomically committed global
+manifest, resharding restore across world sizes, and optional emergency
+in-memory replicas for fast single-worker-failure recovery.  See the
+README "Checkpointing" section for the layout and semantics.
+"""
+
+from .async_writer import AsyncCheckpointWriter, WriteJob, publish_shard
+from .format import (CheckpointError, Snapshot, build_manifest, build_shard,
+                     commit_manifest, is_committed, load_pytree,
+                     read_manifest, restore_tree, save_pytree, snapshot_tree,
+                     verify_checkpoint, write_bytes_atomic, write_shard)
+from .manager import (Checkpoint, CheckpointManager, WorkerCheckpointClient,
+                      atomic_rmtree, scan_run_dir, step_dir)
+from .replica import ReplicaHolder, ensure_holder, get_holder, holder_name
+from .sharding import (even_placement, even_shard, even_shard_spec,
+                       full_index, intersect, normalize_index)
+
+__all__ = [
+    "AsyncCheckpointWriter", "WriteJob", "publish_shard",
+    "CheckpointError", "Snapshot",
+    "build_manifest", "build_shard", "commit_manifest", "is_committed",
+    "load_pytree", "read_manifest", "restore_tree", "save_pytree",
+    "snapshot_tree", "verify_checkpoint", "write_bytes_atomic",
+    "write_shard", "Checkpoint", "CheckpointManager",
+    "WorkerCheckpointClient", "atomic_rmtree", "scan_run_dir", "step_dir",
+    "ReplicaHolder", "ensure_holder", "get_holder", "holder_name",
+    "even_placement", "even_shard", "even_shard_spec", "full_index",
+    "intersect", "normalize_index",
+]

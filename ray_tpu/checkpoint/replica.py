@@ -1,0 +1,205 @@
+"""Emergency checkpoint replicas: newest snapshot in RAM, not just on disk.
+
+Gemini-style fast failure recovery: after a single-worker death the fresh
+worker should restore from memory over the wire, not from cold storage.
+Two pieces cooperate:
+
+* A **peer holder** — a small named actor (one per experiment) that keeps
+  the newest shard blobs in its process heap.  The writer thread pushes
+  each published shard to it fire-and-forget; restores try it first and
+  fall back to disk when it has nothing (holder death loses only the fast
+  path, never data — the committed manifest on disk stays authoritative).
+* A **local object-store pin** — each worker also ``put``s its newest blob
+  into the host object store and pins it (``ctl_pin_object``), so host-RAM
+  staging survives LRU/spill pressure for same-host restarts.  The pin
+  moves with the newest snapshot: publishing step N unpins step N-1.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ..util import telemetry
+
+#: Shard generations the holder keeps per rank (newest first).  Two, not
+#: one: step N's push races step N+1's across ranks, and the restore picks
+#: whatever step the committed manifest names.
+KEEP_STEPS = 2
+
+
+def holder_name(experiment: str) -> str:
+    return f"ckpt_replica:{experiment}"
+
+
+class ReplicaHolder:
+    """Peer-host RAM copy of the newest checkpoint shards.
+
+    Spawned by the train controller as a named detached-ish actor (it
+    lives for the runtime session, so a SECOND trainer resuming the same
+    experiment finds the blobs of the first).  Methods are plain data in /
+    data out — the actor runner handles concurrency (max_concurrency=1).
+    """
+
+    def __init__(self):
+        #: rank -> {step -> (index_dict, blob_bytes)}
+        self._shards: Dict[int, Dict[int, Tuple[dict, bytes]]] = {}
+
+    def hold(self, step: int, rank: int, index: dict, blob: bytes) -> bool:
+        gen = self._shards.setdefault(rank, {})
+        gen[step] = (index, blob)
+        for old in sorted(gen)[:-KEEP_STEPS]:
+            del gen[old]
+        return True
+
+    def fetch(self, step: int, rank: int) -> Optional[Tuple[dict, bytes]]:
+        return self._shards.get(rank, {}).get(step)
+
+    def steps(self) -> Dict[int, list]:
+        return {rank: sorted(gen) for rank, gen in self._shards.items()}
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "ranks": len(self._shards),
+            "bytes": sum(len(blob) for gen in self._shards.values()
+                         for _idx, blob in gen.values()),
+            "steps": self.steps(),
+        }
+
+
+def ensure_holder(experiment: str):
+    """Driver-side: create (or find) the experiment's replica holder."""
+    import ray_tpu
+    holder_cls = ray_tpu.remote(ReplicaHolder)
+    return holder_cls.options(name=holder_name(experiment),
+                              get_if_exists=True, num_cpus=0).remote()
+
+
+def get_holder(experiment: str):
+    """Worker-side: resolve the holder by name (None when replication is
+    off or the holder died — callers fall back to disk)."""
+    import ray_tpu
+    try:
+        return ray_tpu.get_actor(holder_name(experiment))
+    except Exception:
+        return None
+
+
+def _pin_key(experiment: str, rank: int) -> str:
+    return f"ckpt/pin/{experiment}/{rank}"
+
+
+class LocalPin:
+    """Keeps the newest shard blob pinned in the host object store (and
+    escape-marked against ref-GC), advertised through the runtime KV so
+    restores can read it back.
+
+    The KV entry chains unpins ACROSS worker incarnations: before
+    publishing its own pin, a worker unpins whatever the previous entry
+    (possibly a dead predecessor's) still holds — so each (experiment,
+    rank) keeps at most one pinned blob no matter how many times the
+    worker is restarted."""
+
+    def __init__(self, experiment: str, rank: int):
+        self.key = _pin_key(experiment, rank)
+        self._lock = threading.Lock()
+        self._pinned: Optional[Any] = None  # ObjectRef
+
+    def pin(self, blob: bytes, step: int, index: dict) -> None:
+        import pickle
+
+        import ray_tpu
+        from .._private.api import _control
+        try:
+            ref = ray_tpu.put(blob)
+            _control("pin_object", ref.binary())
+            prev_entry = _control("kv_get", self.key)
+            _control("kv_put", self.key, pickle.dumps(
+                {"ref": ref.binary(), "step": step, "index": index}))
+            if prev_entry is not None:
+                _control("unpin_object", pickle.loads(prev_entry)["ref"])
+        except Exception as e:
+            telemetry.note_swallowed("checkpoint.replica.pin", e)
+            return
+        with self._lock:
+            self._pinned = ref
+
+    def release(self) -> None:
+        import pickle
+
+        from .._private.api import _control
+        with self._lock:
+            ref, self._pinned = self._pinned, None
+        if ref is None:
+            return
+        try:
+            entry = _control("kv_get", self.key)
+            if entry is not None and \
+                    pickle.loads(entry)["ref"] == ref.binary():
+                _control("kv_del", self.key)
+            _control("unpin_object", ref.binary())
+        except Exception as e:
+            telemetry.note_swallowed("checkpoint.replica.unpin", e)
+
+
+def fetch_local_pins(experiment: str,
+                     manifest: dict) -> Dict[int, Tuple[dict, bytes]]:
+    """Shards of the manifest's step still pinned in the host object
+    store (same-host fast path; survives the producing worker's death)."""
+    import pickle
+
+    import ray_tpu
+    from .._private.api import ObjectRef, _control
+    from .._private.ids import ObjectID
+    out: Dict[int, Tuple[dict, bytes]] = {}
+    step = manifest["step"]
+    for sh in manifest["shards"]:
+        try:
+            entry = _control("kv_get", _pin_key(experiment, sh["rank"]))
+            if entry is None:
+                continue
+            rec = pickle.loads(entry)
+            if rec["step"] != step:
+                continue
+            blob = ray_tpu.get(ObjectRef(ObjectID(rec["ref"])), timeout=10)
+            out[sh["rank"]] = (rec["index"], blob)
+        except Exception as e:
+            telemetry.note_swallowed("checkpoint.replica.pin_fetch", e)
+    return out
+
+
+def push_shard(holder, step: int, rank: int, index: dict,
+               blob: bytes) -> bool:
+    """Fire-and-forget replica push from the writer thread.  Returns
+    whether the push was issued (False = no holder; disk remains the only
+    copy)."""
+    if holder is None:
+        return False
+    try:
+        holder.hold.remote(step, rank, index, blob)
+        return True
+    except Exception as e:
+        telemetry.note_swallowed("checkpoint.replica.push", e)
+        return False
+
+
+def fetch_shards(holder, manifest: dict,
+                 timeout: float = 30.0) -> Dict[int, Tuple[dict, bytes]]:
+    """Collect whatever shards of the manifest's step the holder has in
+    RAM; missing ranks restore from disk."""
+    if holder is None:
+        return {}
+    import ray_tpu
+    out: Dict[int, Tuple[dict, bytes]] = {}
+    step = manifest["step"]
+    try:
+        refs = {sh["rank"]: holder.fetch.remote(step, sh["rank"])
+                for sh in manifest["shards"]}
+        for rank, ref in refs.items():
+            got = ray_tpu.get(ref, timeout=timeout)
+            if got is not None:
+                out[rank] = (got[0], got[1])
+    except Exception as e:
+        telemetry.note_swallowed("checkpoint.replica.fetch", e)
+        return {}
+    return out

@@ -286,6 +286,60 @@ class UnknownTelemetrySeries(Rule):
         return aliases, direct
 
 
+#: Modules whose on-disk files other processes treat as commit records
+#: (RT206): a torn write here IS state corruption, so every publication
+#: must be tmp-file + os.replace.  Matched against the normalized path.
+_ATOMIC_PUBLISH_MODULES = (
+    "/checkpoint/",            # the distributed checkpointing subsystem
+    "train/_checkpoint.py",    # its compat shim
+    "_private/persist.py",     # head-state WAL/snapshot store
+)
+
+
+@register
+class NonAtomicPublish(Rule):
+    id = "RT206"
+    scope = "internal"
+    summary = "non-atomic file publication in a checkpoint/control-plane " \
+              "module"
+    rationale = ("A manifest/index written with a bare open(path, 'w') can "
+                 "be observed (or survive a crash) as a torn prefix that "
+                 "parses as a valid-looking file; publish through a tmp "
+                 "file + os.replace (checkpoint.format.write_bytes_atomic) "
+                 "so the path either holds the full bytes or nothing.")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        key = ctx.module_key
+        if not (any(key.endswith(m) for m in _ATOMIC_PUBLISH_MODULES
+                    if not m.startswith("/"))
+                or any(m in key for m in _ATOMIC_PUBLISH_MODULES
+                       if m.startswith("/"))):
+            return
+        for node in ctx.nodes(ast.Call):
+            if dotted(node.func) not in ("open", "io.open") or \
+                    not node.args:
+                continue
+            mode = node.args[1] if len(node.args) >= 2 else next(
+                (kw.value for kw in node.keywords if kw.arg == "mode"),
+                None)
+            if not (isinstance(mode, ast.Constant) and
+                    isinstance(mode.value, str) and
+                    mode.value.startswith("w")):
+                continue
+            # The tmp+replace idiom names its staging path: a path
+            # expression mentioning "tmp" (tmp var, .tmp suffix,
+            # mkstemp/mkdtemp product) is the atomic pattern's first
+            # half, not a publication.
+            path_src = ast.unparse(node.args[0])
+            if "tmp" in path_src.lower():
+                continue
+            yield ctx.finding(
+                self, node,
+                f"open({path_src}, {mode.value!r}) publishes a file "
+                f"non-atomically: write to a tmp path and os.replace() "
+                f"into place (see checkpoint.format.write_bytes_atomic)")
+
+
 @register
 class ProtocolHandlerMissing(Rule):
     id = "RT205"

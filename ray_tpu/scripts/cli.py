@@ -338,6 +338,110 @@ def lint(paths, fmt, list_rules, internal):
 
 
 @cli.group()
+def ckpt():
+    """Distributed checkpoint inspection (ray_tpu.checkpoint)."""
+
+
+def _resolve_run_dir(run, storage_path):
+    run_dir = run if storage_path is None else os.path.join(storage_path,
+                                                            run)
+    if not os.path.isdir(run_dir):
+        raise click.ClickException(
+            f"no run directory at {run_dir} — pass the "
+            f"<storage>/<experiment> path, or --storage-path plus the "
+            f"experiment name")
+    return run_dir
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+
+
+@ckpt.command("ls")
+@click.argument("run")
+@click.option("--storage-path", default=None,
+              help="Prepend to RUN (otherwise RUN is the run dir path).")
+@click.option("--deep", is_flag=True,
+              help="Verify shard crc32s, not just manifest + sizes.")
+def ckpt_ls(run, storage_path, deep):
+    """List a run's checkpoints: step, size, shards, replica presence,
+    and validity (manifest self-checksum + shard verification).
+    Uncommitted directories (in-flight or crashed saves) show as
+    ``uncommitted`` — they are invisible to restore by design."""
+    from ray_tpu.checkpoint import scan_run_dir
+    recs = scan_run_dir(_resolve_run_dir(run, storage_path), deep=deep)
+    if not recs:
+        click.echo("no checkpoints")
+        return
+    click.echo(f"{'STEP':>8}  {'SIZE':>10}  {'SHARDS':>6}  "
+               f"{'REPLICA':>7}  STATUS")
+    bad = 0
+    for r in recs:
+        if not r["committed"]:
+            status = "uncommitted"
+        elif r["valid"]:
+            status = "valid"
+        else:
+            status = "INVALID: " + "; ".join(r["problems"])
+            bad += 1
+        click.echo(f"{r['step']:>8}  {_fmt_bytes(r.get('bytes', 0)):>10}  "
+                   f"{r.get('shards', 0):>6}  "
+                   f"{'yes' if r.get('replica') else 'no':>7}  {status}")
+    if bad:
+        raise SystemExit(1)
+
+
+@ckpt.command("inspect")
+@click.argument("run")
+@click.option("--storage-path", default=None)
+@click.option("--step", type=int, default=None,
+              help="Checkpoint step (default: newest committed).")
+@click.option("--deep", is_flag=True, help="Re-read shards and check crcs.")
+def ckpt_inspect(run, storage_path, step, deep):
+    """Print one checkpoint's manifest: leaves, shard map, validity."""
+    from ray_tpu.checkpoint import read_manifest, scan_run_dir, \
+        verify_checkpoint
+    run_dir = _resolve_run_dir(run, storage_path)
+    recs = [r for r in scan_run_dir(run_dir) if r["committed"]]
+    if step is not None:
+        recs = [r for r in recs if r["step"] == step]
+    if not recs:
+        raise click.ClickException(
+            "no committed checkpoint" +
+            (f" at step {step}" if step is not None else ""))
+    rec = recs[-1]
+    problems = verify_checkpoint(rec["path"], deep=deep)
+    try:
+        manifest = read_manifest(rec["path"])
+    except Exception as e:
+        # Inspect exists to diagnose exactly this checkpoint: a corrupt
+        # manifest is a report, not a traceback.
+        click.echo(f"path:      {rec['path']}")
+        click.echo(f"step:      {rec['step']}")
+        click.echo(f"valid:     {'; '.join(problems) or e}")
+        raise SystemExit(1)
+    click.echo(f"path:      {rec['path']}")
+    click.echo(f"step:      {manifest['step']}")
+    click.echo(f"world:     {manifest['world_size']} "
+               f"({len(manifest['shards'])} shards, "
+               f"{_fmt_bytes(manifest['total_bytes'])})")
+    click.echo(f"replica:   {'yes' if manifest['replica'] else 'no'}")
+    click.echo(f"valid:     "
+               f"{'yes' if not problems else '; '.join(problems)}")
+    if manifest.get("metrics"):
+        click.echo(f"metrics:   {json.dumps(manifest['metrics'])}")
+    click.echo("leaves:")
+    for key, spec in sorted(manifest["leaves"].items()):
+        shape = "x".join(str(d) for d in spec["global_shape"]) or "scalar"
+        click.echo(f"  {key}  {spec['dtype']}[{shape}]")
+    if problems:
+        raise SystemExit(1)
+
+
+@cli.group()
 def debug():
     """Failure forensics (flight recorder)."""
 

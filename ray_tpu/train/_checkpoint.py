@@ -1,155 +1,15 @@
-"""Checkpoints: directory-backed, with pytree helpers and a manager.
+"""Thin compat shim over ``ray_tpu.checkpoint``.
 
-Reference analog: Checkpoint (reference: python/ray/train/_checkpoint.py:56,
-fsspec directory URI) and CheckpointManager (reference:
-python/ray/train/v2/_internal/execution/checkpoint/checkpoint_manager.py:98
-— rank-0 commit, top-k retention).  Round-1 storage is a local/shared
-filesystem path; pytrees serialize via pickled host numpy (orbax adapter:
-``save_pytree(..., use_orbax=True)``).
+The checkpoint implementation moved into the first-class
+``ray_tpu/checkpoint/`` subsystem (async sharded saves, atomic manifest
+commit, resharding restore, emergency replicas).  This module keeps the
+historical import surface — ``Checkpoint``, ``CheckpointManager``,
+``save_pytree``, ``load_pytree`` — stable for existing train code.
 """
 
 from __future__ import annotations
 
-import json
-import os
-import pickle
-import shutil
-import tempfile
-import time
-from typing import Any, Dict, List, Optional
+from ..checkpoint.format import load_pytree, save_pytree
+from ..checkpoint.manager import Checkpoint, CheckpointManager
 
-
-class Checkpoint:
-    """Handle to a checkpoint directory (reference: train/_checkpoint.py:56)."""
-
-    def __init__(self, path: str):
-        self.path = os.path.abspath(path)
-
-    @classmethod
-    def from_directory(cls, path: str) -> "Checkpoint":
-        return cls(path)
-
-    def as_directory(self) -> str:
-        return self.path
-
-    def to_directory(self, dest: Optional[str] = None) -> str:
-        dest = dest or tempfile.mkdtemp(prefix="ckpt_")
-        if os.path.abspath(dest) != self.path:
-            shutil.copytree(self.path, dest, dirs_exist_ok=True)
-        return dest
-
-    # -- pytree convenience -------------------------------------------------
-
-    @classmethod
-    def from_pytree(cls, tree: Any, path: str,
-                    use_orbax: bool = False) -> "Checkpoint":
-        os.makedirs(path, exist_ok=True)
-        save_pytree(tree, path, use_orbax=use_orbax)
-        return cls(path)
-
-    def load_pytree(self, use_orbax: bool = False) -> Any:
-        return load_pytree(self.path, use_orbax=use_orbax)
-
-    def __repr__(self):
-        return f"Checkpoint({self.path})"
-
-
-def save_pytree(tree: Any, path: str, use_orbax: bool = False) -> None:
-    """Device arrays -> host numpy -> disk."""
-    import jax
-    import numpy as np
-    t0 = time.perf_counter()
-    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
-    if use_orbax:
-        import orbax.checkpoint as ocp
-        ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.join(path, "orbax"), host)
-    else:
-        with open(os.path.join(path, "pytree.pkl"), "wb") as f:
-            pickle.dump(host, f, protocol=5)
-    _note_ckpt("save", time.perf_counter() - t0)
-
-
-def load_pytree(path: str, use_orbax: bool = False) -> Any:
-    t0 = time.perf_counter()
-    if use_orbax:
-        import orbax.checkpoint as ocp
-        ckptr = ocp.PyTreeCheckpointer()
-        out = ckptr.restore(os.path.join(path, "orbax"))
-    else:
-        with open(os.path.join(path, "pytree.pkl"), "rb") as f:
-            out = pickle.load(f)
-    _note_ckpt("restore", time.perf_counter() - t0)
-    return out
-
-
-def _note_ckpt(op: str, seconds: float) -> None:
-    try:
-        from ..util import telemetry
-    except Exception:
-        return
-    telemetry.observe("ray_tpu_train_checkpoint_seconds", seconds,
-                      tags={"op": op})
-    telemetry.note_checkpoint_seconds(seconds)
-
-
-class CheckpointManager:
-    """Tracks committed checkpoints under <storage>/<experiment>/.
-
-    Commit protocol: a checkpoint directory is durable once the manager
-    writes its entry into ``checkpoints.json`` (rank-0 report drives this;
-    reference: checkpoint_manager.py rank-0-commit + _latest marker).
-    """
-
-    def __init__(self, storage_path: str, experiment_name: str,
-                 num_to_keep: Optional[int] = None):
-        self.root = os.path.join(os.path.abspath(storage_path),
-                                 experiment_name)
-        os.makedirs(self.root, exist_ok=True)
-        self.num_to_keep = num_to_keep
-        self._index_path = os.path.join(self.root, "checkpoints.json")
-        self._entries: List[Dict[str, Any]] = []
-        if os.path.exists(self._index_path):
-            with open(self._index_path) as f:
-                self._entries = json.load(f)
-
-    def checkpoint_dir(self, step: int) -> str:
-        return os.path.join(self.root, f"checkpoint_{step:06d}")
-
-    def register(self, path: str, metrics: Dict[str, Any]) -> None:
-        self._entries.append({
-            "path": os.path.abspath(path),
-            "metrics": {k: v for k, v in metrics.items()
-                        if isinstance(v, (int, float, str, bool))},
-            "time": time.time(),
-        })
-        self._flush()
-        self._enforce_retention()
-
-    def latest(self) -> Optional[str]:
-        return self._entries[-1]["path"] if self._entries else None
-
-    def best(self, metric: str, mode: str = "min") -> Optional[str]:
-        scored = [e for e in self._entries if metric in e["metrics"]]
-        if not scored:
-            return None
-        pick = min if mode == "min" else max
-        return pick(scored, key=lambda e: e["metrics"][metric])["path"]
-
-    def all_entries(self) -> List[Dict[str, Any]]:
-        return list(self._entries)
-
-    def _flush(self) -> None:
-        tmp = self._index_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._entries, f, indent=1)
-        os.replace(tmp, self._index_path)
-
-    def _enforce_retention(self) -> None:
-        if not self.num_to_keep:
-            return
-        while len(self._entries) > self.num_to_keep:
-            victim = self._entries.pop(0)
-            self._flush()
-            if os.path.isdir(victim["path"]):
-                shutil.rmtree(victim["path"], ignore_errors=True)
+__all__ = ["Checkpoint", "CheckpointManager", "save_pytree", "load_pytree"]

@@ -24,7 +24,8 @@ class TrainContext:
                  local_rank: int, storage_path: str,
                  experiment_name: str,
                  latest_checkpoint: Optional[str] = None,
-                 slice_id: int = 0, num_slices: int = 1):
+                 slice_id: int = 0, num_slices: int = 1,
+                 checkpoint_options: Optional[Dict[str, Any]] = None):
         self.run_id = run_id
         self._rank = rank
         self._world_size = world_size
@@ -34,6 +35,8 @@ class TrainContext:
         self._latest_checkpoint = latest_checkpoint
         self.slice_id = slice_id
         self.num_slices = num_slices
+        self._ckpt_options = dict(checkpoint_options or {})
+        self._ckpt_client = None
         self._report_seq = 0
         # Unique per worker incarnation: keeps report keys distinct across
         # failure-recovery restarts (seq restarts at 0 in a fresh worker).
@@ -61,6 +64,44 @@ class TrainContext:
         if self._latest_checkpoint and os.path.exists(self._latest_checkpoint):
             return Checkpoint(self._latest_checkpoint)
         return None
+
+    # -- sharded checkpoint subsystem ---------------------------------------
+
+    def checkpoint_client(self):
+        """This worker's save/restore client (ray_tpu.checkpoint)."""
+        if self._ckpt_client is None:
+            from ..checkpoint.manager import (WorkerCheckpointClient,
+                                             _dir_step)
+            opts = self._ckpt_options
+            start = 0
+            if self._latest_checkpoint:
+                # Resume the auto-step sequence past the restored
+                # checkpoint so a restarted worker never overwrites a
+                # committed step directory.
+                s = _dir_step(os.path.basename(
+                    os.path.normpath(self._latest_checkpoint)))
+                if s is not None:
+                    start = s + 1
+            self._ckpt_client = WorkerCheckpointClient(
+                run_id=self.run_id, rank=self._rank,
+                world_size=self._world_size,
+                run_root=os.path.join(os.path.abspath(self.storage_path),
+                                      self.experiment_name),
+                experiment=self.experiment_name,
+                async_save=opts.get("async_save", True),
+                max_inflight=opts.get("max_inflight", 2),
+                emergency_replica=opts.get("emergency_replica", False),
+                initial_step=start,
+                generation=opts.get("generation"))
+        return self._ckpt_client
+
+    def teardown(self) -> None:
+        """Flush + close the async checkpoint writer (run at the end of
+        the train fn so every submitted save acks before the worker
+        reports success)."""
+        if self._ckpt_client is not None:
+            self._ckpt_client.close()
+            self._ckpt_client = None
 
 
 def set_context(ctx: Optional[TrainContext]) -> None:
@@ -110,6 +151,40 @@ def report(metrics: Dict[str, Any],
              f"train/{ctx.run_id}/report/{ctx.get_world_rank()}/"
              f"{ctx._incarnation}/{ctx._report_seq}",
              pickle.dumps(payload))
+
+
+def save_checkpoint(tree: Any, metrics: Optional[Dict[str, Any]] = None,
+                    *, shard_spec=None, step: Optional[int] = None,
+                    sync: Optional[bool] = None) -> str:
+    """Save this rank's shards of ``tree`` through the distributed
+    checkpoint subsystem; returns the checkpoint directory.
+
+    With async saves (the default, ``CheckpointConfig.async_save``), the
+    call blocks only for the device->host snapshot — serialization and
+    the write happen on a background thread while training continues —
+    and the checkpoint becomes ``latest`` only after EVERY rank's shard
+    landed and the coordinator committed the manifest atomically.
+    ``shard_spec(key, leaf) -> (global_shape, index)`` declares the slice
+    of a global array this rank holds (see
+    ``ray_tpu.checkpoint.even_shard_spec``)."""
+    ctx = get_context()
+    return ctx.checkpoint_client().save(tree, metrics=metrics,
+                                        shard_spec=shard_spec, step=step,
+                                        sync=sync)
+
+
+def load_checkpoint(placement=None) -> Optional[Any]:
+    """Restore the latest committed checkpoint's pytree, resharded to
+    ``placement(key, global_shape) -> index`` (None = full arrays; see
+    ``ray_tpu.checkpoint.even_placement``).  Prefers in-memory emergency
+    replica shards over disk when replication is enabled.  Returns None
+    when the run has no checkpoint yet."""
+    ctx = get_context()
+    if not ctx._latest_checkpoint or \
+            not os.path.exists(ctx._latest_checkpoint):
+        return None
+    return ctx.checkpoint_client().load(ctx._latest_checkpoint,
+                                        placement=placement)
 
 
 def _note_step(ctx: "TrainContext", now: float, now_mono: float,

@@ -80,7 +80,8 @@ class TrainWorker:
             latest_checkpoint=ctx_info.get("latest_checkpoint"),
             slice_id=int(os.environ.get(
                 "MEGASCALE_SLICE_ID", ctx_info.get("slice_id", 0))),
-            num_slices=ctx_info.get("num_slices", 1))
+            num_slices=ctx_info.get("num_slices", 1),
+            checkpoint_options=ctx_info.get("checkpoint"))
         _context.set_context(ctx)
         try:
             fn = serialization.loads_control(fn_blob)
@@ -88,6 +89,10 @@ class TrainWorker:
                 fn(config)
             else:
                 fn()
+            # Drain the async checkpoint writer BEFORE reporting success:
+            # every submitted save must have published + acked (or raised)
+            # by the time the controller sees this rank finish.
+            ctx.teardown()
             return "ok"
         finally:
             _context.set_context(None)
@@ -128,6 +133,7 @@ class TrainController:
             num_to_keep=run_config.checkpoint_config.num_to_keep)
         self._reports: List[Dict[str, Any]] = []
         self._seen_report_keys: set = set()
+        self._seen_ack_keys: set = set()
         # Goodput accounting (reference analog: MegaScale-style wall-time
         # partitioning): init/step/checkpoint/restart/idle phases; the
         # ratio lands on the ray_tpu_train_goodput_ratio gauge live.
@@ -237,6 +243,24 @@ class TrainController:
                 if payload.get("checkpoint_dir"):
                     self.manager.register(payload["checkpoint_dir"],
                                           payload["metrics"])
+        self._poll_ckpt_acks()
+
+    def _poll_ckpt_acks(self) -> None:
+        """Sharded-save commit protocol: collect per-rank shard acks and
+        commit the global manifest once a step's ack set is complete (the
+        coordinator half of ray_tpu.checkpoint; a crash before this
+        commit leaves "latest" untouched)."""
+        from .._private.api import _control
+        from ..checkpoint.manager import ack_prefix
+        for key in _control("kv_keys", ack_prefix(self.run_id)):
+            if key in self._seen_ack_keys:
+                continue
+            data = _control("kv_get", key)
+            if data is None:
+                continue  # not marked seen: the read stays retryable
+            self._seen_ack_keys.add(key)
+            self.manager.note_ack(pickle.loads(data))
+        self.manager.commit_ready()
 
     # -- main loop ----------------------------------------------------------
 
@@ -264,13 +288,32 @@ class TrainController:
                 # Fresh incarnation: stale rank clocks must not trip on the
                 # re-formed group.
                 self.watchdog.reset_ranks()
+                # And stale checkpoint acks from the torn-down group must
+                # never complete a new incarnation's ack set (the retried
+                # step re-acks under a fresh per-worker nonce key; the
+                # generation tag drops straggler acks that race in late).
+                self.manager.reset_pending_acks(
+                    generation=len(self.world_size_history))
                 group = self._start_group(world)
                 fn_blob = serialization.dumps_control(self.train_fn)
+                ckpt_cfg = self.run_config.checkpoint_config
+                if getattr(ckpt_cfg, "emergency_replica", False):
+                    # Peer RAM copy of the newest shards: spawn (or find)
+                    # the experiment's replica holder before workers run.
+                    from ..checkpoint import replica as _replica
+                    _replica.ensure_holder(self.run_config.name)
                 ctx_info = {
                     "storage_path": self.run_config.storage_path,
                     "experiment_name": self.run_config.name,
                     "latest_checkpoint": self.manager.latest(),
                     "num_slices": self.scaling.num_slices,
+                    "checkpoint": {
+                        "async_save": getattr(ckpt_cfg, "async_save", True),
+                        "max_inflight": getattr(ckpt_cfg, "max_inflight", 2),
+                        "emergency_replica": getattr(
+                            ckpt_cfg, "emergency_replica", False),
+                        "generation": len(self.world_size_history),
+                    },
                 }
                 group.run_refs = [
                     w.run.remote(fn_blob, self.train_loop_config, ctx_info)

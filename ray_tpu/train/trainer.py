@@ -31,6 +31,15 @@ class CheckpointConfig:
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "min"
+    # Sharded-save knobs (ray_tpu.checkpoint): async saves block the step
+    # only for the device->host snapshot; the bounded write queue applies
+    # backpressure past ``max_inflight`` outstanding saves.
+    async_save: bool = True
+    max_inflight: int = 2
+    # Keep the newest shards in a peer's RAM (and pinned in the host
+    # object store) so single-worker-failure recovery restores from
+    # memory over the wire instead of cold storage.
+    emergency_replica: bool = False
 
 
 @dataclass

@@ -145,6 +145,38 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "description": "Watchdog hang verdicts: a rank produced no "
                        "report within the hang deadline (one per "
                        "incident)."},
+    # -- ckpt (distributed checkpointing subsystem) ------------------------
+    "ray_tpu_ckpt_save_blocking_seconds": {
+        "type": "histogram", "tag_keys": (),
+        "boundaries": _STEP_BUCKETS,
+        "description": "Train-thread time a save actually stole: the "
+                       "device->host snapshot plus any write-queue "
+                       "backpressure wait (async saves) or the full "
+                       "serialize+write (sync saves)."},
+    "ray_tpu_ckpt_write_seconds": {
+        "type": "histogram", "tag_keys": (),
+        "boundaries": _STEP_BUCKETS,
+        "description": "Background shard serialize+publish duration "
+                       "(tmp-file + atomic rename, off the step path)."},
+    "ray_tpu_ckpt_bytes_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Checkpoint shard bytes published by this "
+                       "process."},
+    "ray_tpu_ckpt_inflight": {
+        "type": "gauge", "tag_keys": (),
+        "description": "Async checkpoint saves queued or writing "
+                       "(bounded by CheckpointConfig.max_inflight; "
+                       "pinned at the bound = the saver outruns the "
+                       "disk and backpressure is biting)."},
+    "ray_tpu_ckpt_restore_seconds": {
+        "type": "histogram", "tag_keys": ("source",),
+        "boundaries": _STEP_BUCKETS,
+        "description": "Checkpoint restore duration, by shard source "
+                       "(source=disk|replica)."},
+    "ray_tpu_ckpt_replica_restores_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Restores that used in-memory emergency replica "
+                       "shards instead of (or ahead of) cold storage."},
     # -- internal ----------------------------------------------------------
     "ray_tpu_internal_swallowed_errors_total": {
         "type": "counter", "tag_keys": ("where",),

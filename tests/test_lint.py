@@ -452,6 +452,62 @@ def f():
         assert rule_ids(src, internal=True) == []
 
 
+class TestAtomicPublishRT206:
+    BAD = """
+import json
+
+def commit(path, manifest):
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+"""
+
+    GOOD = """
+import json
+import os
+
+def commit(path, manifest):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, path)
+"""
+
+    def test_positive_in_checkpoint_module(self):
+        assert rule_ids(self.BAD, internal=True,
+                        path="ray_tpu/checkpoint/manager.py") == ["RT206"]
+
+    def test_tmp_plus_replace_negative(self):
+        assert rule_ids(self.GOOD, internal=True,
+                        path="ray_tpu/checkpoint/manager.py") == []
+
+    def test_keyword_mode_positive(self):
+        src = self.BAD.replace('open(path, "w")', 'open(path, mode="w")')
+        assert rule_ids(src, internal=True,
+                        path="ray_tpu/checkpoint/manager.py") == ["RT206"]
+
+    def test_out_of_scope_module_negative(self):
+        # Only checkpoint/control-plane modules publish commit records;
+        # a bare open() elsewhere (bench output, debug dumps) is fine.
+        assert rule_ids(self.BAD, internal=True,
+                        path="ray_tpu/serve/api.py") == []
+
+    def test_read_mode_negative(self):
+        src = """
+def load(path):
+    with open(path, "rb") as f:
+        return f.read()
+"""
+        assert rule_ids(src, internal=True,
+                        path="ray_tpu/checkpoint/format.py") == []
+
+    def test_suppression(self):
+        patched = self.BAD.replace(
+            'with open(path, "w") as f:',
+            'with open(path, "w") as f:  # ray-tpu: noqa[RT206]')
+        assert rule_ids(patched, internal=True,
+                        path="ray_tpu/checkpoint/manager.py") == []
+
+
 class TestProtocolCoverageRT205:
     def test_unhandled_message_positive(self, tmp_path):
         private = tmp_path / "_private"
