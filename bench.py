@@ -390,8 +390,98 @@ def bench_checkpoint() -> None:
         raise SystemExit(1)
 
 
+def bench_sanitize(tasks: int = 400, actor_calls: int = 400) -> None:
+    """Core task/actor round-trip throughput with the resource-leak
+    sanitizer (RAY_TPU_SANITIZE=1) off vs. on (budget: < 2% overhead).
+
+    The sanitizer costs one registry write per tracked event (thread
+    start, pin, tracked open) — nothing on the per-task path — so the
+    measured overhead should be noise.  The whole tier-1 suite runs with
+    it enabled, so a regression that puts bookkeeping on the hot path
+    would tax every test run."""
+    import ray_tpu
+    from ray_tpu._private import sanitizer
+
+    @ray_tpu.remote
+    def _noop(x):
+        return x
+
+    class _Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    def loop_once() -> float:
+        t0 = time.perf_counter()
+        for start in range(0, tasks, 20):
+            ray_tpu.get([_noop.remote(i) for i in range(start, start + 20)])
+        actor = ray_tpu.remote(_Counter).remote()
+        for start in range(0, actor_calls, 20):
+            ray_tpu.get([actor.bump.remote() for _ in range(20)])
+        return time.perf_counter() - t0
+
+    doc: dict = {"tasks": tasks, "actor_calls": actor_calls}
+    # One cluster, sanitizer toggled per rep.  The machine drifts over a
+    # bench run, so each rep measures an (off, on) pair with the ORDER
+    # ALTERNATING between reps (drift inflates whichever side runs
+    # second — alternating cancels it) and the reported overhead is the
+    # median of the per-rep deltas.
+    times: dict = {"sanitize_off": [], "sanitize_on": []}
+    deltas: list = []
+    ray_tpu.init(num_cpus=2)
+    try:
+        loop_once()  # warm (worker spawn, code ship)
+        for rep in range(8):
+            pair = {}
+            order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+            for which in order:
+                if which == "on":
+                    sanitizer.install()
+                try:
+                    pair[which] = loop_once()
+                finally:
+                    if which == "on":
+                        sanitizer.uninstall()
+            times["sanitize_off"].append(pair["off"])
+            times["sanitize_on"].append(pair["on"])
+            deltas.append((pair["on"] - pair["off"]) / pair["off"] * 100.0)
+    finally:
+        ray_tpu.shutdown()
+        sanitizer._reset_for_tests()
+    for label, ts in times.items():
+        srt = sorted(ts)
+        dt = srt[len(srt) // 2]
+        doc[label] = {"median_wall_s": round(dt, 4),
+                      "all_s": [round(t, 4) for t in ts],
+                      "ops_per_s": round((tasks + actor_calls) / dt, 1)}
+    off = doc["sanitize_off"]["median_wall_s"]
+    on = doc["sanitize_on"]["median_wall_s"]
+    deltas.sort()
+    # Trimmed mean (drop best+worst rep): the container this runs in
+    # jitters ±10% per rep, far above the effect being measured.
+    core = deltas[1:-1]
+    doc["overhead_pct"] = round(sum(core) / len(core), 3)
+    doc["per_rep_delta_pct"] = [round(d, 2) for d in deltas]
+    doc["budget_pct"] = 2.0
+    doc["within_budget"] = doc["overhead_pct"] is not None and \
+        doc["overhead_pct"] < 2.0
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_sanitize.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps({"metric": "sanitizer_overhead_pct",
+                      "value": doc["overhead_pct"],
+                      "within_budget": doc["within_budget"]}))
+    print(f"# sanitize bench -> {path}", file=sys.stderr)
+
+
 def bench_lint() -> None:
-    """Wall time of a full-repo `ray-tpu lint` pass (budget: < 5 s).
+    """Wall time of a full-repo `ray-tpu lint` pass (budget: < 8 s —
+    raised from 5 s when the RT3xx dataflow pass joined: per-function
+    CFG construction + per-acquire reachability on top of the AST walk).
 
     The self-lint gate runs in tier-1 on every change, so the lint pass
     itself is a hot path for developers; a rule whose AST walk goes
@@ -411,8 +501,8 @@ def bench_lint() -> None:
         "findings": len(res.findings),
         "wall_s": round(dt, 3),
         "files_per_s": round(res.files_checked / dt, 1) if dt > 0 else None,
-        "budget_s": 5.0,
-        "within_budget": dt < 5.0,
+        "budget_s": 8.0,
+        "within_budget": dt < 8.0,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_lint.json")
@@ -429,14 +519,16 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--spec", default="auto",
                     choices=["auto", "7b", "diagnostics", "lint",
-                             "checkpoint"],
+                             "checkpoint", "sanitize"],
                     help="auto: timed bench on local chip(s); "
                          "7b: AOT shape-verify of the Llama-2-7B "
                          "north-star on a virtual 8-device mesh; "
                          "diagnostics: watchdog-overhead bench only; "
                          "lint: full-repo static-analysis wall time; "
                          "checkpoint: async vs sync save blocking + "
-                         "restore disk vs replica")
+                         "restore disk vs replica; "
+                         "sanitize: leak-sanitizer overhead on the core "
+                         "task/actor loop")
     args = ap.parse_args()
     if args.spec == "7b":
         shape_verify_7b()
@@ -449,6 +541,9 @@ def main() -> None:
         return
     if args.spec == "checkpoint":
         bench_checkpoint()
+        return
+    if args.spec == "sanitize":
+        bench_sanitize()
         return
 
     import jax

@@ -37,6 +37,15 @@ if _os.environ.get("RAY_TPU_DEBUG_LOCKS") == "1":
     from .devtools import lockdebug as _lockdebug
     _lockdebug.install()
 
+# Opt-in runtime resource-leak sanitizer (_private/sanitizer.py):
+# registries for framework threads / pins / tracked files / named
+# actors, snapshotted at cluster start and diffed at shutdown.
+# Installed before the _private imports so module-level framework
+# threads are attributed too.
+if _os.environ.get("RAY_TPU_SANITIZE") == "1":
+    from ._private import sanitizer as _sanitizer
+    _sanitizer.install()
+
 from ._private import runtime as _runtime_mod
 from ._private.api import (ActorClass, ActorHandle, ActorMethod, ObjectRef,
                            ObjectRefGenerator, PlacementGroup, RemoteFunction,
@@ -116,7 +125,14 @@ def shutdown() -> None:
         return
     rt = _runtime_mod.driver_runtime()
     if rt is not None:
+        # Leak-sanitizer gate (RAY_TPU_SANITIZE=1): named actors are
+        # inspected before teardown marks everything DEAD; threads /
+        # pins / file handles are diffed after teardown completes, so a
+        # LeakError never leaves a half-shut cluster behind.
+        from ._private import sanitizer as _san
+        pre = _san.pre_shutdown(rt)
         rt.shutdown()
+        _san.check_after_shutdown(pre)
 
 
 def _private_worker_mode(worker_runtime) -> None:

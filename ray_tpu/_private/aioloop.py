@@ -1,0 +1,46 @@
+"""Shared teardown for daemon-thread asyncio servers.
+
+The serve HTTP ingress, the dashboard and the job server all run an
+aiohttp app on a private event loop inside a daemon thread.  Their
+teardown has two sharp edges that must be handled identically in all
+three (and were once copy-pasted, drifting apart):
+
+* the loop's *default executor* keeps its ``asyncio_N`` worker threads
+  (every ``run_in_executor`` get) alive forever unless shut down WITH
+  the loop — a per-server thread leak the sanitizer flags at cluster
+  shutdown;
+* once the loop is closed, ``call_soon_threadsafe`` raises
+  ``RuntimeError`` — a second ``stop()`` (or one racing the serve
+  thread's own exit) must be a no-op, not an exception that aborts the
+  caller's shutdown sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def shutdown_loop(loop: Any) -> None:
+    """Run on the loop's own thread after ``run_until_complete``
+    returns: retire the default executor, then close the loop."""
+    try:
+        loop.run_until_complete(loop.shutdown_default_executor())
+    except Exception:
+        pass
+    try:
+        loop.close()
+    except Exception:
+        pass
+
+
+def stop_loop_thread(loop: Any, thread: Optional[Any],
+                     join_timeout: float = 5.0) -> None:
+    """Request the loop stop from any thread and join its host thread.
+    Safe against an already-exited (closed) loop and double stops."""
+    if loop is not None and not loop.is_closed():
+        try:
+            loop.call_soon_threadsafe(loop.stop)
+        except RuntimeError:
+            pass  # loop closed between the check and the call
+    if thread is not None:
+        thread.join(timeout=join_timeout)

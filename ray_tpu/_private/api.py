@@ -23,6 +23,7 @@ from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
 from .protocol import TaskSpec
 from .resources import ResourceSet, task_resources
 from . import runtime as _rtmod
+from . import sanitizer as _sanitizer
 from .runtime import current_runtime, driver_runtime
 from ..util import tracing as _tracing
 from .scheduler import (NodeAffinitySchedulingStrategy,
@@ -184,7 +185,7 @@ class ObjectRef:
                 fut.set_result(get(self))
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
-        threading.Thread(target=fill, daemon=True).start()
+        _sanitizer.spawn(fill, name="ref-fill")
         return fut
 
     def __await__(self):

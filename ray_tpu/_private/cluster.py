@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from multiprocessing.connection import Client, Listener
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import sanitizer
 from .config import Config
 from .controller import NodeInfo
 from .ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
@@ -366,8 +367,8 @@ class DataServer:
                 except Exception:
                     pass
                 return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+            sanitizer.spawn(self._serve, args=(conn,),
+                            name="cluster-serve")
 
     def _serve(self, conn) -> None:
         try:
@@ -687,11 +688,13 @@ class HeadServer:
         self.proxies: Dict[NodeID, RemoteNodeProxy] = {}
         self._lock = threading.Lock()
         self._closed = False
+        # Pending node-death grace timers: cancelled at shutdown so a
+        # mid-grace timer does not outlive the head (sanitizer finding).
+        self._death_timers: List[Any] = []
         self._acceptor = threading.Thread(target=self._accept_loop,
                                           name="head-accept", daemon=True)
         self._acceptor.start()
-        threading.Thread(target=self._ping_loop, name="head-ping",
-                         daemon=True).start()
+        sanitizer.spawn(self._ping_loop, name="head-ping")
 
     # -- membership ----------------------------------------------------------
 
@@ -709,8 +712,8 @@ class HeadServer:
                 except Exception:
                     pass
                 return
-            threading.Thread(target=self._register, args=(conn,),
-                             daemon=True).start()
+            sanitizer.spawn(self._register, args=(conn,),
+                            name="head-register")
 
     def _register(self, conn) -> None:
         try:
@@ -752,9 +755,8 @@ class HeadServer:
         # Register with the scheduler only after the ack is on the wire so
         # the first dispatch can't race the node's own setup.
         rt.scheduler.add_node(info)
-        threading.Thread(target=self._reader_loop, args=(proxy,),
-                         name=f"head-node-{node_id.hex()[:8]}",
-                         daemon=True).start()
+        sanitizer.spawn(self._reader_loop, args=(proxy,),
+                        name=f"head-node-{node_id.hex()[:8]}")
 
     def _reattach(self, msg: RegisterNode, conn) -> bool:
         """A node reconnecting within the grace window re-attaches under
@@ -797,9 +799,8 @@ class HeadServer:
             return self._reattach_from_wal(msg, conn, nid)
         if proxy is None or not proxy.alive:
             return False  # grace expired / truly unknown
-        threading.Thread(target=self._reader_loop, args=(proxy,),
-                         name=f"head-node-{nid.hex()[:8]}",
-                         daemon=True).start()
+        sanitizer.spawn(self._reader_loop, args=(proxy,),
+                        name=f"head-node-{nid.hex()[:8]}")
         return True
 
     def _reattach_from_wal(self, msg: RegisterNode, conn,
@@ -838,9 +839,8 @@ class HeadServer:
             rt.controller.mark_node_dead(nid, "wal re-attach ack failed")
             return False
         rt.scheduler.add_node(info)
-        threading.Thread(target=self._reader_loop, args=(proxy,),
-                         name=f"head-node-{nid.hex()[:8]}",
-                         daemon=True).start()
+        sanitizer.spawn(self._reader_loop, args=(proxy,),
+                        name=f"head-node-{nid.hex()[:8]}")
         return True
 
     def _register_client(self, conn) -> None:
@@ -849,9 +849,8 @@ class HeadServer:
         proxy = ClientProxy(self, conn, client_id)
         proxy.send(ClientAck(client_id.binary(), rt.job_id.binary(),
                              Config.blob(), rt.node_id.binary()))
-        threading.Thread(target=self._client_reader, args=(proxy,),
-                         name=f"head-client-{client_id.hex()[:8]}",
-                         daemon=True).start()
+        sanitizer.spawn(self._client_reader, args=(proxy,),
+                        name=f"head-client-{client_id.hex()[:8]}")
 
     def _client_reader(self, proxy: ClientProxy) -> None:
         rt = self.runtime
@@ -972,6 +971,18 @@ class HeadServer:
                 grace, self._on_node_death, args=(proxy,),
                 kwargs={"expect_conn": conn})
             t.daemon = True
+            with self._lock:
+                if self._closed:
+                    # Head shutdown already swept the timers; the EOFs
+                    # it caused must not mint new ones behind the sweep.
+                    return
+                # Prune by finished (fired/cancelled), NOT is_alive():
+                # a concurrently appended but not-yet-started Timer is
+                # not alive yet, and dropping it here would let it slip
+                # past the shutdown cancel sweep.
+                self._death_timers = [x for x in self._death_timers
+                                      if not x.finished.is_set()]
+                self._death_timers.append(t)
             t.start()
         else:
             self._on_node_death(proxy)
@@ -1055,7 +1066,7 @@ class HeadServer:
                     proxy.send(NodeRpcReply(m.request_id, None, repr(e)))
             if msg.method in rt._BLOCKING_CTL:
                 # Long-poll ctl calls must not stall this node's reader.
-                threading.Thread(target=run_rpc, daemon=True).start()
+                sanitizer.spawn(run_rpc, name="node-ctl-rpc")
             else:
                 run_rpc()
         elif isinstance(msg, RegisterNode):
@@ -1074,8 +1085,8 @@ class HeadServer:
         return p.data_address if p is not None else None
 
     def shutdown(self) -> None:
-        self._closed = True
         with self._lock:
+            self._closed = True
             proxies = list(self.proxies.values())
             self.proxies.clear()
         for p in proxies:
@@ -1085,6 +1096,14 @@ class HeadServer:
             self._listener.close()
         except Exception:
             pass
+        # Cancel LAST: the proxy shutdowns above EOF every reader, and a
+        # reader that won the race before _closed was observed may have
+        # scheduled one more grace timer (cancel-before-start is safe —
+        # the timer thread exits immediately).
+        with self._lock:
+            timers, self._death_timers = self._death_timers, []
+        for t in timers:
+            t.cancel()
 
 
 # --------------------------------------------------------------------------
@@ -1279,17 +1298,16 @@ class NodeServer:
         import queue as _q
         self._dispatch_q: Any = _q.Queue()
         self._to_worker_q: Any = _q.Queue()
-        threading.Thread(target=self._queue_loop,
-                         args=(self._dispatch_q, self._do_dispatch),
-                         name="node-dispatch", daemon=True).start()
-        threading.Thread(target=self._queue_loop,
-                         args=(self._to_worker_q, self._do_to_worker),
-                         name="node-to-worker", daemon=True).start()
+        sanitizer.spawn(self._queue_loop,
+                        args=(self._dispatch_q, self._do_dispatch),
+                        name="node-dispatch")
+        sanitizer.spawn(self._queue_loop,
+                        args=(self._to_worker_q, self._do_to_worker),
+                        name="node-to-worker")
         # Second message completes the handshake with the real data address.
         self.send_up(RegisterNode(socket.gethostname(), node_resources,
                                   int(num_tpus or 0), self.data_address))
-        threading.Thread(target=self._syncer_loop, name="node-syncer",
-                         daemon=True).start()
+        sanitizer.spawn(self._syncer_loop, name="node-syncer")
 
     def _syncer_loop(self) -> None:
         """Versioned resource-view reporter (reference: ray_syncer.h:91

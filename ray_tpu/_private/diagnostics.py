@@ -118,11 +118,16 @@ def write_debug_bundle(rt, reason: str,
     contents: List[str] = []
 
     def section(fname: str, produce) -> None:
+        from . import sanitizer
         try:
             data = produce()
             if data is None:
                 return
-            with open(os.path.join(path, fname), "w") as f:
+            # tracked_open: bundle handles register with the leak
+            # sanitizer while open, so a wedged producer shows up in the
+            # shutdown diff with this site.
+            with sanitizer.tracked_open(os.path.join(path, fname),
+                                        "w") as f:
                 f.write(data)
             contents.append(fname)
         except Exception:  # noqa: BLE001 — forensics are best-effort
@@ -162,6 +167,16 @@ def write_debug_bundle(rt, reason: str,
             return None
         return json.dumps(rep, indent=1, default=str)
     section("lock_findings.json", _locks)
+
+    def _leaks():
+        # Leak-sanitizer registries (RAY_TPU_SANITIZE=1): the live
+        # framework threads / pins / tracked handles / named actors with
+        # creation sites — a hang/death bundle shows what was held.
+        from ray_tpu._private import sanitizer
+        if not sanitizer.is_enabled():
+            return None
+        return json.dumps(sanitizer.report(), indent=1, default=str)
+    section("leak_findings.json", _leaks)
 
     section("manifest.json", lambda: json.dumps({
         "reason": reason,

@@ -58,9 +58,8 @@ class DirectServer:
         self.address: Tuple[str, int] = (
             host, self._listener.address[1])
         self._closed = False
-        t = threading.Thread(target=self._accept_loop,
-                             name="direct-accept", daemon=True)
-        t.start()
+        from . import sanitizer
+        sanitizer.spawn(self._accept_loop, name="direct-accept")
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -70,8 +69,9 @@ class DirectServer:
                 if self._closed:
                     return
                 continue
-            threading.Thread(target=self._serve, args=(conn,),
-                             name="direct-serve", daemon=True).start()
+            from . import sanitizer
+            sanitizer.spawn(self._serve, args=(conn,),
+                            name="direct-serve")
 
     def _serve(self, conn) -> None:
         send_lock = threading.Lock()
@@ -237,8 +237,8 @@ class DirectChannel:
         if self._resolver_running:
             return
         self._resolver_running = True
-        threading.Thread(target=self._resolve_loop, name="direct-resolve",
-                         daemon=True).start()
+        from . import sanitizer
+        sanitizer.spawn(self._resolve_loop, name="direct-resolve")
 
     def _resolve_loop(self) -> None:
         from .exceptions import ActorError  # noqa: F401 (error path)
@@ -276,9 +276,9 @@ class DirectChannel:
                                 self.buffered = buffered[i:]
                                 self._ensure_resolver_locked()
                                 return
-                    threading.Thread(target=self._recv_loop, args=(conn,),
-                                     name="direct-recv",
-                                     daemon=True).start()
+                    from . import sanitizer
+                    sanitizer.spawn(self._recv_loop, args=(conn,),
+                                    name="direct-recv")
                     return
             elif state == "dead" or time.monotonic() > deadline:
                 with self.lock:

@@ -77,6 +77,10 @@ class WorkerHandle:
     # fn_ids whose blobs this worker has already received — later specs
     # ship without the blob (reference: function-table export-once).
     seen_fns: Set[bytes] = field(default_factory=set)
+    # Registration-timeout Timer; cancelled the moment the worker
+    # registers (otherwise one timer thread per spawn idles out the
+    # full worker_register_timeout_s — a leak the sanitizer flags).
+    register_watchdog: Optional[Any] = None
     running: Set[TaskID] = field(default_factory=set)
     # task_id -> (start_monotonic, retriable) for the OOM kill policy.
     task_meta: Dict[TaskID, Any] = field(default_factory=dict)
@@ -214,6 +218,7 @@ class NodeManager:
                         break
                 handle.pending_msgs.clear()
             handle.ready.set()
+            self._cancel_register_watchdog(handle)
             with self._lock:
                 self._poll_conns[conn] = handle
                 self._conns_version += 1
@@ -483,8 +488,20 @@ class NodeManager:
         t = threading.Timer(Config.get("worker_register_timeout_s"),
                             _watchdog)
         t.daemon = True
+        with self._lock:
+            if self._closed:
+                # shutdown()'s cancel sweep already ran (or is running):
+                # starting the timer now would leave it ticking against
+                # a torn-down manager for the full register timeout.
+                return handle
+            handle.register_watchdog = t
         t.start()
         return handle
+
+    def _cancel_register_watchdog(self, handle: WorkerHandle) -> None:
+        t, handle.register_watchdog = handle.register_watchdog, None
+        if t is not None:
+            t.cancel()
 
     def _kill_and_reap(self, handle: WorkerHandle) -> None:
         """SIGKILL a worker and guarantee its death handler runs.
@@ -509,7 +526,8 @@ class NodeManager:
             time.sleep(1.0)
             if h.state != DEAD:
                 self._on_worker_death(h)
-        threading.Thread(target=_reap, daemon=True).start()
+        from . import sanitizer
+        sanitizer.spawn(_reap, name="worker-reap")
 
     def _acquire_worker(self, env_key: str = "",
                         env: Optional[Dict[str, str]] = None) -> WorkerHandle:
@@ -571,8 +589,8 @@ class NodeManager:
                             self.info.node_id, spec.resources,
                             spec.placement_group, spec.bundle_index)
                         self.runtime.on_dispatch_failed(spec, repr(e))
-                threading.Thread(target=_bg, name="runtime-env-build",
-                                 daemon=True).start()
+                from . import sanitizer
+                sanitizer.spawn(_bg, name="runtime-env-build")
                 return
             # Extract content-addressed packages into the node session dir;
             # workers apply them at boot (reference: runtime-env agent
@@ -947,6 +965,7 @@ class NodeManager:
             raise ValueError(f"unknown wire frame tag {msg[0]!r}")
         if isinstance(msg, WorkerReady):
             handle.ready.set()
+            self._cancel_register_watchdog(handle)
         elif isinstance(msg, TaskDone):
             handle.running.discard(msg.task_id)
             handle.task_meta.pop(msg.task_id, None)
@@ -1167,8 +1186,17 @@ class NodeManager:
                 self._idle.setdefault("", []).append(h.worker_id)
 
     def shutdown(self) -> None:
-        self._closed = True
+        # _closed flips under the lock: a racing _spawn_worker either
+        # sees it and skips its watchdog timer, or has already published
+        # handle.register_watchdog under the same lock — in which case
+        # the sweep below cancels it.
+        with self._lock:
+            self._closed = True
+            handles = list(self._workers.values())
         self.memory_monitor.stop()
+        # Workers that never registered still hold a live watchdog timer.
+        for h in handles:
+            self._cancel_register_watchdog(h)
         self._out_ev.set()  # sender thread sees _closed and exits
         self._sender.join(timeout=3.0)
         self._wake_poller()

@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import sanitizer
 from . import serialization
 from . import wire as _wire
 from .config import Config
@@ -301,8 +302,7 @@ class Runtime:
         import queue as _q
         self._ref_drop_q: Any = _q.SimpleQueue()
         if self._gc_enabled:
-            threading.Thread(target=self._ref_drop_loop, name="ref-gc",
-                             daemon=True).start()
+            sanitizer.spawn(self._ref_drop_loop, name="ref-gc")
         self._local_refs: Dict[ObjectID, int] = {}
         self._escaped: set = set()
         self._dropped: set = set()
@@ -435,8 +435,7 @@ class Runtime:
             self._xfer_q = _queue.Queue()
             self._xfer_pool = ThreadPoolExecutor(
                 max_workers=4, thread_name_prefix="head-xfer")
-            threading.Thread(target=self._xfer_loop, name="head-xfer-ordered",
-                             daemon=True).start()
+            sanitizer.spawn(self._xfer_loop, name="head-xfer-ordered")
 
         if self.state_store is not None:
             self._revive_persisted_state()
@@ -2016,6 +2015,7 @@ class Runtime:
         remaining = {"n": len(states)}
         lock = threading.Lock()
         replied = {"done": False}
+        timer_box: Dict[str, Any] = {}
         is_remote = getattr(node, "is_remote", False)
         is_client = getattr(node, "is_client", False)
 
@@ -2024,6 +2024,13 @@ class Runtime:
                 if replied["done"]:
                     return
                 replied["done"] = True
+            # The timeout Timer must die WITH the request: un-cancelled
+            # it idles out the full user timeout per get() — thousands of
+            # zombie timer threads under load (leak found by the
+            # sanitizer).
+            t = timer_box.get("t")
+            if t is not None:
+                t.cancel()
             if not is_remote and any(
                     isinstance(st.desc, tuple) and st.desc
                     and st.desc[0] == "at" for st in states
@@ -2099,6 +2106,7 @@ class Runtime:
         if msg.timeout_s is not None:
             timer = threading.Timer(msg.timeout_s, lambda: finish(True))
             timer.daemon = True
+            timer_box["t"] = timer
             timer.start()
         if not states:
             finish(False)
@@ -2114,7 +2122,7 @@ class Runtime:
                 ready = []
             node.send_to_worker(msg.worker_id,
                                 WaitReply(msg.request_id, ready))
-        threading.Thread(target=run, daemon=True).start()
+        sanitizer.spawn(run, name="wait-reply")
 
     def on_put_from_worker(self, msg: PutFromWorker) -> None:
         self.mark_ready(msg.object_id, msg.desc)
@@ -2137,8 +2145,7 @@ class Runtime:
                 node.send_to_worker(msg.worker_id,
                                     RpcReply(msg.request_id, None, repr(e)))
         if msg.method in self._BLOCKING_CTL:
-            threading.Thread(target=run, daemon=True,
-                             name=f"ctl-{msg.method}").start()
+            sanitizer.spawn(run, name=f"ctl-{msg.method}")
         else:
             run()
 
@@ -2153,6 +2160,7 @@ class Runtime:
         the escape-mark keeps the directory entry alive."""
         oid = ObjectID(oid_bytes)
         self.mark_escaped(oid)
+        sanitizer.note_pin(oid.hex())
         store_pin = getattr(self.node.store, "try_pin", None)
         return bool(store_pin(oid)) if store_pin is not None else False
 
@@ -2160,6 +2168,7 @@ class Runtime:
         oid = ObjectID(oid_bytes)
         with self._ref_lock:
             self._escaped.discard(oid)
+        sanitizer.note_unpin(oid.hex())
         store_unpin = getattr(self.node.store, "try_unpin", None)
         return bool(store_unpin(oid)) if store_unpin is not None else False
 
@@ -2192,6 +2201,9 @@ class Runtime:
                          namespace=namespace or self.namespace,
                          class_name=class_name)
         self.register_actor(info)
+        if name:
+            sanitizer.note_named_actor(name, namespace or self.namespace,
+                                       class_name)
         return True
 
     def ctl_actor_creation_spec(self, actor_id_bytes, spec: TaskSpec):
@@ -2497,8 +2509,7 @@ class Runtime:
                                    extra=extra)
             except Exception as e:
                 telemetry.note_swallowed("runtime.death_bundle", e)
-        threading.Thread(target=run, name="death-bundle",
-                         daemon=True).start()
+        sanitizer.spawn(run, name="death-bundle")
 
     # -- pubsub (reference: src/ray/pubsub/ long-poll publisher) ----------
 
@@ -2615,6 +2626,12 @@ def init_runtime(**kwargs) -> Runtime:
     with _runtime_lock:
         if _global_runtime is not None:
             return _global_runtime
+        # Leak-sanitizer baseline BEFORE the Runtime boots: the
+        # runtime's own long-lived threads (ref-gc, head-accept,
+        # node-dispatch, ...) must be inside the gate — a regression
+        # that leaves one running after shutdown() is exactly what the
+        # ratchet exists to catch (RAY_TPU_SANITIZE=1).
+        sanitizer.snapshot()
         rt = Runtime(**kwargs)
         _global_runtime = rt
-        return rt
+    return rt

@@ -571,8 +571,8 @@ class _TaskPool:
     def resize(self, n: int) -> None:
         while self._size < n:
             self._size += 1
-            threading.Thread(target=self._loop, name="task-exec",
-                             daemon=True).start()
+            from . import sanitizer
+            sanitizer.spawn(self._loop, name="task-exec")
 
     @property
     def size(self) -> int:

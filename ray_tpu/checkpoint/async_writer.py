@@ -153,7 +153,18 @@ class AsyncCheckpointWriter:
 
     def _run(self) -> None:
         while True:
-            job = self._q.get()
+            try:
+                # Bounded get, not a blocking one: when close() timed
+                # out on a wedged write it may fail to enqueue the None
+                # sentinel (a producer raced the queue slot) — the
+                # closed-flag check below still retires this thread once
+                # the wedged job finishes, instead of leaking it for the
+                # rest of the process (leak found by the sanitizer).
+                job = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
             if job is None:
                 return
             try:

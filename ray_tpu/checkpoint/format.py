@@ -223,10 +223,15 @@ def write_bytes_atomic(path: str, data: bytes) -> None:
     complete bytes or does not exist — never a torn prefix, and (with
     the fsync) never a size-correct zero-filled file after power loss
     on delayed-allocation filesystems."""
+    from .._private import sanitizer
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
                                prefix=os.path.basename(path) + ".tmp")
+    os.close(fd)
     try:
-        with os.fdopen(fd, "wb") as f:
+        # tracked_open: checkpoint write handles register with the leak
+        # sanitizer while open (RAY_TPU_SANITIZE=1), so a writer that
+        # wedges mid-publish is attributable in the shutdown diff.
+        with sanitizer.tracked_open(tmp, "wb") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())

@@ -70,6 +70,11 @@ class ReplicaHolder:
 def ensure_holder(experiment: str):
     """Driver-side: create (or find) the experiment's replica holder."""
     import ray_tpu
+    from .._private import sanitizer
+    # Session-lifetime by design: a second trainer resuming the same
+    # experiment in this session finds the first's RAM shards — declare
+    # it so the leak sanitizer doesn't report it at shutdown.
+    sanitizer.session_scoped(holder_name("*"))
     holder_cls = ray_tpu.remote(ReplicaHolder)
     return holder_cls.options(name=holder_name(experiment),
                               get_if_exists=True, num_cpus=0).remote()
@@ -113,16 +118,34 @@ class LocalPin:
         try:
             ref = ray_tpu.put(blob)
             _control("pin_object", ref.binary())
-            prev_entry = _control("kv_get", self.key)
-            _control("kv_put", self.key, pickle.dumps(
-                {"ref": ref.binary(), "step": step, "index": index}))
-            if prev_entry is not None:
-                _control("unpin_object", pickle.loads(prev_entry)["ref"])
         except Exception as e:
             telemetry.note_swallowed("checkpoint.replica.pin", e)
             return
+        try:
+            prev_entry = _control("kv_get", self.key)
+            _control("kv_put", self.key, pickle.dumps(
+                {"ref": ref.binary(), "step": step, "index": index}))
+        except Exception as e:
+            # The new pin has no durable record (no KV entry, nothing in
+            # self._pinned): nothing could ever unpin it — release it
+            # NOW or the blob stays pinned for the rest of the session
+            # (this was a real leak the RT304 dataflow rule found).
+            telemetry.note_swallowed("checkpoint.replica.pin", e)
+            try:
+                _control("unpin_object", ref.binary())
+            except Exception as e2:
+                telemetry.note_swallowed("checkpoint.replica.pin", e2)
+            return
         with self._lock:
             self._pinned = ref
+        if prev_entry is not None:
+            # Chain-unpin the predecessor (possibly a dead worker's)
+            # AFTER our own pin is durably advertised: a failure here
+            # leaks at most the old blob, never strands the new one.
+            try:
+                _control("unpin_object", pickle.loads(prev_entry)["ref"])
+            except Exception as e:
+                telemetry.note_swallowed("checkpoint.replica.unpin", e)
 
     def release(self) -> None:
         import pickle
@@ -176,7 +199,9 @@ def push_shard(holder, step: int, rank: int, index: dict,
     if holder is None:
         return False
     try:
-        holder.hold.remote(step, rank, index, blob)
+        # ray-tpu: detached — replica push is best-effort by contract:
+        # holder death loses only the fast path, disk stays authoritative.
+        holder.hold.remote(step, rank, index, blob)  # ray-tpu: detached
         return True
     except Exception as e:
         telemetry.note_swallowed("checkpoint.replica.push", e)

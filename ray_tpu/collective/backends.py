@@ -135,8 +135,9 @@ class _SocketP2P:
                 except Exception:
                     pass
                 return
-            threading.Thread(target=self._reader, args=(conn,),
-                             daemon=True).start()
+            from .._private import sanitizer
+            sanitizer.spawn(self._reader, args=(conn,),
+                            name="collective-reader")
 
     def _reader(self, conn) -> None:
         import queue as _q

@@ -88,8 +88,8 @@ class CppGateway:
         # Tensor hand-off segments whose replies may never be consumed
         # (client crash): unlinked at stop() unless the client already did.
         self._segments: set = set()
-        threading.Thread(target=self._accept_loop, name="cpp-gateway",
-                         daemon=True).start()
+        from ._private import sanitizer
+        sanitizer.spawn(self._accept_loop, name="cpp-gateway")
 
     # -- framing ----------------------------------------------------------- #
 
@@ -128,8 +128,9 @@ class CppGateway:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+            from ._private import sanitizer
+            sanitizer.spawn(self._serve, args=(conn,),
+                            name="cpp-gateway-serve")
 
     def _serve(self, conn) -> None:
         try:
@@ -222,6 +223,16 @@ class CppGateway:
 
     def stop(self) -> None:
         self._closed = True
+        # A thread blocked in accept() does not observe close() on Linux
+        # (it keeps blocking on the old fd): wake it with a dummy
+        # connect first, the same treatment node.py gives its acceptor —
+        # otherwise the gateway thread outlives stop() (sanitizer
+        # finding).
+        try:
+            s = socket.create_connection(self.address, timeout=1.0)
+            s.close()
+        except OSError:
+            pass
         try:
             self._sock.close()
         except Exception:

@@ -20,6 +20,8 @@ Endpoints (reference: dashboard/modules/*):
 
 from __future__ import annotations
 
+from .._private import aioloop as _aioloop
+
 import json
 import threading
 from typing import Optional
@@ -232,10 +234,13 @@ class DashboardServer:
             if not self._started.is_set():
                 self._error = e
                 self._started.set()
+        finally:
+            # Executor + loop retirement shared across the three
+            # daemon-loop servers (see _private/aioloop.py).
+            _aioloop.shutdown_loop(self._loop)
 
     def stop(self):
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        _aioloop.stop_loop_thread(self._loop, self._thread)
 
 
 def start_dashboard(port: int = 0, host: str = "127.0.0.1"

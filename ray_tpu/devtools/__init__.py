@@ -19,6 +19,15 @@ and understands ``ray_tpu`` semantics):
   wrappers build a per-process acquisition-order graph, flag cycles
   (AB/BA potential deadlocks) and sleeps under a held lock, and feed the
   findings into the flight-recorder debug bundle.
+
+* ``ray_tpu.devtools.dataflow`` — a per-function CFG builder + an
+  acquire/release pairing analysis over it; the RT3xx rule family
+  (``rules_dataflow``) runs on top: resources released on every path
+  (RT301), no dangling ObjectRefs (RT302, ``# ray-tpu: detached``
+  marker), KV prefixes with a delete/GC story (RT303), except paths
+  that keep the happy path's releases (RT304).  Its runtime twin is the
+  leak sanitizer in ``ray_tpu/_private/sanitizer.py``
+  (``RAY_TPU_SANITIZE=1``), on for the whole tier-1 suite.
 """
 
 from .lint import (Finding, LintResult, Rule, iter_rules, lint_paths,

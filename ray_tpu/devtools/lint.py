@@ -105,6 +105,12 @@ class Rule:
     summary: str = ""
     rationale: str = ""
     scope: str = "user"  # "user" | "internal"
+    #: True for rules that run over the per-function CFG
+    #: (devtools/dataflow.py) rather than single AST nodes.
+    dataflow: bool = False
+    #: Optional snippets for ``ray-tpu lint --explain RULE``.
+    example_bad: str = ""
+    example_good: str = ""
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         raise NotImplementedError
@@ -275,12 +281,41 @@ def format_json(result: LintResult) -> str:
 def rule_catalog_text() -> str:
     lines = []
     for rule in _RULES:
-        lines.append(f"{rule.id} [{rule.scope}] {rule.summary}")
+        tags = rule.scope + (", dataflow" if rule.dataflow else "")
+        lines.append(f"{rule.id} [{tags}] {rule.summary}")
         if rule.rationale:
             lines.append(f"    {rule.rationale}")
     return "\n".join(lines)
 
 
+def explain_text(rule_id: str) -> Optional[str]:
+    """Human explanation of one rule for ``ray-tpu lint --explain``:
+    summary, rationale, bad/good example (when recorded) and the
+    suppression syntax.  None for an unknown rule id."""
+    rid = rule_id.strip().upper()
+    rule = next((r for r in _RULES if r.id == rid), None)
+    if rule is None:
+        return None
+    tags = rule.scope + (", dataflow-backed" if rule.dataflow else "")
+    lines = [f"{rule.id} [{tags}] — {rule.summary}", ""]
+    if rule.rationale:
+        lines += [rule.rationale, ""]
+    if rule.example_bad:
+        lines.append("Bad:")
+        lines += ["    " + ln for ln in rule.example_bad.rstrip().
+                  splitlines()]
+        lines.append("")
+    if rule.example_good:
+        lines.append("Good:")
+        lines += ["    " + ln for ln in rule.example_good.rstrip().
+                  splitlines()]
+        lines.append("")
+    lines.append(f"Suppress a deliberate violation on its line with "
+                 f"`# ray-tpu: noqa[{rule.id}]` "
+                 f"(bare `# ray-tpu: noqa` suppresses every rule).")
+    return "\n".join(lines)
+
+
 # Rule modules self-register on import; they import helpers from this
 # module, so this must stay at the bottom.
-from . import rules_internal, rules_user  # noqa: E402,F401
+from . import rules_dataflow, rules_internal, rules_user  # noqa: E402,F401

@@ -70,6 +70,13 @@ def _is_str_join(call: ast.Call) -> bool:
 @register
 class BlockingUnderLock(Rule):
     id = "RT201"
+    example_bad = (
+        "with self._lock:\n"
+        "    time.sleep(1.0)     # every contender convoys\n")
+    example_good = (
+        "with self._lock:\n"
+        "    work = self._take()\n"
+        "time.sleep(1.0)         # block after releasing\n")
     scope = "internal"
     summary = "blocking call while holding a lock"
     rationale = ("A sleep/join/recv/wait/subprocess call under a held "
@@ -132,6 +139,16 @@ class BlockingUnderLock(Rule):
 @register
 class SwallowedException(Rule):
     id = "RT202"
+    example_bad = (
+        "try:\n"
+        "    handler(msg)\n"
+        "except Exception:\n"
+        "    pass                 # state corruption hides\n")
+    example_good = (
+        "try:\n"
+        "    handler(msg)\n"
+        "except Exception as e:\n"
+        "    telemetry.note_swallowed(\"runtime.handler\", e)\n")
     scope = "internal"
     summary = "bare `except Exception: pass` in a control-plane module"
     rationale = ("A silently swallowed control-plane error hides state "
@@ -164,6 +181,14 @@ class SwallowedException(Rule):
 @register
 class WallClockDuration(Rule):
     id = "RT203"
+    example_bad = (
+        "t0 = time.time()\n"
+        "work()\n"
+        "elapsed = time.time() - t0   # NTP step corrupts it\n")
+    example_good = (
+        "t0 = time.monotonic()\n"
+        "work()\n"
+        "elapsed = time.monotonic() - t0\n")
     scope = "internal"
     summary = "duration arithmetic on time.time()"
     rationale = ("Wall clocks step under NTP; intervals, deadlines and "
@@ -221,6 +246,11 @@ class WallClockDuration(Rule):
 @register
 class UnknownTelemetrySeries(Rule):
     id = "RT204"
+    example_bad = (
+        "telemetry.inc(\"ray_tpu_misspelled_total\")  # silently records nothing\n")
+    example_good = (
+        "# declare the series in util/telemetry.py CATALOG first\n"
+        "telemetry.inc(\"ray_tpu_serve_requests_total\")\n")
     scope = "internal"
     summary = "telemetry series name missing from the catalog"
     rationale = ("util/telemetry.py's CATALOG is the single source of "
@@ -299,6 +329,12 @@ _ATOMIC_PUBLISH_MODULES = (
 @register
 class NonAtomicPublish(Rule):
     id = "RT206"
+    example_bad = (
+        "with open(manifest_path, \"w\") as f:   # torn prefix on crash\n"
+        "    json.dump(doc, f)\n")
+    example_good = (
+        "write_bytes_atomic(manifest_path,\n"
+        "                   json.dumps(doc).encode())  # tmp + os.replace\n")
     scope = "internal"
     summary = "non-atomic file publication in a checkpoint/control-plane " \
               "module"
@@ -343,6 +379,15 @@ class NonAtomicPublish(Rule):
 @register
 class ProtocolHandlerMissing(Rule):
     id = "RT205"
+    example_bad = (
+        "@dataclass\n"
+        "class NewMessage:      # declared in protocol.py...\n"
+        "    x: int = 0\n"
+        "# ...but no isinstance(msg, NewMessage) handler anywhere\n")
+    example_good = (
+        "# in worker.py/node.py/runtime.py/cluster.py:\n"
+        "if isinstance(msg, NewMessage):\n"
+        "    handle_new_message(msg)\n")
     scope = "internal"
     summary = "protocol message type with no registered handler"
     rationale = ("Every dataclass in _private/protocol.py must be "

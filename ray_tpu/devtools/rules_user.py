@@ -126,6 +126,14 @@ def _remote_functions(ctx: ModuleContext):
 @register
 class NestedBlockingGet(Rule):
     id = "RT101"
+    example_bad = (
+        "@ray_tpu.remote\n"
+        "def outer(ref):\n"
+        "    return ray_tpu.get(ref) + 1\n")
+    example_good = (
+        "@ray_tpu.remote\n"
+        "def outer(x):          # take the VALUE\n"
+        "    return x + 1\n")
     scope = "user"
     summary = "blocking get() inside a @remote function/actor method"
     rationale = ("A task that blocks on get() occupies its worker while "
@@ -152,6 +160,11 @@ class NestedBlockingGet(Rule):
 @register
 class GetInLoop(Rule):
     id = "RT102"
+    example_bad = (
+        "for r in refs:\n"
+        "    out.append(ray_tpu.get(r))   # serializes the batch\n")
+    example_good = (
+        "out = ray_tpu.get(refs)             # one batched get\n")
     scope = "user"
     summary = "get() called per item in a loop over refs"
     rationale = ("get() per loop iteration serializes the whole batch "
@@ -215,6 +228,18 @@ class GetInLoop(Rule):
 @register
 class LargeCapture(Rule):
     id = "RT103"
+    example_bad = (
+        "TABLE = np.zeros((1000, 1000))\n"
+        "\n"
+        "@ray_tpu.remote\n"
+        "def f(i):\n"
+        "    return TABLE[i].sum()   # re-shipped per submit\n")
+    example_good = (
+        "ref = ray_tpu.put(TABLE)   # ship once\n"
+        "\n"
+        "@ray_tpu.remote\n"
+        "def f(table, i):\n"
+        "    return table[i].sum()\n")
     scope = "user"
     summary = "large literal/array captured in a remote closure"
     rationale = ("Each .remote() call re-serializes captured arguments; "
@@ -257,6 +282,19 @@ class LargeCapture(Rule):
 @register
 class UnserializableCapture(Rule):
     id = "RT104"
+    example_bad = (
+        "LOCK = threading.Lock()\n"
+        "\n"
+        "@ray_tpu.remote\n"
+        "def f():\n"
+        "    with LOCK:              # locks do not pickle\n"
+        "        return 1\n")
+    example_good = (
+        "@ray_tpu.remote\n"
+        "def f():\n"
+        "    lock = threading.Lock()  # create inside the task\n"
+        "    with lock:\n"
+        "        return 1\n")
     scope = "user"
     summary = "unserializable object in a .remote() call/closure"
     rationale = ("Locks, file handles and sockets do not survive "
@@ -301,6 +339,16 @@ class UnserializableCapture(Rule):
 @register
 class ActorSelfCall(Rule):
     id = "RT105"
+    example_bad = (
+        "@ray_tpu.remote\n"
+        "class A:\n"
+        "    def run(self):\n"
+        "        return self.step.remote()   # own busy queue\n")
+    example_good = (
+        "@ray_tpu.remote\n"
+        "class A:\n"
+        "    def run(self):\n"
+        "        return self.step()          # direct call\n")
     scope = "user"
     summary = "actor method .remote()-calls its own actor"
     rationale = ("self.method.remote() from inside the actor targets the "

@@ -147,14 +147,21 @@ class JobManager:
     def _refresh(self, submission_id: str) -> JobInfo:
         info = self._jobs[submission_id]
         if info.status in JobStatus.TERMINAL:
+            self._reap_supervisor(submission_id)
             return info
-        sup = self._supervisors[submission_id]
+        sup = self._supervisors.get(submission_id)
+        if sup is None:
+            info.status = JobStatus.FAILED
+            info.message = "supervisor gone"
+            info.end_time = time.time()
+            return info
         try:
             code = ray_tpu.get(sup.poll.remote(), timeout=30)
         except Exception as e:
             info.status = JobStatus.FAILED
             info.message = f"supervisor died: {e!r}"
             info.end_time = time.time()
+            self._reap_supervisor(submission_id)
             return info
         if code is None:
             return info
@@ -164,7 +171,34 @@ class JobManager:
         else:
             info.status = JobStatus.FAILED
             info.message = f"entrypoint exited with code {code}"
+        # Terminal: the supervisor actor has nothing left to supervise —
+        # without this reap every submitted job leaks one named actor
+        # (and its worker process) for the rest of the session (found by
+        # the leak sanitizer).  Logs stay readable from the head-local
+        # log file.
+        self._reap_supervisor(submission_id)
         return info
+
+    def _reap_supervisor(self, submission_id: str) -> None:
+        sup = self._supervisors.pop(submission_id, None)
+        if sup is None:
+            return
+        # Pull the log bytes down BEFORE the kill: on a multi-node
+        # cluster the supervisor wrote its log file on ITS node, so the
+        # head-local fallback in get_job_logs would otherwise read
+        # nothing once the actor is gone.
+        log_path = os.path.join(self.log_dir, f"{submission_id}.log")
+        try:
+            if not os.path.exists(log_path):
+                data = ray_tpu.get(sup.logs.remote(), timeout=30)
+                with open(log_path, "wb") as f:
+                    f.write(data)
+        except Exception:
+            pass  # dead supervisor: whatever is on disk is all there is
+        try:
+            ray_tpu.kill(sup)
+        except Exception:
+            pass  # actor already dead / runtime tearing down
 
     def get_job_status(self, submission_id: str) -> str:
         return self._refresh(submission_id).status
@@ -183,13 +217,23 @@ class JobManager:
             self._supervisors[submission_id].stop.remote(), timeout=30)
         info.status = JobStatus.STOPPED
         info.end_time = time.time()
+        self._reap_supervisor(submission_id)
         return bool(stopped)
 
     def get_job_logs(self, submission_id: str) -> str:
         if submission_id not in self._jobs:
             raise KeyError(submission_id)
-        data = ray_tpu.get(
-            self._supervisors[submission_id].logs.remote(), timeout=30)
+        sup = self._supervisors.get(submission_id)
+        if sup is None:
+            # Supervisor reaped at job end: the log file on the head is
+            # the durable copy.
+            log_path = os.path.join(self.log_dir, f"{submission_id}.log")
+            try:
+                with open(log_path, "rb") as f:
+                    return f.read().decode(errors="replace")
+            except FileNotFoundError:
+                return ""
+        data = ray_tpu.get(sup.logs.remote(), timeout=30)
         return data.decode(errors="replace")
 
     def wait_until_finished(self, submission_id: str,

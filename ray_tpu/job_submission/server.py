@@ -7,6 +7,8 @@ Reference: dashboard/modules/job/job_head.py (REST routes
 
 from __future__ import annotations
 
+from ray_tpu._private import aioloop as _aioloop
+
 import asyncio
 import threading
 from typing import Any, Dict, Optional
@@ -181,7 +183,10 @@ class JobServer:
             self._loop.run_until_complete(main())
         except Exception:
             pass
+        finally:
+            # Executor + loop retirement shared across the three
+            # daemon-loop servers (see _private/aioloop.py).
+            _aioloop.shutdown_loop(self._loop)
 
     def stop(self):
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        _aioloop.stop_loop_thread(self._loop, self._thread)

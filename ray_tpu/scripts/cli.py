@@ -307,11 +307,14 @@ def stack(address, timeout, output):
               default="text", show_default=True)
 @click.option("--list-rules", is_flag=True,
               help="Print the rule catalog and exit.")
+@click.option("--explain", "explain_rule", metavar="RULE", default=None,
+              help="Print one rule's rationale, a bad/good example and "
+                   "the suppression syntax, then exit.")
 @click.option("--internal/--no-internal", "internal", default=None,
               help="Force framework-internal rules on/off (default: "
                    "auto-detect per file — on for files inside a "
                    "ray_tpu package tree).")
-def lint(paths, fmt, list_rules, internal):
+def lint(paths, fmt, list_rules, explain_rule, internal):
     """Framework-aware static analysis (see README "Static analysis").
 
     Checks user code for ray_tpu anti-patterns (blocking get() inside
@@ -325,6 +328,14 @@ def lint(paths, fmt, list_rules, internal):
     from ray_tpu.devtools import lint as lint_mod
     if list_rules:
         click.echo(lint_mod.rule_catalog_text())
+        return
+    if explain_rule is not None:
+        text = lint_mod.explain_text(explain_rule)
+        if text is None:
+            click.echo(f"unknown rule {explain_rule!r} "
+                       f"(see --list-rules)")
+            raise SystemExit(1)
+        click.echo(text)
         return
     if not paths:
         paths = (".",)

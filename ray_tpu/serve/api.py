@@ -19,6 +19,8 @@ from __future__ import annotations
 import random
 import threading
 import time
+
+from .._private import aioloop as _aioloop
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, TYPE_CHECKING
 
@@ -266,6 +268,10 @@ def _controller() -> Any:
         return cached
     from .controller import (CONTROLLER_NAME, CONTROLLER_NAMESPACE,
                              ServeControllerActor)
+    # Session-lifetime by design: deployments keep serving after the
+    # driver's handles are gone — declare it to the leak sanitizer.
+    from .._private import sanitizer
+    sanitizer.session_scoped(CONTROLLER_NAME)
     cls = ray_tpu.remote(ServeControllerActor)
     last_exc: Optional[Exception] = None
     for _attempt in range(10):
@@ -459,7 +465,9 @@ class _Router:
                             counts = {k: v
                                       for k, v in self._inflight.items()
                                       if v}
-                        ctrl.report_metrics.remote(
+                        # Best-effort stats push; a lost tick is
+                        # replaced by the next one.
+                        ctrl.report_metrics.remote(  # ray-tpu: detached
                             self.name, self.router_id, counts)
                     except Exception:
                         # Transient (controller swap, runtime teardown
@@ -472,8 +480,8 @@ class _Router:
                 # starve the autoscaler and mis-drain downscales).
                 with self._lock:
                     self._metrics_started = False
-        threading.Thread(target=push, name=f"serve-metrics-{self.name}",
-                         daemon=True).start()
+        from .._private import sanitizer
+        sanitizer.spawn(push, name=f"serve-metrics-{self.name}")
 
 
 def _router_for(name: str) -> _Router:
@@ -568,7 +576,8 @@ class DeploymentHandle:
             router.note_done(hexid)
             _note_latency()
         # Decrement when the result materializes.
-        threading.Thread(target=_done, daemon=True).start()
+        from .._private import sanitizer
+        sanitizer.spawn(_done, name="serve-done-watch")
         return ref
 
 
@@ -761,10 +770,13 @@ class _HttpServer:
             self._loop.run_until_complete(main())
         except Exception:
             pass
+        finally:
+            # Executor + loop retirement shared across the three
+            # daemon-loop servers (see _private/aioloop.py).
+            _aioloop.shutdown_loop(self._loop)
 
     def stop(self):
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+        _aioloop.stop_loop_thread(self._loop, self._thread)
 
 
 def _ensure_http(port: int) -> None:

@@ -224,8 +224,8 @@ class ServeController:
                 ray_tpu.kill(r)
             except Exception:
                 pass
-        threading.Thread(target=drain, name="serve-drain",
-                         daemon=True).start()
+        from .._private import sanitizer
+        sanitizer.spawn(drain, name="serve-drain")
 
     def _publish(self, state) -> None:
         with state._lock:

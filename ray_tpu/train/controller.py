@@ -243,6 +243,11 @@ class TrainController:
                 if payload.get("checkpoint_dir"):
                     self.manager.register(payload["checkpoint_dir"],
                                           payload["metrics"])
+            # Consumed: GC the key (RT303) — report keys are write-once
+            # per (rank, incarnation, seq); without the delete every run
+            # grows the head KV forever.  The payload lives on in
+            # self._reports.
+            _control("kv_del", key)
         self._poll_ckpt_acks()
 
     def _poll_ckpt_acks(self) -> None:
@@ -260,7 +265,40 @@ class TrainController:
                 continue  # not marked seen: the read stays retryable
             self._seen_ack_keys.add(key)
             self.manager.note_ack(pickle.loads(data))
+            # Consumed: GC the ack key (each is one (step, rank, nonce)
+            # write-once record; note_ack holds the payload from here).
+            _control("kv_del", key)
         self.manager.commit_ready()
+
+    def _release_orphan_pins(self) -> None:
+        """End-of-run sweep of ``ckpt/pin/<experiment>/*``.
+
+        A worker killed mid-save leaves its newest blob pinned in the
+        host object store with only its KV entry pointing at it — by
+        design, so the NEXT incarnation chain-unpins it.  When the run
+        ends there is no next incarnation: release whatever is left, or
+        the blobs stay pinned (and escape-marked) for the rest of the
+        session.  Live workers already released their own pins at
+        train-fn teardown; this only reaps dead incarnations' leftovers
+        (a leak the runtime sanitizer catches without this sweep).
+        """
+        from .._private.api import _control
+        from ..util import telemetry
+        try:
+            prefix = f"ckpt/pin/{self.run_config.name}/"
+            for key in _control("kv_keys", prefix):
+                entry = _control("kv_get", key)
+                if entry is None:
+                    continue
+                try:
+                    ref = pickle.loads(entry).get("ref")
+                except Exception:
+                    ref = None
+                if ref is not None:
+                    _control("unpin_object", ref)
+                _control("kv_del", key)
+        except Exception as e:  # noqa: BLE001 — sweep is best-effort
+            telemetry.note_swallowed("train.release_orphan_pins", e)
 
     # -- main loop ----------------------------------------------------------
 
@@ -400,6 +438,9 @@ class TrainController:
             # monitor thread and join pending bundle writers.
             self.watchdog.stop()
             self.goodput.finish()
+            if getattr(self.run_config.checkpoint_config,
+                       "emergency_replica", False):
+                self._release_orphan_pins()
         rank0 = sorted((r for r in self._reports if r["rank"] == 0),
                        key=lambda r: r["time"])
         last_metrics = rank0[-1]["metrics"] if rank0 else {}

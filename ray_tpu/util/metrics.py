@@ -258,8 +258,8 @@ def _ensure_flusher() -> None:
             time.sleep(2.0)
             flush()
 
-    threading.Thread(target=loop, daemon=True,
-                     name="ray_tpu-metrics-flush").start()
+    from ray_tpu._private import sanitizer
+    sanitizer.spawn(loop, name="ray_tpu-metrics-flush")
 
 
 def _merged_snapshots() -> List[Dict[str, Any]]:
@@ -357,8 +357,8 @@ def start_metrics_server(port: int = 0):
 
     stop_metrics_server()  # a leftover server would serve the old registry
     _server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    threading.Thread(target=_server.serve_forever, daemon=True,
-                     name="ray_tpu-metrics-http").start()
+    from ray_tpu._private import sanitizer
+    sanitizer.spawn(_server.serve_forever, name="ray_tpu-metrics-http")
     return _server.server_address[1]
 
 

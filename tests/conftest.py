@@ -43,6 +43,11 @@ if not os.environ.get("RAY_TPU_TEST_REAL_TPU") \
     os.execve(sys.executable,
               [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Resource-leak sanitizer on for the whole suite: every test that
+# starts a cluster also asserts, at shutdown, that no framework
+# threads / pins / tracked file handles / named actors leaked
+# (ray_tpu/_private/sanitizer.py).  Opt out with RAY_TPU_SANITIZE=0.
+os.environ.setdefault("RAY_TPU_SANITIZE", "1")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,538 @@
+"""CFG/dataflow engine tests: CFG shape unit tests (try/finally, loop
+back-edges, with desugaring, early return inside except), a good/bad
+pair per RT3xx rule, the `# ray-tpu: detached` marker, suppression, and
+the --explain / --list-rules CLI surface."""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools import dataflow, lint_source
+from ray_tpu.devtools.dataflow import analyze_function, build_cfg
+
+
+def fn_of(src: str):
+    tree = ast.parse(src)
+    return next(n for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+
+
+def leaks_of(src: str):
+    return analyze_function(fn_of(src))
+
+
+def rule_ids(src, path="ray_tpu/somepkg/mod.py"):
+    return [f.rule for f in lint_source(src, path=path, internal=True)]
+
+
+# -- CFG unit tests ---------------------------------------------------------
+
+
+class TestCfgShapes:
+    def test_linear_sequence(self):
+        cfg = build_cfg(fn_of("def f():\n    a = 1\n    b = 2\n"))
+        # entry -> a -> b -> exit
+        kinds = [n.kind for n in cfg.nodes]
+        assert kinds.count("stmt") == 2
+        stmt_idxs = [n.idx for n in cfg.nodes if n.kind == "stmt"]
+        assert cfg.successors(cfg.entry) == [stmt_idxs[0]]
+        assert cfg.exit in cfg.successors(stmt_idxs[1])
+
+    def test_branch_joins(self):
+        cfg = build_cfg(fn_of("""
+def f(x):
+    if x:
+        a = 1
+    else:
+        b = 2
+    c = 3
+"""))
+        # both branch tails reach the join statement
+        c_node = next(n for n in cfg.nodes if n.kind == "stmt" and
+                      isinstance(n.stmt, ast.Assign) and
+                      n.stmt.targets[0].id == "c")
+        preds = [i for i in range(len(cfg.nodes))
+                 if c_node.idx in cfg.successors(i)]
+        assert len(preds) == 2
+
+    def test_loop_back_edge(self):
+        cfg = build_cfg(fn_of("""
+def f(items):
+    for it in items:
+        use(it)
+    done()
+"""))
+        head = next(n for n in cfg.nodes if n.kind == "loop-head")
+        body = next(n for n in cfg.nodes if n.kind == "stmt" and
+                    isinstance(n.stmt, ast.Expr) and
+                    "use" in ast.unparse(n.stmt))
+        # body falls back to the head (back edge), head exits the loop
+        assert head.idx in cfg.successors(body.idx)
+        after = next(n for n in cfg.nodes if n.kind == "stmt" and
+                     "done" in ast.unparse(n.stmt))
+        assert after.idx in cfg.successors(head.idx)
+
+    def test_while_true_only_exits_via_break(self):
+        cfg = build_cfg(fn_of("""
+def f():
+    while True:
+        if ready():
+            break
+    after()
+"""))
+        head = next(n for n in cfg.nodes if n.kind == "loop-head")
+        after = next(n for n in cfg.nodes if n.kind == "stmt" and
+                     "after" in ast.unparse(n.stmt))
+        assert after.idx not in cfg.successors(head.idx)
+        brk = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Break))
+        assert after.idx in cfg.successors(brk.idx)
+
+    def test_with_desugars_to_enter_exit(self):
+        cfg = build_cfg(fn_of("""
+def f(p):
+    with open(p) as fh:
+        fh.read()
+    after()
+"""))
+        kinds = [n.kind for n in cfg.nodes]
+        assert "with" in kinds and "with-exit" in kinds
+        w = next(n for n in cfg.nodes if n.kind == "with")
+        x = next(n for n in cfg.nodes if n.kind == "with-exit")
+        body = next(n for n in cfg.nodes if n.kind == "stmt" and
+                    "read" in ast.unparse(n.stmt))
+        assert body.idx in cfg.successors(w.idx)
+        assert x.idx in cfg.successors(body.idx)
+
+    def test_try_body_has_exception_edge_to_handler(self):
+        cfg = build_cfg(fn_of("""
+def f():
+    try:
+        work()
+    except Exception:
+        cleanup()
+"""))
+        handler = next(n for n in cfg.nodes if n.kind == "except")
+        work = next(n for n in cfg.nodes if n.kind == "stmt" and
+                    "work" in ast.unparse(n.stmt))
+        assert handler.idx in cfg.successors(work.idx, labels=("exc",))
+        assert handler.idx not in cfg.successors(work.idx,
+                                                 labels=("normal",))
+
+    def test_return_in_try_runs_finally(self):
+        cfg = build_cfg(fn_of("""
+def f():
+    try:
+        return 1
+    finally:
+        cleanup()
+"""))
+        ret = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Return))
+        # the return's successor is a finally instance, not the exit
+        succ = cfg.successors(ret.idx)
+        assert cfg.exit not in succ
+        assert any(cfg.nodes[s].kind == "finally" for s in succ)
+
+    def test_early_return_inside_except(self):
+        cfg = build_cfg(fn_of("""
+def f():
+    try:
+        work()
+    except Exception:
+        return None
+    after()
+"""))
+        handler = next(n for n in cfg.nodes if n.kind == "except")
+        ret = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Return))
+        # handler -> return -> exit; the join statement is NOT on that path
+        assert ret.idx in cfg.successors(handler.idx)
+        assert cfg.exit in cfg.successors(ret.idx)
+        after = next(n for n in cfg.nodes if n.kind == "stmt" and
+                     "after" in ast.unparse(n.stmt))
+        assert after.idx not in cfg.successors(ret.idx)
+
+
+# -- analysis-level pairs ---------------------------------------------------
+
+
+class TestAnalyzeFunction:
+    def test_finally_release_settles_exception_path(self):
+        assert leaks_of("""
+def f(store, oid):
+    store.try_pin(oid)
+    try:
+        work(oid)
+    finally:
+        store.try_unpin(oid)
+""") == []
+
+    def test_loop_backedge_terminates_and_release_after_loop(self):
+        assert leaks_of("""
+def f(store, oid, items):
+    store.try_pin(oid)
+    for it in items:
+        use(it)
+    store.try_unpin(oid)
+""") == []
+
+    def test_release_only_inside_loop_body_is_clean(self):
+        # acquire+release both inside the body: every path through an
+        # iteration is settled before the back edge.
+        assert leaks_of("""
+def f(store, items):
+    for it in items:
+        store.try_pin(it)
+        use(it)
+        store.try_unpin(it)
+""") == []
+
+
+# -- RT301 ------------------------------------------------------------------
+
+
+class TestRT301:
+    BAD = """
+def stage(store, oid, flag):
+    store.try_pin(oid)
+    if flag:
+        return None
+    store.try_unpin(oid)
+"""
+
+    GOOD = """
+def stage(store, oid, flag):
+    store.try_pin(oid)
+    try:
+        if flag:
+            return None
+    finally:
+        store.try_unpin(oid)
+"""
+
+    def test_bad(self):
+        findings = lint_source(self.BAD, internal=True)
+        assert [f.rule for f in findings] == ["RT301"]
+        assert "try_pin" in findings[0].message
+
+    def test_good(self):
+        assert rule_ids(self.GOOD) == []
+
+    def test_thread_fire_and_forget_bad(self):
+        src = """
+import threading
+
+def f(run):
+    threading.Thread(target=run, daemon=True).start()
+"""
+        assert rule_ids(src) == ["RT301"]
+
+    def test_thread_spawn_helper_good(self):
+        src = """
+from ray_tpu._private import sanitizer
+
+def f(run):
+    sanitizer.spawn(run, name="bg")
+"""
+        assert rule_ids(src) == []
+
+    def test_thread_joined_good(self):
+        src = """
+import threading
+
+def f(run):
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(5)
+"""
+        assert rule_ids(src) == []
+
+    def test_open_without_close_bad_with_close_good(self):
+        bad = """
+def f(p):
+    fh = open(p)
+    return fh.read()
+"""
+        good = bad.replace("return fh.read()",
+                           "data = fh.read()\n    fh.close()\n"
+                           "    return data")
+        assert rule_ids(bad) == ["RT301"]
+        assert rule_ids(good) == []
+
+    def test_with_open_good(self):
+        src = """
+def f(p):
+    with open(p) as fh:
+        return fh.read()
+"""
+        assert rule_ids(src) == []
+
+    def test_bare_lock_acquire_bad(self):
+        src = """
+def f(lock):
+    lock.acquire()
+    work()
+"""
+        assert rule_ids(src) == ["RT301"]
+
+    def test_lock_acquire_release_good(self):
+        src = """
+def f(lock):
+    lock.acquire()
+    try:
+        work()
+    finally:
+        lock.release()
+"""
+        assert rule_ids(src) == []
+
+    def test_suppression(self):
+        patched = self.BAD.replace(
+            "store.try_pin(oid)",
+            "store.try_pin(oid)  # ray-tpu: noqa[RT301]")
+        assert rule_ids(patched) == []
+
+    def test_user_scope_skips(self):
+        assert [f.rule for f in lint_source(self.BAD, internal=False)] == []
+
+
+# -- RT304 ------------------------------------------------------------------
+
+
+class TestRT304:
+    BAD = """
+def pin(self, blob, kv):
+    ref = put(blob)
+    _control("pin_object", ref.binary())
+    try:
+        kv.put(self.key)
+    except Exception:
+        return
+    self._pinned = ref
+"""
+
+    GOOD = """
+def pin(self, blob, kv):
+    ref = put(blob)
+    _control("pin_object", ref.binary())
+    try:
+        kv.put(self.key)
+    except Exception:
+        _control("unpin_object", ref.binary())
+        return
+    self._pinned = ref
+"""
+
+    def test_bad(self):
+        findings = lint_source(self.BAD, internal=True)
+        assert [f.rule for f in findings] == ["RT304"]
+        assert "except path" in findings[0].message
+
+    def test_good(self):
+        assert rule_ids(self.GOOD) == []
+
+    def test_handler_line_suppression(self):
+        patched = self.BAD.replace(
+            "    except Exception:",
+            "    except Exception:  # ray-tpu: noqa[RT304]")
+        assert rule_ids(patched) == []
+
+
+# -- RT302 ------------------------------------------------------------------
+
+
+class TestRT302:
+    def test_discarded_ref_bad(self):
+        src = """
+def f(h):
+    h.refresh.remote()
+"""
+        findings = lint_source(src, internal=True)
+        assert [f.rule for f in findings] == ["RT302"]
+
+    def test_detached_marker_good(self):
+        src = """
+def f(h):
+    h.refresh.remote()  # ray-tpu: detached
+"""
+        assert rule_ids(src) == []
+
+    def test_unused_binding_bad(self):
+        src = """
+def f(h):
+    ref = h.work.remote()
+    return 1
+"""
+        findings = lint_source(src, internal=True)
+        assert [f.rule for f in findings] == ["RT302"]
+        assert "ref" in findings[0].message
+
+    def test_consumed_ref_good(self):
+        src = """
+def f(h, get):
+    ref = h.work.remote()
+    return get(ref)
+"""
+        assert rule_ids(src) == []
+
+    def test_rebinding_after_use_still_flagged(self):
+        # The Load at use(r) consumed the FIRST ref; the rebinding's
+        # result is dangling and must be flagged.
+        src = """
+def f(h, use):
+    r = h.a.remote()
+    use(r)
+    r = h.b.remote()
+    return 1
+"""
+        assert rule_ids(src) == ["RT302"]
+
+    def test_loop_carried_ref_clean(self):
+        # In a loop a textually earlier Load runs after the rebinding
+        # on the next iteration: not dangling.
+        src = """
+def f(h, use, xs):
+    r = None
+    for x in xs:
+        if r is not None:
+            use(r)
+        r = h.b.remote()
+    use(r)
+"""
+        assert rule_ids(src) == []
+
+    def test_closure_use_counts(self):
+        src = """
+def f(h, later):
+    ref = h.work.remote()
+    def cb():
+        return later(ref)
+    return cb
+"""
+        assert rule_ids(src) == []
+
+
+# -- RT303 ------------------------------------------------------------------
+
+
+class TestRT303:
+    BAD = """
+def publish(run_id, blob, _control):
+    _control("kv_put", f"myfeat/{run_id}/x", blob)
+"""
+
+    GOOD = """
+def publish(run_id, blob, _control):
+    _control("kv_put", f"myfeat/{run_id}/x", blob)
+
+def gc(run_id, _control):
+    _control("kv_del", f"myfeat/{run_id}/x")
+"""
+
+    def test_bad(self):
+        findings = lint_source(self.BAD, internal=True, path="<snippet>")
+        assert [f.rule for f in findings] == ["RT303"]
+        assert "myfeat/" in findings[0].message
+
+    def test_good_same_module_delete(self):
+        assert [f.rule for f in lint_source(
+            self.GOOD, internal=True, path="<snippet>")] == []
+
+    def test_generic_gc_loop_counts(self):
+        src = """
+def publish(run_id, blob, _control):
+    _control("kv_put", f"myfeat/{run_id}/x", blob)
+
+def consume(_control):
+    for key in _control("kv_keys", "myfeat/"):
+        _control("kv_del", key)
+"""
+        assert [f.rule for f in lint_source(
+            src, internal=True, path="<snippet>")] == []
+
+    def test_constant_singleton_key_exempt(self):
+        src = """
+KEY = "registry/services"
+
+def publish(blob, _control):
+    _control("kv_put", KEY, blob)
+"""
+        assert [f.rule for f in lint_source(
+            src, internal=True, path="<snippet>")] == []
+
+    def test_subsystem_scan_across_files(self, tmp_path):
+        from ray_tpu.devtools import lint_paths
+        sub = tmp_path / "ray_tpu" / "feat"
+        sub.mkdir(parents=True)
+        (sub / "writer.py").write_text(
+            'def publish(run_id, blob, _control):\n'
+            '    _control("kv_put", f"feat/{run_id}/x", blob)\n')
+        res = lint_paths([str(sub)], internal=True)
+        assert [f.rule for f in res.findings] == ["RT303"]
+        # A sibling module's GC makes the subsystem clean.
+        (sub / "gc.py").write_text(
+            'def sweep(run_id, _control):\n'
+            '    _control("kv_del", f"feat/{run_id}/x")\n')
+        from ray_tpu.devtools import rules_dataflow
+        rules_dataflow._subsystem_cache.clear()
+        res = lint_paths([str(sub)], internal=True)
+        assert res.findings == []
+
+
+# -- injected-leak chaos (static half; runtime half in test_sanitizer) ------
+
+
+class TestInjectedLeakStatic:
+    #: The exact leak shape PR 4's review caught by hand — a worker that
+    #: pins its blob, then dies before any path unpins it.
+    INJECTED = """
+def stage_blob(self, store, blob, kv):
+    ref = self.put(blob)
+    store.try_pin(ref)
+    kv.put("ckpt/pin/exp/0", ref)
+"""
+
+    def test_static_rule_catches_injected_leak(self):
+        findings = lint_source(self.INJECTED, internal=True)
+        assert "RT301" in [f.rule for f in findings]
+
+
+# -- CLI surface ------------------------------------------------------------
+
+
+class TestCliSurface:
+    def test_list_rules_marks_dataflow(self):
+        from click.testing import CliRunner
+
+        from ray_tpu.scripts.cli import cli
+        r = CliRunner().invoke(cli, ["lint", "--list-rules"])
+        assert r.exit_code == 0
+        for rid in ("RT301", "RT302", "RT303", "RT304"):
+            assert rid in r.output
+        assert "dataflow" in r.output
+
+    def test_explain_rule(self):
+        from click.testing import CliRunner
+
+        from ray_tpu.scripts.cli import cli
+        r = CliRunner().invoke(cli, ["lint", "--explain", "RT301"])
+        assert r.exit_code == 0
+        assert "Bad:" in r.output and "Good:" in r.output
+        assert "noqa[RT301]" in r.output
+        r = CliRunner().invoke(cli, ["lint", "--explain", "rt304"])
+        assert r.exit_code == 0
+        assert "except" in r.output.lower()
+
+    def test_explain_unknown_rule_exits_nonzero(self):
+        from click.testing import CliRunner
+
+        from ray_tpu.scripts.cli import cli
+        r = CliRunner().invoke(cli, ["lint", "--explain", "RT999"])
+        assert r.exit_code == 1
+
+    def test_explain_covers_every_registered_rule(self):
+        from ray_tpu.devtools.lint import explain_text, iter_rules
+        for rule in iter_rules():
+            text = explain_text(rule.id)
+            assert text is not None and rule.id in text
+            assert "Bad:" in text and "Good:" in text, \
+                f"{rule.id} needs a bad/good example pair"
+            assert f"noqa[{rule.id}]" in text

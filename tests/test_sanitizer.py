@@ -1,0 +1,261 @@
+"""Runtime leak-sanitizer tests: injected-leak chaos (the runtime half
+of the static/dynamic pair in test_dataflow.py), clean-shutdown green
+path, leak_findings.json in debug bundles, and regression tests for the
+real leaks the RT3xx pass found (LocalPin exception path, async-writer
+thread at close timeout, job-supervisor reaping, train KV key GC)."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import sanitizer
+from ray_tpu._private.sanitizer import LeakError
+
+
+@pytest.fixture(autouse=True)
+def _fast_grace(monkeypatch):
+    # Installed suite-wide by conftest (RAY_TPU_SANITIZE=1); keep the
+    # post-shutdown wind-down wait short for leak-injection tests.
+    assert sanitizer.is_enabled()
+    monkeypatch.setattr(sanitizer, "DEFAULT_GRACE_S", 1.0)
+    yield
+
+
+@ray_tpu.remote
+def _echo(x):
+    return x
+
+
+class TestShutdownGate:
+    def test_clean_cluster_passes(self):
+        ray_tpu.init(num_cpus=2)
+        assert ray_tpu.get(_echo.remote(7)) == 7
+        ray_tpu.shutdown()  # must not raise
+
+    def test_injected_pin_leak_caught(self):
+        """Runtime half of the injected-leak chaos pair: a pin with no
+        unpin on any path trips the shutdown gate with its site."""
+        rt = ray_tpu.init(num_cpus=2)
+        ref = ray_tpu.put(b"snapshot-blob")
+        rt.ctl_pin_object(ref.binary())
+        with pytest.raises(LeakError) as ei:
+            ray_tpu.shutdown()
+        msg = str(ei.value)
+        assert "[pin]" in msg
+        assert "pinned at" in msg
+        # Clean the registry so later clusters start from zero.
+        sanitizer.note_unpin(ref.binary().hex())
+
+    def test_injected_thread_leak_caught(self):
+        ray_tpu.init(num_cpus=2)
+        release = threading.Event()
+        t = sanitizer.spawn(release.wait, name="injected-leak-thread")
+        try:
+            with pytest.raises(LeakError) as ei:
+                ray_tpu.shutdown()
+            msg = str(ei.value)
+            assert "injected-leak-thread" in msg
+            assert "created at" in msg
+        finally:
+            release.set()
+            t.join(5)
+
+    def test_injected_named_actor_leak_caught(self):
+        rt = ray_tpu.init(num_cpus=2)
+
+        class Holder:
+            def ping(self):
+                return "ok"
+
+        h = ray_tpu.remote(Holder).options(name="leaky-holder").remote()
+        ray_tpu.get(h.ping.remote())
+        # User-created named actors are reaped by shutdown by design and
+        # are NOT leaks; simulate a framework-created one by registering
+        # it the way a subsystem frame would.
+        with sanitizer._state.mu:
+            sanitizer._state.named_actors["default/leaky-holder"] = {
+                "name": "leaky-holder", "namespace": "default",
+                "class_name": "Holder",
+                "site": "ray_tpu/somepkg/mod.py:1", "stack": []}
+        try:
+            with pytest.raises(LeakError) as ei:
+                ray_tpu.shutdown()
+            assert "leaky-holder" in str(ei.value)
+        finally:
+            with sanitizer._state.mu:
+                sanitizer._state.named_actors.pop(
+                    "default/leaky-holder", None)
+
+    def test_session_scoped_name_is_exempt(self):
+        ray_tpu.init(num_cpus=2)
+        from ray_tpu.checkpoint import replica
+        holder = replica.ensure_holder("san-exp")
+        assert ray_tpu.get(holder.stats.remote())["ranks"] == 0
+        ray_tpu.shutdown()  # replica holder declared session-scoped
+
+
+class TestBundleAndReport:
+    def test_leak_findings_in_debug_bundle(self, tmp_path):
+        from ray_tpu._private.diagnostics import write_debug_bundle
+
+        class _Rt:
+            session_dir = str(tmp_path)
+        path = write_debug_bundle(_Rt(), "sanitizer_test",
+                                  capture_stacks=False)
+        with open(os.path.join(path, "leak_findings.json")) as f:
+            doc = json.load(f)
+        assert doc["enabled"] is True
+        assert "threads" in doc and "pins" in doc
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert "leak_findings.json" in manifest["contents"]
+
+    def test_report_names_tracked_spawn(self):
+        release = threading.Event()
+        t = sanitizer.spawn(release.wait, name="report-probe")
+        try:
+            rep = sanitizer.report()
+            probe = [th for th in rep["threads"]
+                     if th["name"] == "report-probe"]
+            assert probe and probe[0]["tracked"] is True
+            assert probe[0]["site"]
+        finally:
+            release.set()
+            t.join(5)
+
+
+class TestLeakRegressions:
+    """Each fixed leak keeps a test so it cannot come back."""
+
+    def test_localpin_released_when_kv_write_fails(self, monkeypatch):
+        """LocalPin.pin: pin succeeded, the KV advertise raised — the
+        blob must be unpinned on the exception path (RT304 finding)."""
+        ray_tpu.init(num_cpus=2)
+        try:
+            from ray_tpu._private import api as api_mod
+            from ray_tpu.checkpoint.replica import LocalPin
+
+            real_control = api_mod._control
+            calls = []
+
+            def flaky_control(method, *args, **kwargs):
+                calls.append(method)
+                if method == "kv_put":
+                    raise RuntimeError("injected kv failure")
+                return real_control(method, *args, **kwargs)
+
+            import ray_tpu.checkpoint.replica as replica_mod
+            monkeypatch.setattr(replica_mod, "_control", flaky_control,
+                                raising=False)
+            # replica.py imports _control inside the method, from
+            # _private.api — patch it there.
+            monkeypatch.setattr(api_mod, "_control", flaky_control)
+
+            pin = LocalPin("pin-reg-exp", 0)
+            pin.pin(b"blob-bytes", step=1, index={"crc32": 0})
+            assert "pin_object" in calls
+            assert "unpin_object" in calls, \
+                "exception path must unpin the freshly pinned blob"
+            assert pin._pinned is None
+        finally:
+            ray_tpu.shutdown()
+
+    def test_async_writer_thread_exits_after_wedged_close(self,
+                                                          monkeypatch,
+                                                          tmp_path):
+        """close() timing out on a wedged write must not leak the writer
+        thread forever: it retires itself once the write finishes."""
+        import numpy as np
+
+        from ray_tpu.checkpoint import format as ckpt_format
+        from ray_tpu.checkpoint.async_writer import (AsyncCheckpointWriter,
+                                                     WriteJob)
+        monkeypatch.setenv("RAY_TPU_CKPT_TEST_WRITE_DELAY_S", "2.0")
+        w = AsyncCheckpointWriter(max_inflight=1)
+        snap = ckpt_format.snapshot_tree({"x": np.zeros(4)})
+        w.submit(WriteJob(dirpath=str(tmp_path / "step_00000001"),
+                          step=1, rank=0, world=1, snapshot=snap))
+        with pytest.raises(ckpt_format.CheckpointError):
+            w.close(timeout=0.2)
+        deadline = time.monotonic() + 10
+        while w._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not w._thread.is_alive(), \
+            "writer thread must exit once the wedged write completes"
+
+    def test_job_supervisor_reaped_and_logs_survive(self):
+        ray_tpu.init(num_cpus=2)
+        try:
+            from ray_tpu.job_submission.manager import JobManager
+            mgr = JobManager()
+            sid = mgr.submit_job(entrypoint="echo sanitize-done")
+            status = mgr.wait_until_finished(sid, timeout=60)
+            assert status == "SUCCEEDED"
+            # Supervisor actor reaped at terminal state... (kill() is
+            # asynchronous: poll until the death lands)
+            assert mgr._supervisors.get(sid) is None
+            deadline = time.monotonic() + 15
+            alive = True
+            while alive and time.monotonic() < deadline:
+                try:
+                    ray_tpu.get_actor(f"_job_supervisor:{sid}")
+                    time.sleep(0.1)
+                except Exception:
+                    alive = False
+            assert not alive, "reaped supervisor still resolvable"
+            # ...but the logs remain readable from the head-local file.
+            assert "sanitize-done" in mgr.get_job_logs(sid)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_train_kv_keys_gcd_after_run(self):
+        """Report + ack keys are consumed-and-deleted (RT303): a
+        finished run leaves nothing under train/ in the head KV."""
+        ray_tpu.init(num_cpus=4)
+        try:
+            from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+            def train_fn(config):
+                import ray_tpu.train as train
+                for step in range(config["steps"]):
+                    train.report({"step": step})
+
+            with tempfile.TemporaryDirectory() as tmp:
+                trainer = JaxTrainer(
+                    train_fn, train_loop_config={"steps": 3},
+                    scaling_config=ScalingConfig(num_workers=1),
+                    run_config=RunConfig(name="kvgc", storage_path=tmp))
+                result = trainer.fit()
+                assert result.error is None
+            from ray_tpu._private.api import _control
+            assert _control("kv_keys", "train/") == []
+            assert _control("kv_keys", "ckpt/pin/") == []
+        finally:
+            ray_tpu.shutdown()
+
+    def test_get_timeout_timer_cancelled(self):
+        """get(ref, timeout=...) must cancel its Timer on completion —
+        not leave one zombie timer thread per get for the full
+        timeout."""
+        ray_tpu.init(num_cpus=2)
+        try:
+            refs = [_echo.remote(i) for i in range(8)]
+            assert ray_tpu.get(refs, timeout=120) == list(range(8))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                zombies = [t for t in threading.enumerate()
+                           if isinstance(t, threading.Timer)
+                           and t.is_alive()]
+                if not zombies:
+                    break
+                time.sleep(0.05)
+            assert not zombies, f"lingering timers: {zombies}"
+        finally:
+            ray_tpu.shutdown()
