@@ -529,6 +529,59 @@ class ArenaReader:
         return nbytes
 
 
+# -- page-blob export/import (disaggregated LLM serving) --------------------
+
+
+def export_page_blob(store, object_id: ObjectID, value: Any) -> Optional[tuple]:
+    """Publish a prefill KV page blob as a sealed, PINNED store object
+    and return its descriptor for same-host zero-copy import (the
+    disagg prefill->decode handoff path).  The pin holds it exempt from
+    LRU spill/eviction for the export->import window — an unpinned
+    descriptor could be unlinked (Python store) or have its arena
+    offset reused (native store) before the decode worker maps it.
+    Balance with :func:`release_page_blob` after import.  Returns None
+    when the store can't hold it — the caller falls back to direct
+    in-process handoff; the blob is never silently dropped."""
+    try:
+        store.put(object_id, value)
+    except ValueError:
+        pass                      # already exported (idempotent republish)
+    except ObjectStoreFullError:
+        return None
+    if not store.try_pin(object_id):
+        # Evicted (or unpinnable) between put and pin: clean up rather
+        # than strand a multi-MB orphan until LRU pressure finds it.
+        try:
+            store.delete(object_id)
+        except KeyError:
+            pass
+        return None
+    return store.descriptor(object_id)
+
+
+def release_page_blob(store, object_id: ObjectID) -> None:
+    """Unpin + delete a consumed handoff blob (idempotent)."""
+    store.try_unpin(object_id)
+    try:
+        store.delete(object_id)
+    except KeyError:
+        pass
+
+
+def import_page_blob(desc: tuple):
+    """Map a sealed page blob by descriptor: ``("shm", name, nbytes)``
+    from the Python per-segment store or ``("shma", segment, off,
+    nbytes, key)`` from the native arena.  Returns (value, keepalive) —
+    numpy leaves are zero-copy views into the shared mapping for as long
+    as the keepalive is held (cross-host consumers instead pull raw
+    bytes through the normal transfer path and re-publish locally)."""
+    if desc[0] == "shm":
+        return RemoteObjectReader.read(desc[1], desc[2])
+    if desc[0] == "shma":
+        return ArenaReader.read(desc)
+    raise ValueError(f"unknown page-blob descriptor kind {desc[0]!r}")
+
+
 class RemoteObjectReader:
     """Maps sealed objects created by other processes on this host by name."""
 

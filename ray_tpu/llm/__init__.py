@@ -17,4 +17,15 @@ from .serving import LLMServer, build_llm_deployment
 __all__ = [
     "InferenceEngine", "SamplingParams", "Request", "PagePool",
     "LLMServer", "build_llm_deployment",
+    # Disaggregated serving (prefill/decode split + SLO router) lives in
+    # ray_tpu.llm.disagg; imported lazily to keep bare engine imports
+    # light.
+    "disagg",
 ]
+
+
+def __getattr__(name):
+    if name == "disagg":
+        import importlib
+        return importlib.import_module(".disagg", __name__)
+    raise AttributeError(name)

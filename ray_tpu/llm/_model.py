@@ -112,6 +112,87 @@ def write_prefill(kv_pages, ks, vs, page_ids, offs):
     return tuple(kv)
 
 
+def prefill_chunk(params: Dict[str, Any], kv_pages,
+                  tokens: jax.Array, start: jax.Array, length: jax.Array,
+                  block_table: jax.Array, cfg: LlamaConfig,
+                  page_size: int):
+    """Incremental (chunked) prefill: run ``length`` prompt tokens that
+    begin at absolute position ``start`` through the model, writing
+    their K/V into this sequence's pages and attending over ALL cache
+    positions ``[0, start+length)`` — earlier chunks' K/V are read back
+    from the paged cache, so a long prompt prefills as a series of small
+    bounded programs interleaved with decode steps instead of one
+    monolithic program that stalls every active decode (reference
+    analog: vLLM chunked prefill / Sarathi-style piggybacking).
+
+    tokens: [1, C] chunk-bucket-padded; start/length: scalars;
+    block_table: [P] page ids for this sequence.  Returns (logits at the
+    chunk's last valid position [vocab], new kv_pages).
+    """
+    import math as _math
+
+    from ..ops.paged_attention import combine_kv
+
+    dt = cfg.dtype
+    _B, C = tokens.shape
+    P = block_table.shape[0]
+    S = P * page_size
+    Hkv, D = cfg.kv_heads, cfg.head_dim
+    group = cfg.heads // Hkv
+    idx = jnp.arange(C)
+    positions = start + idx                       # [C] absolute
+    total = start + length
+    valid = idx < length
+    # Rope table lookups clamp; writes for padding rows land on reserved
+    # page 0 (never referenced by any block table).
+    rope_pos = jnp.minimum(positions, cfg.max_seq_len - 1)
+    page_ids = jnp.where(
+        valid, block_table[jnp.clip(positions // page_size, 0, P - 1)], 0)
+    offs = jnp.where(valid, positions % page_size, 0)
+    kv_pos = jnp.arange(S)
+    x = params["embed"].astype(dt)[tokens]        # [1, C, E]
+
+    n_layers = params["blocks"]["wq"].shape[0]
+    kv_pages = list(kv_pages)
+    for li in range(n_layers):
+        layer = jax.tree.map(lambda a, li=li: a[li], params["blocks"])
+        kv = kv_pages[li]
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(cfg, layer, h, rope_pos[None, :])
+        # Write this chunk's K/V first, then gather the WHOLE sequence
+        # back from pages: chunk-internal causality rides the same mask
+        # as cross-chunk context.
+        comb = combine_kv(k[0].transpose(1, 0, 2),
+                          v[0].transpose(1, 0, 2)).astype(kv.dtype)
+        kv = kv.at[page_ids, offs, :, :].set(comb)
+        kv_pages[li] = kv
+        pages = jnp.take(kv, block_table, axis=0)  # [P, page, 2Hkv, D]
+        ks = pages[:, :, 0::2, :].reshape(S, Hkv, D)
+        vs = pages[:, :, 1::2, :].reshape(S, Hkv, D)
+        kh = ks.transpose(1, 0, 2)                 # [Hkv, S, D]
+        vh = vs.transpose(1, 0, 2)
+        if group > 1:
+            kh = jnp.repeat(kh, group, axis=0)
+            vh = jnp.repeat(vh, group, axis=0)
+        scores = jnp.einsum("hcd,hsd->hcs", q[0], kh,
+                            preferred_element_type=jnp.float32) \
+            / _math.sqrt(D)
+        mask = (kv_pos[None, :] <= positions[:, None]) & \
+               (kv_pos[None, :] < total)           # [C, S]
+        scores = jnp.where(mask[None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("hcs,hsd->hcd", probs.astype(vh.dtype), vh)
+        attn_out = jnp.einsum("hcd,hde->ce", attn, layer["wo"].astype(dt))
+        x = x + attn_out[None]
+        h2 = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp(cfg, layer, h2)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.clip(length - 1, 0, C - 1)
+    logits = jnp.einsum("e,ev->v", x[0, last].astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    return logits, tuple(kv_pages)
+
+
 def decode_step(params: Dict[str, Any], kv_pages,
                 tokens: jax.Array, positions: jax.Array,
                 block_tables: jax.Array, active: jax.Array,

@@ -1,0 +1,24 @@
+"""ray_tpu.llm.disagg — disaggregated LLM serving.
+
+Prefill/decode split with KV handoff through the shm object store,
+SLO-aware admission control (per-class token budgets, bounded queues
+with deadline shedding, KV-occupancy backpressure), and the open-loop
+``serve_load`` bench harness.  Reference analog: the vLLM-backed
+serving stack the reference wraps (python/ray/llm/_internal/serve/)
+and the DistServe/Splitwise prefill-decode disaggregation pattern it
+deploys in production.
+"""
+
+from .handoff import KVHandoff, export_handoff, import_handoff
+from .loadgen import ServeLoadSpec, run_open_loop
+from .prefill import PrefillWorker
+from .router import (AdmissionConfig, AdmissionController, DisaggServer,
+                     OverloadError, RequestClass, build_disagg_deployment)
+
+__all__ = [
+    "KVHandoff", "export_handoff", "import_handoff",
+    "PrefillWorker",
+    "AdmissionConfig", "AdmissionController", "RequestClass",
+    "DisaggServer", "OverloadError", "build_disagg_deployment",
+    "ServeLoadSpec", "run_open_loop",
+]

@@ -1,0 +1,81 @@
+"""KV handoff: the sealed object a prefill worker hands a decode worker.
+
+Reference analog: DistServe/Splitwise-style prefill/decode
+disaggregation (and the vLLM KV-connector abstraction the reference's
+serving stack reaches through python/ray/llm/_internal/serve/engines/
+vllm/) — the prefill tier computes the prompt's KV once, the decode tier
+imports it into its own paged cache and joins the request to the
+continuous batch.
+
+Transport: the existing host shm object store.  Same-host handoff is
+zero-copy — the blob seals into a shared-memory segment and the decode
+worker maps it by descriptor (numpy leaves are views; nothing is
+re-serialized).  Cross-host consumers ride the normal raw-payload
+transfer path and re-publish locally.  With no store at all (one-process
+serving, tests) the handoff object passes through directly.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ...util import telemetry
+from ..engine import SamplingParams
+
+
+@dataclass
+class KVHandoff:
+    """A prefilled prompt ready to join a decode worker's batch.
+
+    ``ks``/``vs`` are the per-layer K/V for the (bucket-padded) prompt
+    in the prefill program's native ``[L, S_pad, Hkv, D]`` layout — the
+    exact input of the decode engine's compiled ``write_prefill``
+    scatter, so import is ONE device program with no relayouting.
+    """
+
+    prompt_tokens: List[int]
+    first_token: int
+    ks: np.ndarray
+    vs: np.ndarray
+    params: SamplingParams
+    t_submit: float = 0.0     # perf_counter at request submission
+    t_first: float = 0.0      # perf_counter when prefill sampled token 0
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.ks.nbytes) + int(self.vs.nbytes)
+
+
+def export_handoff(store, object_id, handoff: KVHandoff) -> Optional[tuple]:
+    """Seal ``handoff`` into the shm object store; returns the
+    descriptor a same-host decode worker imports by (None when the
+    store can't hold it — caller hands the object off directly)."""
+    from ..._private.object_store import export_page_blob
+
+    t0 = time.perf_counter()
+    desc = export_page_blob(store, object_id, handoff)
+    if desc is not None:
+        telemetry.observe("ray_tpu_llm_kv_transfer_seconds",
+                          time.perf_counter() - t0, tags={"op": "export"})
+        telemetry.inc("ray_tpu_llm_kv_transfer_bytes_total",
+                      handoff.nbytes)
+    return desc
+
+
+def import_handoff(desc: tuple) -> Tuple[KVHandoff, Any]:
+    """Map an exported handoff by descriptor (zero-copy on the same
+    host).  Returns (handoff, keepalive): the K/V arrays are views into
+    the shared mapping for as long as the keepalive is held — the
+    decode worker only needs them until its ``write_prefill`` scatter
+    lands."""
+    from ..._private.object_store import import_page_blob
+
+    t0 = time.perf_counter()
+    handoff, keepalive = import_page_blob(desc)
+    telemetry.observe("ray_tpu_llm_kv_transfer_seconds",
+                      time.perf_counter() - t0, tags={"op": "import"})
+    return handoff, keepalive

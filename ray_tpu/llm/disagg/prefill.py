@@ -1,0 +1,89 @@
+"""Prefill worker: bucketed prompt prefill only, producing KV handoffs.
+
+One half of the disaggregated topology (reference analog: DistServe /
+Splitwise prefill instances; the reference's serving stack reaches the
+same split through vLLM's prefill-decode disaggregation).  A prefill
+worker owns NO paged cache and NO decode slots — it runs the
+length-bucketed prefill program, samples the first token, and publishes
+the prompt's K/V as a :class:`KVHandoff` for a decode worker to import.
+Long prompts therefore never stall a decode batch: they burn compute on
+the prefill tier instead.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from functools import partial
+from typing import List, Optional
+
+import numpy as np
+
+from ...util import telemetry
+from ..engine import SamplingParams, sample_logits
+from .handoff import KVHandoff
+
+
+class PrefillWorker:
+    """Runs prefill-only on its own chips; stateless between requests."""
+
+    def __init__(self, params, cfg, *,
+                 prefill_buckets: tuple = (64, 256, 1024),
+                 page_size: int = 16, seed: int = 0):
+        import jax
+
+        from .. import _model
+
+        self._jax = jax
+        self.params = params
+        self.cfg = cfg
+        self.page_size = page_size
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        self._prefills = {
+            b: jax.jit(partial(_model.prefill, cfg=cfg))
+            for b in self.prefill_buckets}
+        self._rng = np.random.default_rng(seed)
+
+    def _bucket_for(self, n: int) -> Optional[int]:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return None
+
+    def prefill(self, prompt_tokens: List[int],
+                params: Optional[SamplingParams] = None,
+                t_submit: float = 0.0) -> KVHandoff:
+        """Prefill one prompt and package the handoff (raises
+        ValueError for prompts beyond every bucket — the router rejects
+        those at admission, before prefill compute is spent)."""
+        import jax.numpy as jnp
+
+        params = params or SamplingParams()
+        n = len(prompt_tokens)
+        bucket = self._bucket_for(n)
+        if bucket is None:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds the largest prefill "
+                f"bucket ({self.prefill_buckets[-1]})")
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = prompt_tokens
+        with telemetry.profile_span(
+                "engine_prefill", "llm",
+                extra={"prompt_len": n, "disagg": True}):
+            logits, ks, vs = self._prefills[bucket](
+                self.params, jnp.asarray(toks), jnp.asarray(n))
+        telemetry.inc("ray_tpu_llm_tokens_total", n,
+                      tags={"kind": "prompt"})
+        first = sample_logits(np.asarray(logits), params, self._rng)
+        # Trim the handoff to the prompt's pages rounded UP to a power
+        # of two: transfer bytes stay within 2x the prompt (not the
+        # bucket), while the decode side's jitted scatter sees at most
+        # log2(pages-per-bucket) distinct shapes instead of one per
+        # prompt length (same idiom as the engine's chunk-shape cache).
+        need = max(1, math.ceil(n / self.page_size))
+        keep = min(bucket, (1 << (need - 1).bit_length()) * self.page_size)
+        return KVHandoff(
+            prompt_tokens=list(prompt_tokens), first_token=int(first),
+            ks=np.asarray(ks[:, :keep]), vs=np.asarray(vs[:, :keep]),
+            params=params, t_submit=t_submit,
+            t_first=time.perf_counter())

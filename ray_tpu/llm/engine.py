@@ -34,6 +34,16 @@ class SamplingParams:
     stop_token_ids: tuple = ()
     seed: Optional[int] = None
 
+    @classmethod
+    def from_body(cls, body: Dict[str, Any]) -> "SamplingParams":
+        """The one request-body -> params parser every serving entry
+        point shares (LLMServer call/stream, DisaggServer)."""
+        return cls(
+            max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            stop_token_ids=tuple(body.get("stop_token_ids", ())))
+
 
 @dataclass
 class Request:
@@ -48,6 +58,34 @@ class Request:
     finish_reason: str = ""
     # Telemetry: submission time (perf_counter) for TTFT.
     t_submit: float = 0.0
+    # First-token time (perf_counter); 0.0 until the first token lands.
+    t_first: float = 0.0
+    # Admission sequence (preemption picks the youngest victim; -1 =
+    # never admitted) and incarnation counter (bumped on preemption so
+    # in-flight chunk snapshots from the previous residency never apply
+    # to a re-admitted request).
+    admit_seq: int = -1
+    gen: int = 0
+    # Per-output-token perf_counter stamps, recorded only when the
+    # engine was built with record_token_times=True (serve_load bench:
+    # inter-token latency percentiles need per-token arrival times).
+    token_times: List[float] = field(default_factory=list)
+
+
+def sample_logits(logits: np.ndarray, params: SamplingParams,
+                  rng: np.random.Generator) -> int:
+    """Host-side token sampling shared by the engine's admission path
+    and the disagg PrefillWorker (both sample the FIRST token from
+    prefill logits)."""
+    if params.temperature <= 0.0:
+        return int(np.argmax(logits))
+    logits = logits / params.temperature
+    if params.top_k:
+        kth = np.partition(logits, -params.top_k)[-params.top_k]
+        logits = np.where(logits < kth, -np.inf, logits)
+    p = np.exp(logits - logits.max())
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
 
 
 class InferenceEngine:
@@ -56,7 +94,9 @@ class InferenceEngine:
     def __init__(self, params, cfg, *, max_slots: int = 8,
                  page_size: int = 16, num_pages: int = 512,
                  max_seq_len: Optional[int] = None,
-                 prefill_buckets: tuple = (64, 256, 1024)):
+                 prefill_buckets: tuple = (64, 256, 1024),
+                 prefill_chunk: Optional[int] = None,
+                 record_token_times: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -97,6 +137,15 @@ class InferenceEngine:
         # max_tokens=1, rejections) never occupy a slot; step() drains them.
         self._admission_finished: List[Request] = []
         self._req_ids = itertools.count()
+        # Chunked prefill (disagg-off fallback): prompts longer than
+        # ``prefill_chunk`` tokens are prefilled one bounded chunk per
+        # step, interleaved with decode, instead of one monolithic
+        # program that stalls every active decode.
+        self.prefill_chunk = prefill_chunk
+        self.record_token_times = record_token_times
+        self._admit_seq = itertools.count()
+        self._prefilling: Dict[int, int] = {}   # slot -> prompt tokens done
+        self._prefill_chunk_jit = None
         # RLock: step() -> _admit() nests; server threads call
         # add_request/cancel concurrently with the drive thread's step().
         self._lock = threading.RLock()
@@ -168,6 +217,13 @@ class InferenceEngine:
                 return b
         return None
 
+    def _chunk_tokens(self) -> int:
+        """Chunk size for incremental prefill: the configured
+        ``prefill_chunk``, else the largest bucket (the fallback for
+        seeds no monolithic bucket covers)."""
+        return self.prefill_chunk if self.prefill_chunk is not None \
+            else self.prefill_buckets[-1]
+
     # -- scheduling ---------------------------------------------------------
 
     def _admit(self) -> None:
@@ -184,40 +240,59 @@ class InferenceEngine:
         staged: List = []  # (req, slot, device_logits)
         while self.waiting:
             free_slots = [i for i in range(self.max_slots)
-                          if not self.slot_active[i]]
+                          if self.slot_req[i] is None]
             if not free_slots:
                 break
             req = self.waiting[0]
-            n = len(req.prompt_tokens)
-            total = n + req.params.max_tokens
+            # Re-admission after preemption re-prefills prompt + tokens
+            # generated so far (recompute preemption): the "seed".
+            seed = req.prompt_tokens + req.output_tokens
+            n = len(seed)
+            total = len(req.prompt_tokens) + req.params.max_tokens
             if total > self.max_seq_len:
-                req.finished = True
-                req.finish_reason = "prompt_too_long"
-                self.waiting.pop(0)
-                self.running.pop(req.request_id, None)
-                self._admission_finished.append(req)
-                self._note_finish(req)
+                self._reject_head(req, "prompt_too_long")
                 continue
-            bucket = self._bucket_for(n)
-            if bucket is None:
-                req.finished = True
-                req.finish_reason = "prompt_too_long"
-                self.waiting.pop(0)
-                self.running.pop(req.request_id, None)
-                self._admission_finished.append(req)
-                self._note_finish(req)
-                continue
-            n_pages = math.ceil(total / self.page_size)
-            if n_pages > self.pool.num_pages - 1:
+            if math.ceil(total / self.page_size) > self.pool.num_pages - 1:
                 # Could never fit even an empty pool: reject, don't wedge
                 # the FIFO behind an unadmittable request.
-                req.finished = True
-                req.finish_reason = "kv_capacity_exceeded"
-                self.waiting.pop(0)
-                self.running.pop(req.request_id, None)
-                self._admission_finished.append(req)
-                self._note_finish(req)
+                self._reject_head(req, "kv_capacity_exceeded")
                 continue
+            chunked = (self.prefill_chunk is not None
+                       and n > self.prefill_chunk)
+            if not chunked:
+                bucket = self._bucket_for(n)
+                if bucket is None:
+                    # Beyond every bucket — an oversized prompt, or a
+                    # preempted request whose recompute seed (prompt +
+                    # generated-so-far) outgrew them.  The chunked
+                    # program covers any length up to max_seq_len.
+                    chunked = True
+            if chunked:
+                # Reserve the slot; _prefill_tick runs one bounded chunk
+                # per step.  First chunk's pages allocate up front so an
+                # empty pool still backpressures here.
+                need0 = math.ceil(min(self._chunk_tokens(), n)
+                                  / self.page_size)
+                pages = self.pool.alloc(need0)
+                if pages is None:
+                    break  # no KV memory; stay queued (backpressure)
+                self.waiting.pop(0)
+                slot = free_slots[0]
+                req.slot = slot
+                req.pages = pages
+                req.admit_seq = next(self._admit_seq)
+                self.slot_req[slot] = req
+                self.slot_active[slot] = False
+                bt = np.zeros((self.pages_per_seq,), np.int32)
+                bt[:len(pages)] = pages
+                self.block_tables[slot] = bt
+                self._prefilling[slot] = 0
+                continue
+            # Pages are allocated LAZILY: the seed plus the first decode
+            # token now, one page at a time as decode crosses page
+            # boundaries (see _ensure_decode_capacity) — upfront
+            # prompt+max_tokens allocation left most of the pool idle.
+            n_pages = math.ceil((n + 1) / self.page_size)
             pages = self.pool.alloc(n_pages)
             if pages is None:
                 break  # no KV memory; stay queued (backpressure)
@@ -226,7 +301,7 @@ class InferenceEngine:
 
             # Prefill on the padded bucket; returns last logits + K/V.
             toks = np.zeros((1, bucket), np.int32)
-            toks[0, :n] = req.prompt_tokens
+            toks[0, :n] = seed
             with telemetry.profile_span(
                     "engine_prefill", "llm",
                     extra={"request_id": req.request_id, "prompt_len": n}):
@@ -252,6 +327,7 @@ class InferenceEngine:
             # batched sync below.
             req.slot = slot
             req.pages = pages
+            req.admit_seq = next(self._admit_seq)
             self.slot_req[slot] = req
             self.slot_active[slot] = True
             self.slot_pos[slot] = n
@@ -269,26 +345,204 @@ class InferenceEngine:
         now = time.perf_counter()
         for (req, slot, _lg), logits in zip(staged, all_logits):
             first_tok = self._sample_host(logits, req.params)
+            if not req.output_tokens:   # first admission, not recompute
+                telemetry.observe("ray_tpu_llm_ttft_seconds",
+                                  max(0.0, now - req.t_submit))
+                req.t_first = now
             req.output_tokens.append(int(first_tok))
-            telemetry.observe("ray_tpu_llm_ttft_seconds",
-                              max(0.0, now - req.t_submit))
+            if self.record_token_times:
+                req.token_times.append(now)
             self.slot_tokens[slot] = first_tok
             self._maybe_finish(req, int(first_tok))
             if req.finished:
                 self._admission_finished.append(req)
         self._update_gauges()
 
+    def _reject_head(self, req: Request, reason: str) -> None:
+        """Reject the queue-head request at admission (never admitted:
+        no slot or pages to release)."""
+        req.finished = True
+        req.finish_reason = reason
+        self.waiting.pop(0)
+        self.running.pop(req.request_id, None)
+        self._admission_finished.append(req)
+        self._note_finish(req)
+
+    def _prefill_tick(self) -> None:
+        """Advance ONE chunked prefill by ONE chunk (callers hold the
+        lock).  One chunk per step bounds the stall any prefill can
+        impose on the active decode batch — the whole point of chunked
+        prefill."""
+        if not self._prefilling:
+            return
+        jnp = self._jnp
+        from . import _model
+        # FIFO fairness: the earliest-admitted prefill advances first.
+        slot = min(self._prefilling,
+                   key=lambda s: self.slot_req[s].admit_seq)
+        req = self.slot_req[slot]
+        done = self._prefilling[slot]
+        seed = req.prompt_tokens + req.output_tokens
+        n = len(seed)
+        C = self._chunk_tokens()
+        end = min(done + C, n)
+        # Pages must cover positions [0, end), plus the first decode
+        # token when this chunk completes the prompt.
+        cover = end + 1 if end >= n else end
+        need = math.ceil(cover / self.page_size) - len(req.pages)
+        if need > 0:
+            pages = self.pool.alloc(need)
+            while pages is None:
+                # Preempt strictly-YOUNGER page holders (decoding or
+                # prefilling) before stalling: with every slot mid-
+                # prefill and the pool dry, nothing would ever free a
+                # page otherwise (prefill-vs-prefill deadlock).  An
+                # older holder wins instead — we stall and it finishes.
+                cands = [s for s in range(self.max_slots)
+                         if s != slot and self.slot_req[s] is not None
+                         and self.slot_req[s].admit_seq > req.admit_seq]
+                if not cands:
+                    return  # KV pressure: stall until frees arrive
+                self._preempt(max(
+                    cands, key=lambda s: self.slot_req[s].admit_seq))
+                pages = self.pool.alloc(need)
+            base = len(req.pages)
+            req.pages.extend(pages)
+            self.block_tables[slot, base:base + len(pages)] = pages
+        if self._prefill_chunk_jit is None:
+            self._prefill_chunk_jit = self._jax.jit(
+                partial(_model.prefill_chunk, cfg=self.cfg,
+                        page_size=self.page_size),
+                donate_argnums=(1,))
+        toks = np.zeros((1, C), np.int32)
+        toks[0, :end - done] = seed[done:end]
+        with telemetry.profile_span(
+                "engine_prefill_chunk", "llm",
+                extra={"request_id": req.request_id, "start": done,
+                       "len": end - done}):
+            logits, self.kv_pages = self._prefill_chunk_jit(
+                self.params, self.kv_pages, jnp.asarray(toks),
+                jnp.asarray(np.int32(done)),
+                jnp.asarray(np.int32(end - done)),
+                jnp.asarray(self.block_tables[slot].copy()))
+        telemetry.inc("ray_tpu_llm_prefill_chunks_total")
+        telemetry.inc("ray_tpu_llm_tokens_total", end - done,
+                      tags={"kind": "prompt"})
+        self._prefilling[slot] = end
+        if end < n:
+            return
+        # Prompt complete: sample the first token, join the decode batch.
+        first = self._sample_host(np.asarray(logits), req.params)
+        now = time.perf_counter()
+        if not req.output_tokens:
+            telemetry.observe("ray_tpu_llm_ttft_seconds",
+                              max(0.0, now - req.t_submit))
+            req.t_first = now
+        req.output_tokens.append(int(first))
+        if self.record_token_times:
+            req.token_times.append(now)
+        del self._prefilling[slot]
+        self.slot_pos[slot] = n
+        self.slot_tokens[slot] = int(first)
+        self.slot_active[slot] = True
+        self._dev_state = None  # host mirrors changed
+        self._maybe_finish(req, int(first))
+        if req.finished:
+            self._admission_finished.append(req)
+        self._update_gauges()
+
+    def _need_pages(self, slot: int, steps: int) -> int:
+        """Extra pages ``slot`` needs to write KV for ``steps`` more
+        decode tokens (capped at its token budget: pipelined
+        overgeneration beyond it overflow-writes to reserved page 0)."""
+        req = self.slot_req[slot]
+        total = len(req.prompt_tokens) + req.params.max_tokens
+        cover = min(int(self.slot_pos[slot]) + steps, total)
+        return max(0, math.ceil(cover / self.page_size) - len(req.pages))
+
+    def _try_extend_capacity(self, steps: int) -> bool:
+        """Non-preempting capacity extension for the PIPELINED path: a
+        chunk is in flight, so host mirrors lag the device by one chunk
+        and preemption would rewind every other slot on the re-upload.
+        Returns False when the pool can't cover all active slots — the
+        caller must process the in-flight chunk first, then retry with
+        preemption allowed."""
+        active = [s for s in range(self.max_slots) if self.slot_active[s]]
+        if sum(self._need_pages(s, steps) for s in active) \
+                > self.pool.num_free:
+            return False
+        for slot in active:
+            need = self._need_pages(slot, steps)
+            if need == 0:
+                continue
+            pages = self.pool.alloc(need)
+            req = self.slot_req[slot]
+            base = len(req.pages)
+            req.pages.extend(pages)
+            self.block_tables[slot, base:base + len(pages)] = pages
+        return True
+
+    def _ensure_decode_capacity(self, steps: int) -> None:
+        """Lazily extend block tables so every active slot can write KV
+        for its next ``steps`` tokens, preempting the YOUNGEST request
+        (recompute preemption: victims re-queue at the FRONT, so
+        re-admission preserves arrival order) when the pool runs dry.
+        Callers hold the lock."""
+        while True:
+            active = [s for s in range(self.max_slots)
+                      if self.slot_active[s]]
+            if sum(self._need_pages(s, steps) for s in active) \
+                    <= self.pool.num_free:
+                break
+            cands = [s for s in range(self.max_slots)
+                     if self.slot_req[s] is not None]
+            if len(cands) <= 1:
+                break  # a lone request's need is always satisfiable
+            self._preempt(max(
+                cands, key=lambda s: self.slot_req[s].admit_seq))
+        for slot in range(self.max_slots):
+            if not self.slot_active[slot]:
+                continue
+            need = self._need_pages(slot, steps)
+            if need == 0:
+                continue
+            pages = self.pool.alloc(need)
+            if pages is None:
+                # Still short (single-victim granularity): a slot must
+                # never decode past its pages (its KV would land on
+                # reserved page 0 and attention would read garbage).
+                self._preempt(slot)
+                continue
+            req = self.slot_req[slot]
+            base = len(req.pages)
+            req.pages.extend(pages)
+            self.block_tables[slot, base:base + len(pages)] = pages
+
+    def _preempt(self, slot: int) -> None:
+        """Evict the request in ``slot`` back to the FRONT of the
+        waiting queue, freeing its pages; re-admission re-prefills
+        prompt + generated-so-far (recompute preemption, the vLLM
+        default).  Callers hold the lock."""
+        req = self.slot_req[slot]
+        self.slot_active[slot] = False
+        self.slot_req[slot] = None
+        self._prefilling.pop(slot, None)
+        self.pool.free(req.pages)
+        req.pages = []
+        req.slot = None
+        req.gen += 1   # stale in-flight chunk snapshots must not apply
+        self.block_tables[slot] = 0
+        self._dev_state = None
+        # Preemption order is youngest-first, each inserting at the
+        # front: after multiple preemptions the queue front is back in
+        # arrival order ahead of never-admitted requests (which always
+        # arrived later than anything that was already running).
+        self.waiting.insert(0, req)
+        telemetry.inc("ray_tpu_llm_preemptions_total")
+
     def _sample_host(self, logits: np.ndarray,
                      params: SamplingParams) -> int:
-        if params.temperature <= 0.0:
-            return int(np.argmax(logits))
-        logits = logits / params.temperature
-        if params.top_k:
-            kth = np.partition(logits, -params.top_k)[-params.top_k]
-            logits = np.where(logits < kth, -np.inf, logits)
-        p = np.exp(logits - logits.max())
-        p /= p.sum()
-        return int(self._rng.choice(len(p), p=p))
+        return sample_logits(logits, params, self._rng)
 
     def _maybe_finish(self, req: Request, token: int) -> None:
         stop = token in req.params.stop_token_ids
@@ -318,6 +572,8 @@ class InferenceEngine:
             if preempted:
                 self.slot_active[req.slot] = False
                 self.slot_req[req.slot] = None
+                self._prefilling.pop(req.slot, None)
+                req.gen += 1
             self.pool.free(req.pages)
             req.pages = []
             req.finished = True
@@ -325,12 +581,99 @@ class InferenceEngine:
             self._note_finish(req, preempted=preempted)
             self._update_gauges()
 
+    # -- disaggregated prefill import ---------------------------------------
+
+    def import_prefill(self, handoff) -> Optional[int]:
+        """Join a request prefilled ELSEWHERE (a disagg PrefillWorker)
+        to this engine's continuous batch: allocate local pages, scatter
+        the handed-off K/V in one device program (the same compiled
+        ``write_prefill`` the local admission path uses), and activate
+        the slot with the already-sampled first token.
+
+        ``handoff`` is a :class:`ray_tpu.llm.disagg.KVHandoff` (duck-
+        typed: prompt_tokens / first_token / ks / vs / params / t_submit
+        / t_first).  Returns the local request id, or None when no slot
+        or pages are free — the caller holds the handoff and retries
+        (backpressure), it is never silently dropped."""
+        jnp = self._jnp
+        with self._lock:
+            n = len(handoff.prompt_tokens)
+            total = n + handoff.params.max_tokens
+            if total > self.max_seq_len:
+                raise ValueError(
+                    f"handoff needs {total} positions; engine max_seq_len "
+                    f"is {self.max_seq_len}")
+            free_slots = [i for i in range(self.max_slots)
+                          if self.slot_req[i] is None]
+            if not free_slots:
+                return None
+            n_pages = math.ceil((n + 1) / self.page_size)
+            pages = self.pool.alloc(n_pages)
+            if pages is None:
+                return None
+            req = Request(next(self._req_ids),
+                          list(handoff.prompt_tokens), handoff.params,
+                          t_submit=handoff.t_submit or time.perf_counter())
+            req.admit_seq = next(self._admit_seq)
+            slot = free_slots[0]
+            bucket = handoff.ks.shape[1]
+            page_ids_np = np.zeros((bucket,), np.int32)
+            for t in range(n):
+                page_ids_np[t] = pages[t // self.page_size]
+            offs_np = np.arange(bucket, dtype=np.int32) % self.page_size
+            self.kv_pages = self._write_prefill(
+                self.kv_pages, jnp.asarray(np.ascontiguousarray(handoff.ks)),
+                jnp.asarray(np.ascontiguousarray(handoff.vs)),
+                jnp.asarray(page_ids_np), jnp.asarray(offs_np))
+            first = int(handoff.first_token)
+            req.slot = slot
+            req.pages = pages
+            req.t_first = handoff.t_first or time.perf_counter()
+            if handoff.t_submit:
+                # The disagg path's TTFT (submit -> prefill worker's
+                # first token) lands in the same histogram the local
+                # admission paths feed.
+                telemetry.observe("ray_tpu_llm_ttft_seconds",
+                                  max(0.0, req.t_first - req.t_submit))
+            req.output_tokens.append(first)
+            if self.record_token_times:
+                req.token_times.append(req.t_first)
+            self.slot_req[slot] = req
+            self.slot_pos[slot] = n
+            self.slot_tokens[slot] = first
+            self.slot_active[slot] = True
+            bt = np.zeros((self.pages_per_seq,), np.int32)
+            bt[:n_pages] = pages
+            self.block_tables[slot] = bt
+            self.running[req.request_id] = req
+            self._dev_state = None
+            self._maybe_finish(req, first)
+            if req.finished:
+                self._admission_finished.append(req)
+            self._update_gauges()
+            return req.request_id
+
     # -- stepping -----------------------------------------------------------
 
     def has_work(self) -> bool:
         with self._lock:
             return bool(self.waiting or any(self.slot_active)
-                        or self._admission_finished)
+                        or self._prefilling or self._admission_finished)
+
+    def load_stats(self) -> Dict[str, Any]:
+        """Live load snapshot for admission control / backpressure
+        (router-facing: KV occupancy + queue depths)."""
+        with self._lock:
+            return {
+                "kv_occupancy": 1.0 - self.pool.num_free
+                / max(self.pool.num_pages, 1),
+                "free_pages": self.pool.num_free,
+                "active_slots": int(self.slot_active.sum()),
+                "free_slots": sum(1 for i in range(self.max_slots)
+                                  if self.slot_req[i] is None),
+                "waiting": len(self.waiting),
+                "prefilling": len(self._prefilling),
+            }
 
     def step(self) -> List[Request]:
         """Admit + one batched decode step; returns requests finished now.
@@ -341,8 +684,12 @@ class InferenceEngine:
         jnp = self._jnp
         with self._lock:
             self._admit()
+            self._prefill_tick()
             finished = list(self._admission_finished)
             self._admission_finished.clear()
+            if not any(self.slot_active):
+                return finished
+            self._ensure_decode_capacity(1)
             if not any(self.slot_active):
                 return finished
             t0 = time.perf_counter()
@@ -350,10 +697,10 @@ class InferenceEngine:
                 self._dev_state = None  # per-token path mutates mirrors
                 logits, self.kv_pages = self._decode(
                     self.params, self.kv_pages,
-                    jnp.asarray(self.slot_tokens),
-                    jnp.asarray(self.slot_pos),
-                    jnp.asarray(self.block_tables),
-                    jnp.asarray(self.slot_active))
+                    jnp.asarray(self.slot_tokens.copy()),
+                    jnp.asarray(self.slot_pos.copy()),
+                    jnp.asarray(self.block_tables.copy()),
+                    jnp.asarray(self.slot_active.copy()))
                 logits = np.asarray(logits)
             decoded = 0
             for slot in range(self.max_slots):
@@ -362,6 +709,8 @@ class InferenceEngine:
                 req = self.slot_req[slot]
                 tok = self._sample_host(logits[slot], req.params)
                 req.output_tokens.append(tok)
+                if self.record_token_times:
+                    req.token_times.append(time.perf_counter())
                 decoded += 1
                 self.slot_pos[slot] += 1
                 self.slot_tokens[slot] = tok
@@ -389,6 +738,7 @@ class InferenceEngine:
         """
         with self._lock:
             self._admit()
+            self._prefill_tick()
             finished = list(self._admission_finished)
             self._admission_finished.clear()
             # Clock starts AFTER admission: prefill time is not decode
@@ -417,11 +767,19 @@ class InferenceEngine:
         self._note_decode(time.perf_counter() - t_mark, steps=pending[1])
         return out
 
-    def _dispatch_chunk(self, max_steps: int):
+    def _dispatch_chunk(self, max_steps: int, allow_preempt: bool = True,
+                        pos_lag: int = 0):
         """Dispatch one chunk (async — no host sync).  Caller holds the
         lock.  Returns None (nothing active), "incompatible" (mixed
-        sampling params / exhausted budgets: use per-token step()), or
-        (device_out, steps, per-slot request snapshot)."""
+        sampling params / exhausted budgets: use per-token step()),
+        "need_sync" (page pressure while a chunk is in flight — the
+        caller must apply it before capacity work can preempt), or
+        (device_out, steps, per-slot request snapshot).
+
+        ``pos_lag``: steps of an IN-FLIGHT chunk not yet applied to the
+        host mirrors — page capacity must cover the device's true
+        positions (mirror pos + lag + this chunk), not the stale
+        mirrors."""
         jnp = self._jnp
         from . import _model
 
@@ -444,6 +802,15 @@ class InferenceEngine:
         if steps <= 0:
             return "incompatible"
         steps = 1 << (steps.bit_length() - 1)
+        # Page capacity for the whole chunk BEFORE dispatch: block
+        # tables are frozen for the chunk's duration, so lazy extension
+        # (and any preemption it forces) must happen now.
+        if allow_preempt:
+            self._ensure_decode_capacity(steps + pos_lag)
+            if not any(self.slot_active):
+                return None
+        elif not self._try_extend_capacity(steps + pos_lag):
+            return "need_sync"
         shape_key = (steps, sp0.temperature, sp0.top_k)
         fn = self._chunk_cache.get(shape_key)
         if fn is None:
@@ -463,16 +830,20 @@ class InferenceEngine:
         if self._dev_state is not None:
             toks_dev, pos_dev = self._dev_state
         else:
-            toks_dev = jnp.asarray(self.slot_tokens)
-            pos_dev = jnp.asarray(self.slot_pos)
+            toks_dev = jnp.asarray(self.slot_tokens.copy())
+            pos_dev = jnp.asarray(self.slot_pos.copy())
         out, new_pos, self.kv_pages = self._decode_chunk(
             self.params, self.kv_pages,
-            toks_dev, pos_dev, jnp.asarray(self.block_tables),
-            jnp.asarray(self.slot_active), key)
+            toks_dev, pos_dev, jnp.asarray(self.block_tables.copy()),
+            jnp.asarray(self.slot_active.copy()), key)
         # Next chunk can resume from device state (last sampled token
         # per slot + advanced positions) with no host upload.
         self._dev_state = (out[-1], new_pos)
-        snap = [self.slot_req[s] if self.slot_active[s] else None
+        # Snapshot carries the request's incarnation: a preempted-and-
+        # re-admitted request must not receive this chunk's stale tokens
+        # even if it lands back in the same slot.
+        snap = [(self.slot_req[s], self.slot_req[s].gen)
+                if self.slot_active[s] else None
                 for s in range(self.max_slots)]
         return (out, steps, snap)
 
@@ -489,17 +860,23 @@ class InferenceEngine:
         at the next dispatch instead)."""
         out = np.asarray(out_dev)                       # ONE host sync
         finished: List[Request] = []
+        now = time.perf_counter()
         with self._lock:
             any_finished = False
             applied = 0
-            for slot, req in enumerate(snap):
-                if req is None or req.finished:
+            for slot, entry in enumerate(snap):
+                if entry is None:
                     continue
-                if self.slot_req[slot] is not req:
-                    continue  # slot re-admitted to a newer request
+                req, gen = entry
+                if req.finished:
+                    continue
+                if self.slot_req[slot] is not req or req.gen != gen:
+                    continue  # slot re-admitted / request preempted
                 for i in range(steps):
                     tok = int(out[i, slot])
                     req.output_tokens.append(tok)
+                    if self.record_token_times:
+                        req.token_times.append(now)
                     applied += 1
                     self.slot_pos[slot] += 1
                     self.slot_tokens[slot] = tok
@@ -540,22 +917,29 @@ class InferenceEngine:
             with self._lock:
                 if pending is None:
                     self._admit()
+                    self._prefill_tick()
                     done.extend(self._admission_finished)
                     self._admission_finished.clear()
                 skip = False
                 if pending is not None:
-                    if self.waiting and not self.slot_active.all():
+                    free_slot = any(self.slot_req[i] is None
+                                    for i in range(self.max_slots))
+                    if self._prefilling:
+                        # An in-flight chunked prefill only advances at
+                        # bubbles; starving it would deadlock its slot.
+                        skip = True
+                    elif self.waiting and free_slot:
                         # Bubble ONLY when admission can actually make
                         # progress (a slot is free AND the head request's
-                        # pages fit): at saturation — or against an
+                        # first pages fit): at saturation — or against an
                         # oversized head request — the queue stays
                         # non-empty for the whole run and a bubble per
                         # chunk would serialize the pipeline exactly when
                         # load is highest.
                         head = self.waiting[0]
-                        need = math.ceil(
-                            (len(head.prompt_tokens)
-                             + head.params.max_tokens) / self.page_size)
+                        seed_n = len(head.prompt_tokens) \
+                            + len(head.output_tokens)
+                        need = math.ceil((seed_n + 1) / self.page_size)
                         skip = self.pool.num_free >= need
                     else:
                         # The in-flight chunk already covers every active
@@ -568,7 +952,17 @@ class InferenceEngine:
                                          if self.slot_active[s])]
                         skip = bool(rem) and max(rem) <= 0
                 if not skip:
-                    d = self._dispatch_chunk(max_steps)
+                    d = self._dispatch_chunk(
+                        max_steps, allow_preempt=pending is None,
+                        pos_lag=pending[1] if pending is not None else 0)
+            if d == "need_sync":
+                # Page pressure with a chunk in flight: apply it so the
+                # host mirrors catch up, then the next iteration may
+                # preempt safely.
+                done.extend(self._process_pending(pending, t_mark))
+                pending = None
+                t_mark = time.perf_counter()
+                continue
             if d == "incompatible":
                 if pending is not None:
                     done.extend(self._process_pending(pending, t_mark))
@@ -582,7 +976,8 @@ class InferenceEngine:
             t_mark = time.perf_counter()
             if pending is None:
                 with self._lock:
-                    if not self.waiting and not self.slot_active.any():
+                    if not self.waiting and not self.slot_active.any() \
+                            and not self._prefilling:
                         return done
         raise RuntimeError("run_pipelined did not drain")
 

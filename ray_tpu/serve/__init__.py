@@ -12,8 +12,8 @@ TPU angle: replicas are actors that can hold chip reservations
 gets exclusive chips per replica.
 """
 
-from .api import (Application, Deployment, DeploymentHandle, deployment,
-                  get_deployment_handle, run, shutdown, status)
+from .api import (Application, Deployment, DeploymentHandle, OverloadError,
+                  deployment, get_deployment_handle, run, shutdown, status)
 from .batching import batch
 from .controller import AutoscalingConfig
 from .grpc_ingress import (GrpcMethod, add_grpc_service,
@@ -23,7 +23,7 @@ from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment", "run", "shutdown", "status", "Deployment", "Application",
-    "DeploymentHandle", "get_deployment_handle", "batch",
+    "DeploymentHandle", "OverloadError", "get_deployment_handle", "batch",
     "AutoscalingConfig", "LongPollBroker",
     "multiplexed", "get_multiplexed_model_id",
     "GrpcMethod", "add_grpc_service", "remove_grpc_service",

@@ -33,6 +33,14 @@ _http_server = None
 _controller_handle = None
 
 
+class OverloadError(RuntimeError):
+    """A request was shed by admission control (deployment queue bound
+    or SLO router).  Retriable: the service is healthy but saturated —
+    back off and resend instead of treating it as a failure."""
+
+    retriable = True
+
+
 @dataclass
 class Deployment:
     cls_or_fn: Any
@@ -47,6 +55,11 @@ class Deployment:
     # Queue-depth autoscaling (reference: serve/autoscaling_policy.py);
     # None = fixed num_replicas.
     autoscaling_config: Optional["AutoscalingConfig"] = None
+    # Admission bound on the handle path: reject (OverloadError) once
+    # in-flight requests exceed replica capacity (num_replicas *
+    # max_ongoing_requests) plus this queue allowance.  None = queue
+    # unboundedly (legacy behavior).
+    max_queued_requests: Optional[int] = None
 
     def options(self, **kw) -> "Deployment":
         import dataclasses
@@ -69,7 +82,8 @@ def deployment(_cls=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 8,
                num_cpus: float = 0.0, num_tpus: int = 0,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               autoscaling_config: Optional["AutoscalingConfig"] = None):
+               autoscaling_config: Optional["AutoscalingConfig"] = None,
+               max_queued_requests: Optional[int] = None):
     """@serve.deployment (reference: serve/api.py:471)."""
     def wrap(cls):
         return Deployment(cls, name or cls.__name__,
@@ -77,7 +91,8 @@ def deployment(_cls=None, *, name: Optional[str] = None,
                           max_ongoing_requests=max_ongoing_requests,
                           num_cpus=num_cpus, num_tpus=num_tpus,
                           ray_actor_options=ray_actor_options or {},
-                          autoscaling_config=autoscaling_config)
+                          autoscaling_config=autoscaling_config,
+                          max_queued_requests=max_queued_requests)
     if _cls is not None:
         return wrap(_cls)
     return wrap
@@ -327,6 +342,11 @@ class _Router:
         self._replicas: List[tuple] = []  # (actor_id_hex, handle)
         self._inflight: Dict[str, int] = {}
         self._fetched = 0.0
+        # Admission state from the KV snapshot: total replica capacity
+        # (sum of max_ongoing) and the deployment's queue allowance
+        # (None = unbounded, the legacy behavior).
+        self._capacity = 0
+        self._max_queued: Optional[int] = None
         from .multiplex import RouterAffinity
         self.affinity = RouterAffinity(8)
         self._metrics_started = False
@@ -372,11 +392,14 @@ class _Router:
         entries: List[tuple] = []
         version = None
         cap = None
+        max_queued = None
         if blob is not None:
             snap = pickle.loads(blob)
             version, entries = snap[0], snap[1]
             if len(snap) > 2:
                 cap = snap[2]
+            if len(snap) > 3:
+                max_queued = snap[3]
         with self._lock:
             self._fetched = now
             if version is None or version == self._version:
@@ -384,6 +407,8 @@ class _Router:
                     self._replicas = []
                 return
             self._version = version
+            self._capacity = sum(e[2] for e in entries)
+            self._max_queued = max_queued
             if cap is not None and cap != self.affinity._max:
                 from .multiplex import RouterAffinity
                 self.affinity = RouterAffinity(cap)
@@ -440,6 +465,16 @@ class _Router:
     def total_inflight(self) -> int:
         with self._lock:
             return sum(self._inflight.values())
+
+    def over_admission_bound(self) -> bool:
+        """True when this router's in-flight count exceeds replica
+        capacity plus the deployment's max_queued_requests allowance —
+        the handle sheds instead of queueing unboundedly."""
+        with self._lock:
+            if self._max_queued is None or not self._replicas:
+                return False
+            return sum(self._inflight.values()) >= \
+                self._capacity + self._max_queued
 
     def _ensure_metrics_thread(self) -> None:
         with self._lock:
@@ -529,6 +564,13 @@ class DeploymentHandle:
 
         router = _router_for(self._name)
         router._refresh()
+        if router.over_admission_bound():
+            # SLO-aware shedding: overload degrades into a fast
+            # retriable rejection, not a queue that times out later.
+            telemetry.inc("ray_tpu_serve_shed_total", tags=tags)
+            raise OverloadError(
+                f"deployment {self._name!r} is over its admission bound "
+                "(max_queued_requests); retry with backoff")
         # A reconcile may briefly leave zero replicas (all died at once);
         # wait for the controller to backfill rather than failing the
         # request (reference: router retries against the long-poll set).

@@ -258,7 +258,8 @@ class ServeController:
                        for r in snapshot]
             _control("kv_put", key,
                      pickle.dumps((state._version, entries,
-                                   state.multiplex_cap)))
+                                   state.multiplex_cap,
+                                   state.deployment.max_queued_requests)))
         except Exception:
             pass
 

@@ -75,6 +75,11 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "type": "gauge", "tag_keys": ("deployment",),
         "description": "This process's in-flight requests per deployment "
                        "(router view)."},
+    "ray_tpu_serve_shed_total": {
+        "type": "counter", "tag_keys": ("deployment",),
+        "description": "Handle-path requests rejected by the "
+                       "max_queued_requests admission bound (retriable "
+                       "OverloadError instead of unbounded queueing)."},
     # -- llm ---------------------------------------------------------------
     "ray_tpu_llm_ttft_seconds": {
         "type": "histogram", "tag_keys": (),
@@ -109,6 +114,32 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "type": "gauge", "tag_keys": (),
         "description": "Requests queued for admission (KV/slot "
                        "backpressure depth)."},
+    "ray_tpu_llm_admission_queue_depth": {
+        "type": "gauge", "tag_keys": ("class",),
+        "description": "Requests held in the SLO router's bounded "
+                       "admission queue, per request class (disagg "
+                       "router; ahead of engine admission)."},
+    "ray_tpu_llm_shed_total": {
+        "type": "counter", "tag_keys": ("reason",),
+        "description": "Requests shed by SLO-aware admission control "
+                       "(reason=queue_full|class_budget|backpressure|"
+                       "deadline).  Shedding is a retriable overload "
+                       "error, never a silent timeout."},
+    "ray_tpu_llm_kv_transfer_bytes_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "KV-cache bytes handed off from prefill to "
+                       "decode workers (disagg page-blob transfers)."},
+    "ray_tpu_llm_kv_transfer_seconds": {
+        "type": "histogram", "tag_keys": ("op",),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Prefill->decode KV handoff latency "
+                       "(op=export|import: object-store publish / "
+                       "decode-side page scatter)."},
+    "ray_tpu_llm_prefill_chunks_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Chunked-prefill chunks executed (single-engine "
+                       "disagg-off fallback: long prompts sliced across "
+                       "decode steps)."},
     # -- train -------------------------------------------------------------
     "ray_tpu_train_step_seconds": {
         "type": "histogram", "tag_keys": (),

@@ -78,6 +78,45 @@ class TestServeCore:
         serve.shutdown()
 
 
+class TestAdmissionBound:
+    def test_max_queued_requests_sheds(self, ray_start):
+        """Past replica capacity + the queue allowance, handle.remote
+        raises a retriable OverloadError instead of queueing unboundedly
+        (SLO-aware admission on the handle path)."""
+        @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                          max_queued_requests=1)
+        class Slow:
+            def __call__(self, payload):
+                time.sleep(0.5)
+                return payload
+
+        handle = serve.run(Slow.bind())
+        # Warm the path (replica up, router snapshot fetched).
+        ray_tpu.get(handle.remote(0), timeout=60)
+        refs = []
+        shed = 0
+        for i in range(8):
+            try:
+                refs.append(handle.remote(i))
+            except serve.OverloadError as e:
+                assert e.retriable
+                shed += 1
+        assert shed > 0, "burst past capacity+queue must shed"
+        assert refs, "requests within the bound are still admitted"
+        for r in refs:
+            ray_tpu.get(r, timeout=60)
+        # Drained: admission accepts again.
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                assert ray_tpu.get(handle.remote(7), timeout=60) == 7
+                break
+            except serve.OverloadError:
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+        serve.shutdown()
+
+
 class TestBatching:
     def test_batch_accumulates(self, ray_start):
         @serve.deployment

@@ -92,6 +92,32 @@ class TestCatalog:
         assert telemetry.CATALOG["ray_tpu_ckpt_restore_seconds"][
             "tag_keys"] == ("source",)
 
+    def test_disagg_admission_series_registered(self):
+        """The disaggregated-serving / admission-control series (PR 6)
+        are declared in the catalog: router queue depth, shed counts by
+        reason, KV-transfer bytes/latency, chunked-prefill chunks, and
+        the serve handle-path shed counter."""
+        specs = {
+            "ray_tpu_llm_admission_queue_depth": ("gauge", ("class",)),
+            "ray_tpu_llm_shed_total": ("counter", ("reason",)),
+            "ray_tpu_llm_kv_transfer_bytes_total": ("counter", ()),
+            "ray_tpu_llm_kv_transfer_seconds": ("histogram", ("op",)),
+            "ray_tpu_llm_prefill_chunks_total": ("counter", ()),
+            "ray_tpu_serve_shed_total": ("counter", ("deployment",)),
+        }
+        for name, (typ, tags) in specs.items():
+            assert name in telemetry.CATALOG, name
+            assert telemetry.CATALOG[name]["type"] == typ, name
+            assert tuple(telemetry.CATALOG[name]["tag_keys"]) == tags
+            assert telemetry.CATALOG[name]["description"].strip(), name
+        # The exception-safe helpers record them without raising.
+        telemetry.inc("ray_tpu_llm_shed_total", 0.0,
+                      tags={"reason": "queue_full"})
+        telemetry.set_gauge("ray_tpu_llm_admission_queue_depth", 0.0,
+                            tags={"class": "default"})
+        telemetry.observe("ray_tpu_llm_kv_transfer_seconds", 0.0,
+                          tags={"op": "export"})
+
 
 def _base_series(prom_text):
     """Distinct catalog-level metric names present in an exposition."""
