@@ -513,6 +513,251 @@ def bench_lint() -> None:
           file=sys.stderr)
 
 
+def _preempt_train_fn(config):
+    """Per-worker loop for the preemption bench: one saved+reported step
+    at a time, resumable from the sharded-checkpoint subsystem (every
+    rank saves; the async writer is artificially slowed via
+    RAY_TPU_CKPT_TEST_WRITE_DELAY_S so commits lag the step loop — the
+    window an ungraceful kill loses and a graceful drain's urgent flush
+    saves)."""
+    import time as _t
+
+    import numpy as np
+
+    import ray_tpu.train as train
+    from ray_tpu._private.api import _control
+
+    ctx = train.get_context()
+    world = ctx.get_world_size()
+
+    def barrier(step):
+        # Lockstep like a real SPMD step (collectives sync ranks): the
+        # lost-work metric must measure recovery quality, not rank drift
+        # (the all-rank commit can only reach the slowest rank's step).
+        prefix = f"tsync/{ctx.experiment_name}/{step}/"
+        _control("kv_put", prefix + str(ctx.get_world_rank()), b"1")
+        deadline = _t.monotonic() + 60
+        while _t.monotonic() < deadline:
+            if len(_control("kv_keys", prefix)) >= world:
+                return
+            _t.sleep(0.02)
+
+    state = train.load_checkpoint()
+    start = 0 if state is None else int(state["step"])
+    w = np.zeros((64,), np.float32) if state is None else state["w"]
+    for step in range(start, config["steps"]):
+        _t.sleep(config["step_time"])
+        w = w + 1.0
+        train.save_checkpoint({"w": w, "step": step + 1},
+                              metrics={"step": step + 1})
+        train.report({"step": step + 1, "start": start})
+        barrier(step)
+
+
+def _preempt_lost_steps(reports) -> int:
+    """Re-executed rank-0 steps across incarnations = the true lost
+    work (every duplicate step number was computed, thrown away, and
+    computed again)."""
+    from collections import Counter
+    counts = Counter(r["metrics"]["step"] for r in reports
+                     if r["rank"] == 0 and "step" in r["metrics"])
+    return sum(c - 1 for c in counts.values() if c > 1)
+
+
+def _fit_under_chaos(trainer, runner, min_step: int = 2,
+                     arm_timeout_s: float = 90.0):
+    """fit() with the chaos schedule armed only once training has made
+    real progress (reported step >= min_step): every mode's fault lands
+    mid-step-loop, not in the formation race, so the three recovery
+    strategies are compared on identical footing."""
+    import threading
+
+    from ray_tpu.train.controller import TrainController
+
+    controller = TrainController(trainer._train_fn, trainer._config,
+                                 trainer._scaling, trainer._run_config)
+    box: dict = {}
+
+    def run():
+        try:
+            box["result"] = controller.run()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            box["raised"] = e
+
+    t = threading.Thread(target=run, name="bench-preempt-fit")
+    t.start()
+    deadline = time.monotonic() + arm_timeout_s
+    while time.monotonic() < deadline and t.is_alive():
+        if any(r["metrics"].get("step", 0) >= min_step
+               for r in controller._reports):
+            break
+        time.sleep(0.1)
+    runner.start()  # t=0 of the schedule = "progress observed"
+    t.join()
+    if "raised" in box:
+        raise box["raised"]
+    return box["result"]
+
+
+def _run_preempt_mode(mode: str, *, steps: int, step_time: float,
+                      write_delay: float, preempt_at_s: float,
+                      deadline_s: float) -> dict:
+    """One recovery strategy under the identical preemption schedule:
+    boot a 2-node cluster, preempt/kill the second node mid-run, finish
+    at the reduced size, and account what was lost."""
+    import shutil
+    import tempfile
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.devtools.chaos import ChaosRunner, ChaosSchedule
+    from ray_tpu.train import (CheckpointConfig, FailureConfig, JaxTrainer,
+                               RunConfig, ScalingConfig)
+
+    store = tempfile.mkdtemp(prefix=f"bench_preempt_{mode}_")
+    cluster = Cluster(head_num_cpus=0)
+    try:
+        cluster.add_node(num_cpus=2)
+        n2 = cluster.add_node(num_cpus=2)
+        env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+               "XLA_FLAGS": "",
+               "RAY_TPU_CKPT_TEST_WRITE_DELAY_S": str(write_delay)}
+
+        def make_trainer(max_failures: int) -> JaxTrainer:
+            return JaxTrainer(
+                _preempt_train_fn,
+                train_loop_config={"steps": steps, "step_time": step_time},
+                scaling_config=ScalingConfig(
+                    resources_per_worker={"CPU": 1},
+                    min_workers=1, max_workers=4,
+                    elastic_check_interval_s=3600,
+                    env_per_worker=env),
+                run_config=RunConfig(
+                    name="bench_preempt", storage_path=store,
+                    failure_config=FailureConfig(
+                        max_failures=max_failures,
+                        restart_backoff_initial_s=0.5),
+                    checkpoint_config=CheckpointConfig(
+                        async_save=True, max_inflight=2)))
+
+        schedule = ChaosSchedule()
+        if mode == "graceful":
+            schedule.preempt(preempt_at_s, n2, deadline_s=deadline_s)
+        else:  # ungraceful kill, with or without in-run recovery
+            schedule.kill(preempt_at_s, n2)
+        max_failures = 0 if mode == "fail_restart" else 1
+        t0 = time.monotonic()
+        runner = ChaosRunner(cluster, schedule, name=mode)
+        try:
+            res = _fit_under_chaos(make_trainer(max_failures), runner)
+            results = [res]
+            if mode == "fail_restart" and res.error is not None:
+                # The baseline strategy: the run simply dies; an operator
+                # (or a retry wrapper) restarts it from the latest
+                # committed checkpoint as a brand-new fit.
+                results.append(make_trainer(1).fit())
+        finally:
+            runner.stop()
+        wall_s = time.monotonic() - t0
+        reports = [r for res_ in results for r in res_.all_reports]
+        final = results[-1]
+        lost_steps = _preempt_lost_steps(reports)
+        booked_lost = sum(
+            (res_.goodput or {}).get("phases_s", {}).get("lost", 0.0)
+            for res_ in results)
+        productive = sum(
+            (res_.goodput or {}).get("productive_s", 0.0)
+            for res_ in results)
+        total = sum((res_.goodput or {}).get("total_s", 0.0)
+                    for res_ in results)
+        world_hist = [w for res_ in results
+                      for w in res_.world_size_history]
+        return {
+            "mode": mode,
+            "error": repr(final.error) if final.error else None,
+            "completed": final.error is None
+            and final.metrics.get("step") == steps,
+            "final_step": final.metrics.get("step"),
+            "world_size_history": world_hist,
+            "num_failures": sum(r_.num_failures for r_ in results),
+            "num_drains": sum(r_.num_drains for r_ in results),
+            "lost_steps": lost_steps,
+            "lost_work_s": round(lost_steps * step_time, 3),
+            "booked_lost_s": round(booked_lost, 3),
+            "goodput_ratio": round(productive / total, 4) if total else 0.0,
+            "restart_s": round(sum(
+                (res_.goodput or {}).get("phases_s", {}).get(
+                    "restart", 0.0) for res_ in results), 3),
+            "chaos_log": list(runner.log),
+            "wall_s": round(wall_s, 2),
+        }
+    finally:
+        cluster.shutdown()
+        shutil.rmtree(store, ignore_errors=True)
+
+
+def bench_preempt(fast: bool = False) -> None:
+    """Goodput under a scripted preemption schedule, three recovery
+    strategies -> BENCH_preempt.json.
+
+    The same chaos schedule (one of two nodes reclaimed mid-run) is
+    replayed against: **graceful** — the drain protocol (notice ->
+    urgent checkpoint flush -> planned downsize); **ungraceful** — no
+    notice, the crash path (restore from the last committed save, burn
+    a failure); **fail_restart** — the pre-elastic baseline
+    (max_failures=0: the run dies and is re-fit from the latest
+    checkpoint).
+
+    SLA: graceful loses <= 25% of the work the ungraceful kill loses
+    (lost work = re-executed steps x step time — measured from the
+    report stream, not inferred), completes with error=None at the
+    reduced world size, and burns zero failure budget.
+    """
+    budget_wall_s = 180.0 if fast else 600.0
+    if fast:
+        knobs = dict(steps=14, step_time=0.15, write_delay=0.35,
+                     preempt_at_s=0.5, deadline_s=8.0)
+    else:
+        knobs = dict(steps=36, step_time=0.25, write_delay=0.5,
+                     preempt_at_s=1.0, deadline_s=12.0)
+    t0 = time.monotonic()
+    doc: dict = {"spec": "preempt", "fast": fast, "knobs": knobs,
+                 "wall_clock_budget_s": budget_wall_s, "modes": {}}
+    for mode in ("graceful", "ungraceful", "fail_restart"):
+        doc["modes"][mode] = _run_preempt_mode(mode, **knobs)
+        m = doc["modes"][mode]
+        print(f"# {mode}: goodput {m['goodput_ratio']:.3f} lost "
+              f"{m['lost_work_s']}s ({m['lost_steps']} steps) "
+              f"completed={m['completed']} wall {m['wall_s']}s",
+              file=sys.stderr)
+    g, u = doc["modes"]["graceful"], doc["modes"]["ungraceful"]
+    ratio = (g["lost_work_s"] / u["lost_work_s"]
+             if u["lost_work_s"] > 0 else 0.0)
+    doc["wall_s"] = round(time.monotonic() - t0, 2)
+    doc["sla"] = {
+        "lost_ratio_graceful_vs_ungraceful": round(ratio, 4),
+        "lost_ratio_budget": 0.25,
+        "graceful_completed_reduced_world":
+            bool(g["completed"]
+                 and g["world_size_history"]
+                 and g["world_size_history"][-1]
+                 < g["world_size_history"][0]),
+        "graceful_zero_failures": g["num_failures"] == 0,
+        "within_wall_budget": doc["wall_s"] <= budget_wall_s,
+    }
+    doc["sla"]["pass"] = bool(
+        ratio <= 0.25 and doc["sla"]["graceful_completed_reduced_world"]
+        and doc["sla"]["graceful_zero_failures"])
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_preempt.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# preempt SLA {'PASS' if doc['sla']['pass'] else 'FAIL'} "
+          f"(lost ratio {ratio:.3f} vs 0.25 budget) -> {path}",
+          file=sys.stderr)
+    if not doc["sla"]["pass"]:
+        raise SystemExit(1)
+
+
 def bench_serve_load(fast: bool = False) -> None:
     """Open-loop Poisson serving bench -> BENCH_serve_load.json.
 
@@ -679,7 +924,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--spec", default="auto",
                     choices=["auto", "7b", "diagnostics", "lint",
-                             "checkpoint", "sanitize", "serve_load"],
+                             "checkpoint", "sanitize", "serve_load",
+                             "preempt"],
                     help="auto: timed bench on local chip(s); "
                          "7b: AOT shape-verify of the Llama-2-7B "
                          "north-star on a virtual 8-device mesh; "
@@ -691,13 +937,19 @@ def main() -> None:
                          "task/actor loop; "
                          "serve_load: open-loop Poisson serving bench "
                          "(inline vs chunked vs disagg + saturation "
-                         "shedding)")
+                         "shedding); "
+                         "preempt: goodput under a scripted preemption "
+                         "schedule — graceful drain vs ungraceful kill "
+                         "vs fail-and-restart baseline")
     ap.add_argument("--fast", action="store_true",
-                    help="serve_load only: tiny model, short phases "
-                         "(smoke-scale)")
+                    help="serve_load/preempt: short smoke-scale run "
+                         "with a tier-1-friendly wall-clock budget")
     args = ap.parse_args()
     if args.spec == "serve_load":
         bench_serve_load(fast=args.fast)
+        return
+    if args.spec == "preempt":
+        bench_preempt(fast=args.fast)
         return
     if args.spec == "7b":
         shape_verify_7b()

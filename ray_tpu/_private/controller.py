@@ -43,6 +43,14 @@ class NodeInfo:
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
     is_head: bool = False
+    # Drain lifecycle (preemption/maintenance notice): a draining node is
+    # unschedulable for new leases and expected to die by the deadline.
+    # Monotonic stamps — only comparable inside the head process; readers
+    # in other processes get a relative drain_remaining_s via ctl_nodes.
+    draining: bool = False
+    drain_reason: str = ""
+    drain_deadline_mono: float = 0.0
+    drain_started_mono: float = 0.0
 
 
 @dataclass
@@ -214,12 +222,65 @@ class Controller:
             if n:
                 n.last_heartbeat = time.monotonic()
 
+    def drain_node(self, node_id: NodeID, deadline_s: float = 30.0,
+                   reason: str = "preemption") -> bool:
+        """Mark a node draining: a preemption/maintenance notice arrived
+        and the node is expected to disappear within ``deadline_s``.  The
+        scheduler side (making it unschedulable) is wired by the Runtime;
+        this records the state and fans the event out."""
+        now = time.monotonic()
+        with self._lock:
+            n = self.nodes.get(node_id)
+            if not n or not n.alive:
+                return False
+            already = n.draining
+            n.draining = True
+            n.drain_reason = reason
+            n.drain_started_mono = n.drain_started_mono if already else now
+            n.drain_deadline_mono = now + max(0.0, deadline_s)
+        if not already:
+            self._export("EXPORT_NODE", {"node_id": node_id.hex(),
+                                         "state": "DRAINING",
+                                         "reason": reason,
+                                         "deadline_s": deadline_s})
+            self.publish("node_draining", node_id)
+        return True
+
+    def undrain_node(self, node_id: NodeID) -> bool:
+        """Cancel a drain (notice withdrawn / chaos experiment over)."""
+        with self._lock:
+            n = self.nodes.get(node_id)
+            if not n or not n.draining:
+                return False
+            n.draining = False
+            n.drain_reason = ""
+            n.drain_deadline_mono = 0.0
+            n.drain_started_mono = 0.0
+        self._export("EXPORT_NODE", {"node_id": node_id.hex(),
+                                     "state": "ALIVE",
+                                     "reason": "undrain"})
+        return True
+
+    def draining_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self.nodes.values()
+                    if n.alive and n.draining]
+
     def mark_node_dead(self, node_id: NodeID, reason: str = "") -> None:
+        drained_for: Optional[float] = None
         with self._lock:
             n = self.nodes.get(node_id)
             if not n or not n.alive:
                 return
             n.alive = False
+            if n.draining:
+                drained_for = time.monotonic() - n.drain_started_mono
+                n.draining = False
+        if drained_for is not None:
+            # How much of the advertised deadline the cluster actually
+            # got between the notice and the node vanishing.
+            from ..util import telemetry
+            telemetry.observe("ray_tpu_node_drain_seconds", drained_for)
         self._export("EXPORT_NODE", {"node_id": node_id.hex(),
                                      "state": "DEAD", "reason": reason})
         self.publish("node_removed", node_id)

@@ -1851,9 +1851,18 @@ class Runtime:
         if specs or n_direct:
             # Dying WHILE running tasks is the unexpected case worth
             # forensics (clean pool reaping and idle actor kills are not).
+            # A death on a draining node is the EXPECTED half of a
+            # preemption: tag the bundle so the postmortem reads
+            # "preempted", not "mystery crash".
+            node = self.controller.nodes.get(node_id)
+            draining = bool(node is not None and node.draining)
             self._maybe_death_bundle(
-                f"worker_death_{worker_id.hex()[:8]}",
-                {"worker_id": worker_id.hex(), "reason": reason,
+                f"worker_death_{'preempted_' if draining else ''}"
+                f"{worker_id.hex()[:8]}",
+                {"worker_id": worker_id.hex(),
+                 "reason": "preempted" if draining else reason,
+                 "worker_reason": reason,
+                 "node_draining": draining,
                  "running_tasks": [t.hex() for t in running_tasks],
                  "direct_calls_inflight": n_direct})
         for spec in specs:
@@ -1935,6 +1944,8 @@ class Runtime:
         # re-attach (even across a head restart) must be refused.
         self.controller.drop_revivable(node_id.binary())
         self.scheduler.remove_node(node_id)
+        telemetry.set_gauge("ray_tpu_node_draining",
+                            len(self.controller.draining_nodes()))
 
         specs: List[TaskSpec] = []
         with self._running_lock:
@@ -2257,11 +2268,47 @@ class Runtime:
         return self.scheduler.available_resources()
 
     def ctl_nodes(self):
+        now = time.monotonic()
         return [{"node_id": n.node_id.hex(), "alive": n.alive,
                  "hostname": n.hostname,
                  "resources": n.total_resources.to_dict(),
-                 "is_head": n.is_head}
+                 "is_head": n.is_head,
+                 "draining": n.draining,
+                 "drain_reason": n.drain_reason,
+                 # Relative, so cross-process readers never difference a
+                 # foreign monotonic stamp (RT203 territory).
+                 "drain_remaining_s": max(0.0, n.drain_deadline_mono - now)
+                 if n.draining else 0.0}
                 for n in self.controller.nodes.values()]
+
+    def ctl_drain_node(self, node_id_hex: str, deadline_s: float = 30.0,
+                       reason: str = "preemption") -> bool:
+        """Drain protocol entry point: mark the node unschedulable for
+        new leases and advertise the kill deadline.  Train/serve
+        controllers poll the node table and evacuate their work; the
+        autoscaler's provider hook and `ray-tpu drain` both land here."""
+        try:
+            node_id = NodeID.from_hex(node_id_hex)
+        except ValueError:
+            return False
+        if not self.controller.drain_node(node_id, deadline_s, reason):
+            return False
+        self.scheduler.set_draining(node_id, True)
+        telemetry.set_gauge("ray_tpu_node_draining",
+                            len(self.controller.draining_nodes()))
+        return True
+
+    def ctl_undrain_node(self, node_id_hex: str) -> bool:
+        try:
+            node_id = NodeID.from_hex(node_id_hex)
+        except ValueError:
+            return False
+        if not self.controller.undrain_node(node_id):
+            return False
+        self.scheduler.set_draining(node_id, False)
+        telemetry.set_gauge("ray_tpu_node_draining",
+                            len(self.controller.draining_nodes()))
+        return True
 
     # -- syncer (reference: src/ray/ray_syncer/ray_syncer.h:91) -------------
 
@@ -2295,7 +2342,11 @@ class Runtime:
         for a in self.controller.actors.values():
             rec = {"actor_id": a.actor_id.hex(), "state": a.state,
                    "name": a.name, "class_name": a.class_name,
-                   "num_restarts": a.num_restarts}
+                   "num_restarts": a.num_restarts,
+                   # Placement: lets drain-aware owners (train/serve
+                   # controllers) find which of their actors sit on a
+                   # draining node.
+                   "node_id": a.node_id.hex() if a.node_id else None}
             if filters and any(rec.get(k) != v for k, v in filters.items()):
                 continue
             out.append(rec)

@@ -87,6 +87,9 @@ class ClusterScheduler:
         self._ready_count = 0
         self._waiting: Dict[ObjectID, List[_PendingTask]] = defaultdict(list)
         self._infeasible: List[_PendingTask] = []
+        # Draining nodes (preemption notice): unschedulable for NEW
+        # leases/bundles; tasks already running there finish or evacuate.
+        self._draining: Set[NodeID] = set()
         self._wake = threading.Condition(self._lock)
         self._running = True
         self._spread_rr = 0
@@ -116,12 +119,30 @@ class ClusterScheduler:
     def remove_node(self, node_id: NodeID) -> None:
         with self._wake:
             self._nodes.pop(node_id, None)
+            self._draining.discard(node_id)
             self._wake.notify_all()
 
+    def set_draining(self, node_id: NodeID, draining: bool) -> None:
+        """Fence a node off from new placements (drain notice), or lift
+        the fence.  Existing bookings/bundles on the node are untouched —
+        work already there drains through its own lifecycle."""
+        with self._wake:
+            if draining:
+                self._draining.add(node_id)
+            else:
+                self._draining.discard(node_id)
+                # Capacity became visible again: queued tasks may now fit.
+                self._wake.notify_all()
+
     def available_resources(self) -> Dict[str, float]:
+        """Schedulable capacity: draining nodes are excluded — their
+        resources are about to vanish, and counting them would make
+        elastic policies / the autoscaler size work onto a doomed host."""
         with self._lock:
             total = ResourceSet()
             for ns in self._nodes.values():
+                if ns.info.node_id in self._draining:
+                    continue
                 total = total + ns.available
             return total.to_dict()
 
@@ -258,7 +279,8 @@ class ClusterScheduler:
         if spec.placement_group is not None:
             return True
         for ns in self._nodes.values():
-            if need.fits(ns.available):
+            if ns.info.node_id not in self._draining and \
+                    need.fits(ns.available):
                 return True
         return False
 
@@ -382,14 +404,16 @@ class ClusterScheduler:
         strategy = spec.scheduling_strategy
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             ns = self._nodes.get(strategy.node_id)
-            if ns is not None and need.fits(ns.available):
+            if ns is not None and need.fits(ns.available) and \
+                    strategy.node_id not in self._draining:
                 ns.available = ns.available - need
                 return ns.info.node_id
             if not strategy.soft:
                 return None  # stays queued until that node frees up
 
         candidates = [ns for ns in self._nodes.values()
-                      if need.fits(ns.available)]
+                      if ns.info.node_id not in self._draining
+                      and need.fits(ns.available)]
         if not candidates:
             if not any(need.fits(ns.info.total_resources)
                        for ns in self._nodes.values()):
@@ -442,7 +466,11 @@ class ClusterScheduler:
         if not pending:
             self._controller.set_pg_state(pg.pg_id, PG_CREATED)
             return True
-        snapshot = {nid: ns.available.copy() for nid, ns in self._nodes.items()}
+        # Draining nodes never receive NEW bundles (existing bundles on a
+        # draining node stay committed; evacuation is the owner's call).
+        snapshot = {nid: ns.available.copy()
+                    for nid, ns in self._nodes.items()
+                    if nid not in self._draining}
         used = {b.node_id for b in pg.bundles if b.node_id is not None}
         assignment = self._plan_bundles(pg, snapshot, pending, used)
         if assignment is None:

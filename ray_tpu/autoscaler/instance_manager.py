@@ -50,6 +50,30 @@ _TERMINAL = (TERMINATED, FAILED)
 _ALIVE = (REQUESTED, PROVISIONING, RUNNING, JOINED)
 
 
+def _default_drain_hook(ray_node_id: str, deadline_s: float,
+                        reason: str) -> None:
+    """Route a provider preemption notice into the co-located runtime's
+    drain verb.  No-op when the manager runs without a runtime (unit
+    tests, external reconcilers feeding a custom hook)."""
+    from .._private.runtime import driver_runtime
+    rt = driver_runtime()
+    if rt is not None:
+        rt.ctl_drain_node(ray_node_id, deadline_s, reason)
+
+
+def _export_node_event(event: dict) -> None:
+    """EXPORT_NODE record via the co-located runtime's event sink
+    (best-effort: the manager also runs runtime-less in unit tests)."""
+    from .._private.runtime import driver_runtime
+    rt = driver_runtime()
+    if rt is not None:
+        try:
+            rt.ctl_export_event("EXPORT_NODE", event)
+        except Exception as e:
+            from ..util import telemetry
+            telemetry.note_swallowed("instance_manager.export", e)
+
+
 @dataclass
 class Instance:
     instance_id: str
@@ -80,6 +104,18 @@ class CloudInstance:
     os_pid: int = 0
 
 
+@dataclass
+class PreemptionNotice:
+    """Advance warning that the cloud will reclaim a host (GCE spot
+    preemption warning / GKE graceful-termination notice): the instance
+    is still RUNNING, but dies within ``deadline_s``.  The manager turns
+    this into a cluster drain via its ``drain_hook`` so work evacuates
+    instead of crashing."""
+    cloud_id: str
+    deadline_s: float = 30.0
+    reason: str = "preemption"
+
+
 class CloudProvider:
     """Async cloud provider ABC (reference: v2 node_provider.py
     ICloudInstanceProvider — request/terminate return immediately, state
@@ -101,6 +137,12 @@ class CloudProvider:
 
     def terminate(self, cloud_ids: List[str]) -> None:
         raise NotImplementedError
+
+    def preemption_notices(self) -> List[PreemptionNotice]:
+        """Pending reclaim warnings (metadata-server watcher on GCE, the
+        eviction API elsewhere).  Default: the provider has no advance
+        signal — preemptions surface only as vanished instances."""
+        return []
 
 
 class InstanceStore:
@@ -179,7 +221,9 @@ class InstanceManager:
     def __init__(self, provider: CloudProvider,
                  store: Optional[InstanceStore] = None,
                  joined_pids: Optional[Callable[[], Dict[int, str]]] = None,
-                 request_timeout_s: float = 300.0):
+                 request_timeout_s: float = 300.0,
+                 drain_hook: Optional[
+                     Callable[[str, float, str], None]] = None):
         self.provider = provider
         self.store = store or InstanceStore()
         # () -> {os_pid: ray_node_id} of nodes registered with the head.
@@ -189,6 +233,18 @@ class InstanceManager:
         # entries are terminal and never pruned, so without this every
         # pass would re-send the full history of dead ids.
         self._terminate_issued: set = set()
+        # (ray_node_id, deadline_s, reason) -> start a cluster drain.
+        # Default: ctl_drain_node on the co-located runtime, so a
+        # provider preemption notice flows straight into the drain
+        # protocol without extra wiring.
+        self._drain_hook = drain_hook or _default_drain_hook
+        # cloud_ids whose notice already fired the drain hook (notices
+        # repeat until the instance dies; the drain must fire once), and
+        # those whose PREEMPTION_NOTICE event was already exported (a
+        # notice can precede JOIN — event once, hook retried until the
+        # node joins).
+        self._drain_notified: set = set()
+        self._notice_exported: set = set()
 
     # -- desired state ---------------------------------------------------- #
 
@@ -196,6 +252,7 @@ class InstanceManager:
         """One convergence step: sync provider + cluster state into the
         table, then launch/terminate toward ``desired`` (node_type ->
         target instance count)."""
+        self._poll_preemption_notices()
         live_ids = self._sync_cloud_state()
         self._sync_join_state()
         self._replace_failed(live_ids)
@@ -220,6 +277,53 @@ class InstanceManager:
                 self._terminate_surplus(ntype, have)
 
     # -- sync ------------------------------------------------------------- #
+
+    def _poll_preemption_notices(self) -> None:
+        """Turn provider reclaim warnings into cluster drains: a notice
+        for a JOINED instance starts the graceful half of elasticity
+        (drain -> urgent checkpoint -> planned downsize) instead of the
+        crash path the eventual kill would otherwise take."""
+        try:
+            notices = self.provider.preemption_notices()
+        except Exception:
+            return  # the signal plane is best-effort; retried next pass
+        if not notices:
+            return
+        by_cloud = {i.cloud_id: i for i in self.store.all() if i.cloud_id}
+        # A terminated instance's dedup entries must not shadow a future
+        # reissued notice for a recycled/cancelled-and-reposted id.
+        for cid in list(self._drain_notified):
+            inst = by_cloud.get(cid)
+            if inst is None or inst.status in _TERMINAL:
+                self._drain_notified.discard(cid)
+                self._notice_exported.discard(cid)
+        for notice in notices:
+            inst = by_cloud.get(notice.cloud_id)
+            if inst is None or inst.status not in (RUNNING, JOINED):
+                continue
+            if notice.cloud_id not in self._notice_exported:
+                self._notice_exported.add(notice.cloud_id)
+                _export_node_event({
+                    "cloud_id": notice.cloud_id,
+                    "node_id": inst.ray_node_id or None,
+                    "state": "PREEMPTION_NOTICE",
+                    "reason": notice.reason,
+                    "deadline_s": notice.deadline_s})
+            # The drain fires once the node has JOINED — a notice during
+            # the boot->join window must KEEP retrying until then, not
+            # be marked handled while no drain ever happened (the cloud
+            # will still kill the host; the join may land first).
+            if notice.cloud_id in self._drain_notified:
+                continue
+            if inst.status == JOINED and inst.ray_node_id:
+                self._drain_notified.add(notice.cloud_id)
+                try:
+                    self._drain_hook(inst.ray_node_id, notice.deadline_s,
+                                     notice.reason)
+                except Exception as e:
+                    from ..util import telemetry
+                    telemetry.note_swallowed(
+                        "instance_manager.drain_hook", e)
 
     def _sync_cloud_state(self) -> set:
         """Sync table statuses from one provider.describe() snapshot;
@@ -250,9 +354,25 @@ class InstanceManager:
                         inst.os_pid = ci.os_pid
                         break
             if ci is None:
-                if inst.status in (RUNNING, JOINED) or (
-                        inst.status == TERMINATING and inst.cloud_id):
-                    # Cloud lost it (preemption / terminate finished).
+                if inst.status in (RUNNING, JOINED):
+                    # Cloud lost it: a RUNNING/JOINED host vanishing
+                    # without our terminate is a preemption — count it
+                    # and say so, never silently reconcile (the goodput
+                    # hit needs an attributable cause in the event log).
+                    preempted = inst.cloud_id not in self._terminate_issued
+                    self.store.upsert(inst, TERMINATED)
+                    if preempted:
+                        from ..util import telemetry
+                        telemetry.inc("ray_tpu_node_preempted_total")
+                        _export_node_event({
+                            "cloud_id": inst.cloud_id or None,
+                            "node_id": inst.ray_node_id or None,
+                            "node_type": inst.node_type,
+                            "state": "PREEMPTED",
+                            "had_notice": inst.cloud_id in
+                            self._drain_notified})
+                elif inst.status == TERMINATING and inst.cloud_id:
+                    # Our own terminate finished: expected, not preempted.
                     self.store.upsert(inst, TERMINATED)
                 elif inst.status in (REQUESTED, PROVISIONING) and \
                         now - inst.updated_at > self.request_timeout_s:
@@ -384,6 +504,7 @@ class FakeCloudProvider(CloudProvider):
         self.provision_delay_s = provision_delay_s
         self.run_delay_s = run_delay_s
         self.request_log: List[Tuple[str, str, int]] = []
+        self._notices: Dict[str, PreemptionNotice] = {}
 
     def request(self, request_id: str, node_type: str, count: int) -> None:
         with self._lock:
@@ -436,6 +557,29 @@ class FakeCloudProvider(CloudProvider):
             ci = self._instances.get(cloud_id)
             if ci is not None:
                 ci.status = "failed"
+
+    def preempt_notice(self, cloud_id: str, deadline_s: float = 10.0,
+                       reason: str = "preemption") -> None:
+        """Post a reclaim warning (the spot 30s-warning analog); the
+        instance keeps running until lose_instance/kill_instance."""
+        with self._lock:
+            self._notices[cloud_id] = PreemptionNotice(
+                cloud_id, deadline_s, reason)
+
+    def lose_instance(self, cloud_id: str) -> None:
+        """The cloud takes the host away (preemption completes): it
+        disappears from describe() entirely — unlike kill_instance,
+        which still reports a 'failed' record."""
+        with self._lock:
+            self._instances.pop(cloud_id, None)
+            self._created_at.pop(cloud_id, None)
+
+    def preemption_notices(self) -> List[PreemptionNotice]:
+        with self._lock:
+            return [n for n in self._notices.values()
+                    if self._instances.get(n.cloud_id) is not None
+                    and self._instances[n.cloud_id].status
+                    not in ("failed", "terminated")]
 
     def mark_joined_pid(self, cloud_id: str, pid: int) -> None:
         with self._lock:

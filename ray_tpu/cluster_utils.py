@@ -38,6 +38,9 @@ class NodeHandle:
     proc: subprocess.Popen
     num_cpus: float
     resources: Optional[Dict[str, float]]
+    # Runtime node id (hex) once the join is observed — the address the
+    # drain protocol / chaos harness target a node by.
+    node_id: Optional[str] = None
 
     @property
     def alive(self) -> bool:
@@ -77,12 +80,24 @@ class Cluster:
         env.setdefault("RAY_TPU_TPU_CHIPS_PER_HOST_OVERRIDE", "0")
         # Own process group: killing a node takes its spawned workers with
         # it instead of leaving orphans that race the next test's runtime.
+        before = self._alive_node_ids()
         proc = subprocess.Popen(cmd, env=env, start_new_session=True)
         handle = NodeHandle(proc, num_cpus, resources)
         self._nodes.append(handle)
         if wait:
             self.wait_for_nodes(timeout=timeout)
+            # Bind the runtime node id (the diff of the alive set) so the
+            # handle can be drained/preempted by id.  Serial add_node
+            # calls (the test-harness norm) make the diff unambiguous.
+            new = self._alive_node_ids() - before
+            if len(new) == 1:
+                handle.node_id = next(iter(new))
         return handle
+
+    def _alive_node_ids(self) -> set:
+        return {n.node_id.hex()
+                for n in self.runtime.controller.nodes.values()
+                if n.alive and not n.is_head}
 
     def alive_node_count(self) -> int:
         return sum(1 for n in self.runtime.controller.nodes.values()

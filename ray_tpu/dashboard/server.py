@@ -42,7 +42,7 @@ async function refresh(){
   for (const [k,v] of Object.entries(c.total_resources))
     h += `<tr><td>${k}</td><td>${c.available_resources[k]??0} / ${v}</td></tr>`;
   h += '</table><h2>nodes</h2><table><tr><th>id</th><th>state</th><th>host</th><th>head</th></tr>';
-  for (const n of nodes) h += `<tr><td>${n.node_id.slice(0,12)}</td><td>${n.alive?'ALIVE':'DEAD'}</td><td>${n.hostname}</td><td>${n.is_head}</td></tr>`;
+  for (const n of nodes) h += `<tr><td>${n.node_id.slice(0,12)}</td><td>${n.alive?(n.draining?`DRAINING(${Math.round(n.drain_remaining_s)}s)`:'ALIVE'):'DEAD'}</td><td>${n.hostname}</td><td>${n.is_head}</td></tr>`;
   h += '</table><h2>actors</h2><table><tr><th>id</th><th>class</th><th>state</th><th>restarts</th></tr>';
   for (const a of actors) h += `<tr><td>${a.actor_id.slice(0,12)}</td><td>${a.class_name}</td><td>${a.state}</td><td>${a.num_restarts}</td></tr>`;
   h += '</table><h2>tasks</h2><table><tr><th>name</th><th>states</th></tr>';

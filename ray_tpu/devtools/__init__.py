@@ -28,6 +28,11 @@ and understands ``ray_tpu`` semantics):
   that keep the happy path's releases (RT304).  Its runtime twin is the
   leak sanitizer in ``ray_tpu/_private/sanitizer.py``
   (``RAY_TPU_SANITIZE=1``), on for the whole tier-1 suite.
+
+* ``ray_tpu.devtools.chaos`` — the chaos SLA harness: scripted
+  kill/preempt/add schedules replayed against a live cluster, so drain
+  SLAs and goodput-under-preemption are measured (``bench.py --spec
+  preempt``), not asserted from a single hand-timed kill.
 """
 
 from .lint import (Finding, LintResult, Rule, iter_rules, lint_paths,

@@ -1,0 +1,201 @@
+"""Chaos SLA harness: scripted kill/preempt/add schedules against a
+live cluster.
+
+The missing piece between unit-level fault injection (kill one worker at
+one hand-picked moment) and a production claim ("graceful drain loses
+<= 25% of what an ungraceful kill loses"): a *schedule* of failures
+replayed identically against different recovery strategies, so goodput
+under preemption is a measured number, not an anecdote.
+
+A :class:`ChaosSchedule` is a list of timed events:
+
+* ``preempt`` — the spot-reclaim sequence: post a drain notice for the
+  node, then SIGKILL it when the deadline expires (exactly what a cloud
+  does: warning, grace window, gone).
+* ``kill``    — ungraceful: SIGKILL the node with no warning.
+* ``drain``   — notice only, no kill (maintenance that gets cancelled).
+* ``add_node`` — capacity arrives mid-run (elastic upsize fodder).
+
+:class:`ChaosRunner` replays the schedule on a background thread
+(``sanitizer.spawn`` — the leak gate covers the harness itself) against
+a ``cluster_utils.Cluster``; every applied event lands in ``runner.log``
+with its actual fire time, so a bench/test can line events up against
+the goodput timeline.
+
+Used by ``bench.py --spec preempt`` and the tier-1 drain-SLA chaos
+tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ChaosEvent", "ChaosSchedule", "ChaosRunner"]
+
+
+@dataclass
+class ChaosEvent:
+    """One scripted fault.  ``node`` is a ``cluster_utils.NodeHandle``
+    for kill/preempt (the harness needs the process to SIGKILL) or a
+    node-id hex for pure drains; ``add_node`` ignores it."""
+    at_s: float
+    action: str                    # preempt | kill | drain | add_node
+    node: Any = None
+    deadline_s: float = 10.0       # preempt/drain: advertised grace
+    reason: str = "chaos"
+    num_cpus: float = 2.0          # add_node sizing
+    resources: Optional[Dict[str, float]] = None
+
+
+@dataclass
+class ChaosSchedule:
+    events: List[ChaosEvent] = field(default_factory=list)
+
+    def preempt(self, at_s: float, node, deadline_s: float = 10.0,
+                reason: str = "preemption") -> "ChaosSchedule":
+        self.events.append(ChaosEvent(at_s, "preempt", node,
+                                      deadline_s=deadline_s,
+                                      reason=reason))
+        return self
+
+    def kill(self, at_s: float, node) -> "ChaosSchedule":
+        self.events.append(ChaosEvent(at_s, "kill", node))
+        return self
+
+    def drain(self, at_s: float, node, deadline_s: float = 10.0,
+              reason: str = "maintenance") -> "ChaosSchedule":
+        self.events.append(ChaosEvent(at_s, "drain", node,
+                                      deadline_s=deadline_s,
+                                      reason=reason))
+        return self
+
+    def add_node(self, at_s: float, num_cpus: float = 2.0,
+                 resources: Optional[Dict[str, float]] = None
+                 ) -> "ChaosSchedule":
+        self.events.append(ChaosEvent(at_s, "add_node", None,
+                                      num_cpus=num_cpus,
+                                      resources=resources))
+        return self
+
+
+def _node_hex(node) -> Optional[str]:
+    if node is None:
+        return None
+    if isinstance(node, str):
+        return node
+    return getattr(node, "node_id", None)
+
+
+class ChaosRunner:
+    """Replays a :class:`ChaosSchedule` against a live cluster.
+
+    ``start()`` arms the schedule (t=0 is the start call); ``stop()``
+    cancels anything unfired and joins the harness thread (bounded) —
+    chaos threads MUST not outlive the test, the runtime leak sanitizer
+    gates on it.
+    """
+
+    def __init__(self, cluster, schedule: ChaosSchedule,
+                 name: str = "chaos"):
+        self.cluster = cluster
+        self.schedule = schedule
+        self.name = name
+        #: Applied events: {"at_s": planned, "fired_s": actual,
+        #:  "action": ..., "node": hex|None, "ok": bool, "error": str}.
+        self.log: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ChaosRunner":
+        if self._thread is not None:
+            raise RuntimeError("chaos runner already started")
+        from .._private import sanitizer
+        self._thread = sanitizer.spawn(self._run,
+                                       name=f"chaos-{self.name}")
+        return self
+
+    def stop(self, timeout: float = 15.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        self._thread = None
+
+    def join(self, timeout: float = 120.0) -> bool:
+        """Wait for the whole schedule to finish; True when it did."""
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout=timeout)
+        return not t.is_alive()
+
+    def __enter__(self) -> "ChaosRunner":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- replay ------------------------------------------------------------
+
+    def _expand(self) -> List[ChaosEvent]:
+        """preempt = drain now + kill at the deadline: expand so the
+        replay loop only handles primitive actions."""
+        out: List[ChaosEvent] = []
+        for ev in self.schedule.events:
+            if ev.action == "preempt":
+                out.append(ChaosEvent(ev.at_s, "drain", ev.node,
+                                      deadline_s=ev.deadline_s,
+                                      reason=ev.reason))
+                out.append(ChaosEvent(ev.at_s + ev.deadline_s, "kill",
+                                      ev.node, reason=ev.reason))
+            else:
+                out.append(ev)
+        out.sort(key=lambda e: e.at_s)
+        return out
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        for ev in self._expand():
+            delay = ev.at_s - (time.monotonic() - t0)
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            rec = {"at_s": ev.at_s,
+                   "fired_s": time.monotonic() - t0,
+                   "action": ev.action,
+                   "node": _node_hex(ev.node),
+                   "ok": True, "error": None}
+            try:
+                self._apply(ev)
+            except Exception as e:  # noqa: BLE001 — logged, replay goes on
+                rec["ok"] = False
+                rec["error"] = f"{type(e).__name__}: {e}"
+            self.log.append(rec)
+
+    def _apply(self, ev: ChaosEvent) -> None:
+        from .._private.api import _control
+        if ev.action == "drain":
+            hexid = _node_hex(ev.node)
+            if not hexid:
+                raise ValueError("drain target has no node_id")
+            if not _control("drain_node", hexid, ev.deadline_s,
+                            ev.reason):
+                raise RuntimeError(f"drain_node({hexid[:12]}) refused")
+        elif ev.action == "kill":
+            # The cloud's reclaim: SIGKILL the node process group (takes
+            # its workers with it) — no goodbye on any channel.
+            if ev.node is None or isinstance(ev.node, str):
+                raise ValueError("kill needs a NodeHandle")
+            if ev.node.alive:
+                self.cluster.remove_node(ev.node, wait_dead=True)
+        elif ev.action == "add_node":
+            self.cluster.add_node(num_cpus=ev.num_cpus,
+                                  resources=ev.resources)
+        else:
+            raise ValueError(f"unknown chaos action {ev.action!r}")

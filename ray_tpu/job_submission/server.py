@@ -143,6 +143,28 @@ class JobServer:
             path = await call(_control, "debug_dump", reason)
             return web.json_response({"path": path})
 
+        async def cluster_drain_node(request):
+            """Operator-initiated drain (`ray-tpu drain`): the node
+            becomes unschedulable and drain-aware controllers evacuate
+            their work before the deadline."""
+            from ray_tpu._private.api import _control
+            node_id = request.query.get("node_id", "")
+            reason = request.query.get("reason", "manual")
+            try:
+                deadline_s = float(request.query.get("deadline_s", "30"))
+            except ValueError:
+                return web.json_response(
+                    {"error": "bad deadline_s"}, status=400)
+            if request.query.get("undrain") == "1":
+                ok = await call(_control, "undrain_node", node_id)
+            else:
+                ok = await call(_control, "drain_node", node_id,
+                                deadline_s, reason)
+            if not ok:
+                return web.json_response(
+                    {"error": f"no alive node {node_id!r}"}, status=404)
+            return web.json_response({"ok": True})
+
         async def timeline(request):
             from ray_tpu._private.api import _control
             return web.json_response(await call(_control, "timeline"))
@@ -165,6 +187,8 @@ class JobServer:
             app.router.add_get("/api/cluster/stacks", cluster_stacks)
             app.router.add_post("/api/cluster/debug_dump",
                                 cluster_debug_dump)
+            app.router.add_post("/api/cluster/drain_node",
+                                cluster_drain_node)
             app.router.add_get("/metrics", metrics)
             app.router.add_get(
                 "/-/healthz", lambda r: web.json_response({"ok": True}))

@@ -165,7 +165,13 @@ def status(address):
     s = _client(address).cluster_status()
     click.echo(f"nodes: {len(s['nodes'])}")
     for n in s["nodes"]:
-        state = "ALIVE" if n["alive"] else "DEAD"
+        if not n["alive"]:
+            state = "DEAD"
+        elif n.get("draining"):
+            state = (f"DRAINING({n.get('drain_remaining_s', 0):.0f}s "
+                     f"{n.get('drain_reason') or 'drain'})")
+        else:
+            state = "ALIVE"
         click.echo(f"  {n['node_id'][:12]} {state} head={n['is_head']} "
                    f"{n['hostname']}")
     click.echo("resources (available/total):")
@@ -450,6 +456,44 @@ def ckpt_inspect(run, storage_path, step, deep):
         click.echo(f"  {key}  {spec['dtype']}[{shape}]")
     if problems:
         raise SystemExit(1)
+
+
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--deadline-s", type=float, default=30.0, show_default=True,
+              help="Seconds until the node is expected to die; train/"
+                   "serve controllers must evacuate within this window.")
+@click.option("--reason", default="manual", show_default=True)
+@click.option("--undrain", is_flag=True,
+              help="Cancel a drain instead of starting one.")
+@click.argument("node")
+def drain(address, deadline_s, reason, undrain, node):
+    """Start a graceful drain of NODE (node id hex, prefix ok): it stops
+    taking new leases, training checkpoints urgently and re-forms
+    without it, serve replaces its replicas — all before the deadline.
+    This is the manual twin of the cloud preemption-notice hook."""
+    from urllib.parse import urlencode
+    client = _client(address)
+    # Prefix resolution: operators paste the 12-char id `status` prints.
+    nodes = client.cluster_status()["nodes"]
+    matches = [n for n in nodes if n["node_id"].startswith(node)
+               and n["alive"]]
+    if not matches:
+        raise click.ClickException(f"no alive node matching {node!r}")
+    if len(matches) > 1:
+        raise click.ClickException(
+            f"ambiguous node prefix {node!r}: "
+            + ", ".join(n["node_id"][:12] for n in matches))
+    node_id = matches[0]["node_id"]
+    q = {"node_id": node_id, "deadline_s": deadline_s, "reason": reason}
+    if undrain:
+        q["undrain"] = "1"
+    client._request("POST", "/api/cluster/drain_node?" + urlencode(q))
+    if undrain:
+        click.echo(f"node {node_id[:12]} undrained")
+    else:
+        click.echo(f"node {node_id[:12]} draining "
+                   f"(deadline {deadline_s:g}s, reason {reason})")
 
 
 @cli.group()

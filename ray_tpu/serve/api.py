@@ -225,17 +225,24 @@ class _DeploymentState:
             self.inflight[id(r)] = 0
         return r
 
-    def pop_replica(self, min_load: Optional[Dict[str, int]] = None):
-        """Detach and return the least-loaded replica (by the router-
-        reported per-replica loads) WITHOUT killing it — the controller
-        drains it first."""
+    def pop_replica(self, min_load: Optional[Dict[str, int]] = None,
+                    specific=None):
+        """Detach and return a replica WITHOUT killing it — the
+        controller drains it first.  Default pick: least-loaded (by the
+        router-reported per-replica loads); ``specific`` detaches that
+        exact replica instead (node-drain evacuation)."""
         with self._lock:
             if not self.replicas:
                 return None
-            loads = min_load or {}
-            idx = min(range(len(self.replicas)),
-                      key=lambda i: loads.get(
-                          self.replicas[i]._actor_id.hex(), 0))
+            if specific is not None:
+                if specific not in self.replicas:
+                    return None  # already detached (double-drain race)
+                idx = self.replicas.index(specific)
+            else:
+                loads = min_load or {}
+                idx = min(range(len(self.replicas)),
+                          key=lambda i: loads.get(
+                              self.replicas[i]._actor_id.hex(), 0))
             r = self.replicas.pop(idx)
             self.inflight.pop(id(r), None)
             return r

@@ -54,6 +54,10 @@ class ServeController:
         self._stop = threading.Event()
         # Autoscaling decision memory: name -> (direction, since_ts)
         self._pending_scale: Dict[str, tuple] = {}
+        # Node-drain observation (preemption notices): cached snapshot of
+        # draining node ids + its poll stamp.
+        self._draining_cache: set = set()
+        self._last_drain_poll = 0.0
         # Router-pushed ongoing-request metrics:
         # name -> router_id -> (monotonic_ts, total_inflight)
         # (reference: handle-side autoscaling metrics pushed to the
@@ -108,12 +112,32 @@ class ServeController:
     def _reconcile_all(self) -> None:
         with self._app_lock:
             states = list(self.deployments.values())
+        draining = self._draining_node_ids()
         for state in states:
             if state.stopped:
                 continue
             self._health_check(state)
+            if draining:
+                self._evacuate_draining(state, draining)
             self._autoscale(state)
             self._reconcile(state)
+
+    def _draining_node_ids(self) -> set:
+        """Draining-node snapshot, polled at most once per second (a
+        control round-trip per reconcile pass would be pure overhead in
+        the steady state where nothing drains)."""
+        now = time.monotonic()
+        if now - self._last_drain_poll < 1.0:
+            return self._draining_cache
+        self._last_drain_poll = now
+        try:
+            from .._private.api import _control
+            self._draining_cache = {
+                n["node_id"] for n in _control("nodes")
+                if n.get("alive") and n.get("draining")}
+        except Exception:
+            self._draining_cache = set()
+        return self._draining_cache
 
     # -- pieces -------------------------------------------------------------
 
@@ -207,12 +231,48 @@ class ServeController:
         r = state.pop_replica(min_load=loads)
         if r is None:
             return
-        hexid = r._actor_id.hex()
         self._publish(state)
+        self._drain_and_kill(state, r)
+
+    def _evacuate_draining(self, state, draining: set) -> None:
+        """A node covering replicas is draining (preemption notice):
+        proactively move them off — unpublish each doomed replica (the
+        same settle-then-kill path downscales use) and let the reconcile
+        step backfill on a non-draining node, instead of waiting for the
+        crash and serving errors in the gap."""
+        from .._private.api import _control
+        with state._lock:
+            replicas = list(state.replicas)
+        if not replicas:
+            return
+        try:
+            actor_nodes = {a["actor_id"]: a.get("node_id")
+                           for a in _control("list_actors")}
+        except Exception:
+            return  # retried next pass
+        doomed = [r for r in replicas
+                  if actor_nodes.get(r._actor_id.hex()) in draining]
+        if not doomed:
+            return
+        for r in doomed:
+            if state.pop_replica(specific=r) is None:
+                continue  # already evacuated
+            self._drain_and_kill(state, r, settle_s=10.0)
+        self._publish(state)
+        # Backfill ahead of the regular reconcile pass so replacement
+        # capacity exists before the drained node dies (the scheduler
+        # already refuses to place the new replica on a draining node).
+        self._reconcile(state)
+
+    def _drain_and_kill(self, state, r, settle_s: float = 30.0) -> None:
+        """Unpublished replica teardown: wait (bounded) for its reported
+        in-flight to settle at zero, then kill — on a background thread
+        so the control loop keeps reconciling."""
+        hexid = r._actor_id.hex()
 
         def drain():
             import ray_tpu
-            deadline = time.monotonic() + 30.0
+            deadline = time.monotonic() + settle_s
             while time.monotonic() < deadline:
                 if self._replica_loads(state).get(hexid, 0) <= 0:
                     # One extra beat: metrics lag the actual completions.

@@ -4,6 +4,7 @@ from ._checkpoint import (Checkpoint, CheckpointManager, load_pytree,
                           save_pytree)
 from ._context import (TrainContext, get_context, load_checkpoint, report,
                        save_checkpoint)
+from .controller import CrashLoopError
 from .trainer import (CheckpointConfig, FailureConfig, JaxTrainer, Result,
                       RunConfig, ScalingConfig)
 from .watchdog import TrainWatchdog, WatchdogConfig
@@ -12,6 +13,6 @@ __all__ = [
     "JaxTrainer", "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Result", "Checkpoint", "CheckpointManager",
     "get_context", "report", "TrainContext", "save_pytree", "load_pytree",
-    "save_checkpoint", "load_checkpoint",
+    "save_checkpoint", "load_checkpoint", "CrashLoopError",
     "WatchdogConfig", "TrainWatchdog",
 ]

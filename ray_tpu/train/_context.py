@@ -47,6 +47,12 @@ class TrainContext:
         # measured on the monotonic clock (NTP-immune).
         self._last_report_wall = time.time()
         self._last_report_mono = time.monotonic()
+        # Drain protocol (preemption notice): report() polls the
+        # controller's generation-tagged drain request and answers it
+        # once with an urgent checkpoint flush + ack.
+        self._generation = self._ckpt_options.get("generation")
+        self._last_drain_check_mono = 0.0
+        self._drain_acked = False
 
     def get_world_rank(self) -> int:
         return self._rank
@@ -151,6 +157,10 @@ def report(metrics: Dict[str, Any],
              f"train/{ctx.run_id}/report/{ctx.get_world_rank()}/"
              f"{ctx._incarnation}/{ctx._report_seq}",
              pickle.dumps(payload))
+    # Progress published first, THEN answer any pending drain request:
+    # the controller sees this step's checkpoint registration before the
+    # urgent-flush ack completes its ack set.
+    _maybe_drain_flush(ctx)
 
 
 def save_checkpoint(tree: Any, metrics: Optional[Dict[str, Any]] = None,
@@ -185,6 +195,73 @@ def load_checkpoint(placement=None) -> Optional[Any]:
         return None
     return ctx.checkpoint_client().load(ctx._latest_checkpoint,
                                         placement=placement)
+
+
+def drain_key(run_id: str) -> str:
+    """KV key the controller publishes a drain request under."""
+    return f"train/{run_id}/drain"
+
+
+def drain_ack_prefix(run_id: str, generation=None) -> str:
+    """Ack-key prefix — ONE source of truth for the protocol's key
+    layout (the controller polls and GCs by this prefix; generation=None
+    spans every generation for the post-teardown sweep)."""
+    base = f"train/{run_id}/drain_ack/"
+    return base if generation is None else f"{base}{generation}/"
+
+
+def drain_ack_key(run_id: str, generation, rank: int) -> str:
+    return drain_ack_prefix(run_id, generation) + str(rank)
+
+
+def _maybe_drain_flush(ctx: "TrainContext") -> None:
+    """Worker half of the drain protocol: when the controller posts a
+    drain request for this generation, flush the async checkpoint writer
+    (every submitted save publishes, acks, and pushes its emergency RAM
+    replica) and ack — the urgent checkpoint that makes a preemption a
+    planned downsize instead of lost work.  Rate-limited so fast step
+    loops don't pay a KV round-trip per report."""
+    now_mono = time.monotonic()
+    if ctx._drain_acked or \
+            now_mono - ctx._last_drain_check_mono < 0.25:
+        return
+    ctx._last_drain_check_mono = now_mono
+    from .._private.api import _control
+    raw = _control("kv_get", drain_key(ctx.run_id))
+    if raw is None:
+        return
+    try:
+        req = pickle.loads(raw)
+    except Exception:
+        return
+    if req.get("generation") != ctx._generation:
+        return  # stale request from a torn-down incarnation
+    ctx._drain_acked = True
+    budget_s = max(1.0, float(req.get("budget_s", 30.0)))
+    err = None
+    try:
+        if ctx._ckpt_client is not None:
+            ctx._ckpt_client.flush(timeout=budget_s)
+    except Exception as e:  # noqa: BLE001 — reported in the ack
+        err = f"{type(e).__name__}: {e}"
+    _control("kv_put",
+             drain_ack_key(ctx.run_id, ctx._generation,
+                           ctx.get_world_rank()),
+             pickle.dumps({"rank": ctx.get_world_rank(),
+                           "incarnation": ctx._incarnation,
+                           "flushed": ctx._ckpt_client is not None,
+                           "error": err}))
+    # Park until the controller tears this group down: the ack means
+    # "my work is durable — take me down".  Stepping on would only
+    # manufacture an uncommitted tail (work the restart re-executes as
+    # lost) and race fresh saves/pins against the teardown kill.
+    # Bounded: if the drain is cancelled (key gone) or the deadline
+    # passes with this worker still alive, resume training.
+    deadline = time.monotonic() + budget_s + 15.0
+    while time.monotonic() < deadline:
+        if _control("kv_get", drain_key(ctx.run_id)) is None:
+            break
+        time.sleep(0.2)
 
 
 def _note_step(ctx: "TrainContext", now: float, now_mono: float,

@@ -15,11 +15,40 @@ import pickle
 import socket
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from .._private import serialization
 from ._checkpoint import Checkpoint, CheckpointManager
+from ._context import drain_ack_prefix, drain_key
+
+
+class CrashLoopError(RuntimeError):
+    """The same error signature recurred immediately N times: restarting
+    will not fix a deterministic crash.  Raised (as ``Result.error``) by
+    the crash-loop circuit breaker with the diagnosis bundle path."""
+
+    def __init__(self, signature: str, count: int,
+                 last_error: Optional[BaseException] = None,
+                 bundle_path: Optional[str] = None):
+        super().__init__(
+            f"crash loop: {count} consecutive restarts died with the "
+            f"same signature [{signature}]"
+            + (f"; diagnosis bundle: {bundle_path}" if bundle_path
+               else ""))
+        self.signature = signature
+        self.count = count
+        self.last_error = last_error
+        self.bundle_path = bundle_path
+
+
+def _error_signature(exc: BaseException) -> str:
+    """Stable identity of a failure for crash-loop detection: type plus
+    the first line of the message (line numbers / object ids in later
+    lines would make every recurrence look 'different')."""
+    first = str(exc).splitlines()[0] if str(exc) else ""
+    return f"{type(exc).__name__}: {first[:200]}"
 
 
 def _free_port() -> int:
@@ -144,6 +173,16 @@ class TrainController:
         from .watchdog import TrainWatchdog
         self.watchdog = TrainWatchdog(
             self.run_id, getattr(run_config, "watchdog", None))
+        # Drain protocol / restart-hardening state.
+        self._last_drain_poll_mono = 0.0
+        # Monotonic stamp of the newest durable checkpoint (manifest
+        # commit or legacy dir registration): the failure path books
+        # "lost" from here, not from group start.
+        self._last_ckpt_mono = 0.0
+        self.num_drains = 0
+        self._failure_times: "deque[float]" = deque()
+        self._last_error_sig: Optional[str] = None
+        self._crash_streak = 0
 
     # -- worker group -------------------------------------------------------
 
@@ -243,6 +282,7 @@ class TrainController:
                 if payload.get("checkpoint_dir"):
                     self.manager.register(payload["checkpoint_dir"],
                                           payload["metrics"])
+                    self._last_ckpt_mono = time.monotonic()
             # Consumed: GC the key (RT303) — report keys are write-once
             # per (rank, incarnation, seq); without the delete every run
             # grows the head KV forever.  The payload lives on in
@@ -268,7 +308,8 @@ class TrainController:
             # Consumed: GC the ack key (each is one (step, rank, nonce)
             # write-once record; note_ack holds the payload from here).
             _control("kv_del", key)
-        self.manager.commit_ready()
+        if self.manager.commit_ready():
+            self._last_ckpt_mono = time.monotonic()
 
     def _release_orphan_pins(self) -> None:
         """End-of-run sweep of ``ckpt/pin/<experiment>/*``.
@@ -300,6 +341,267 @@ class TrainController:
         except Exception as e:  # noqa: BLE001 — sweep is best-effort
             telemetry.note_swallowed("train.release_orphan_pins", e)
 
+    # -- drain protocol (graceful preemption) -------------------------------
+
+    def _poll_drain_notices(self, group: "WorkerGroupState"):
+        """Check whether any live rank sits on a DRAINING node.  Returns
+        ``(ranks, budget_s)`` — the covered ranks and the tightest
+        remaining drain budget — or None.  Rate-limited: the node table
+        scan costs a control round-trip per second, not per poll."""
+        now = time.monotonic()
+        if now - self._last_drain_poll_mono < 1.0:
+            return None
+        self._last_drain_poll_mono = now
+        from .._private.api import _control
+        from ..util import telemetry
+        try:
+            nodes = _control("nodes")
+        except Exception as e:  # noqa: BLE001 — retried next poll
+            telemetry.note_swallowed("train.drain_poll", e)
+            return None
+        draining = {n["node_id"]: n for n in nodes
+                    if n.get("alive") and n.get("draining")}
+        if not draining:
+            return None
+        try:
+            actor_nodes = {a["actor_id"]: a.get("node_id")
+                           for a in _control("list_actors")}
+        except Exception as e:  # noqa: BLE001
+            telemetry.note_swallowed("train.drain_poll", e)
+            return None
+        ranks = []
+        covering = set()
+        for rank, w in enumerate(group.workers):
+            node = actor_nodes.get(w._actor_id.hex())
+            if node in draining:
+                ranks.append(rank)
+                covering.add(node)
+        if not ranks:
+            return None
+        budget_s = min(draining[n].get("drain_remaining_s", 0.0)
+                       for n in covering)
+        return ranks, max(0.5, budget_s)
+
+    def _handle_drain(self, group: "WorkerGroupState", world: int,
+                      budget_s: float, generation: int):
+        """Drive the urgent-checkpoint half of a drain: publish the
+        generation-tagged request, wait (bounded by the drain budget,
+        minus a teardown margin) for every rank's flush ack while
+        committing checkpoint acks as they land, then GC the protocol
+        keys.  Returns ``(error, finished)``: a worker error if one died
+        mid-drain (the caller then takes the failure path), and whether
+        every rank's train fn already completed (the run is done — no
+        re-formation needed)."""
+        import ray_tpu
+
+        from .._private.api import _control
+        from ..util import telemetry
+        telemetry.inc("ray_tpu_train_urgent_ckpt_total")
+        # EVERY rank flushes (the commit needs all shards) and so every
+        # rank can stall past the hang deadline — suppress verdicts for
+        # the whole group, not just the draining ranks.
+        self.watchdog.note_drain(range(world), budget_s + 30.0)
+        wait_s = max(0.5, budget_s - 1.0)  # margin for teardown itself
+        _control("kv_put", drain_key(self.run_id),
+                 pickle.dumps({"generation": generation,
+                               "budget_s": wait_s}))
+        ack_prefix = drain_ack_prefix(self.run_id, generation)
+        deadline = time.monotonic() + wait_s
+        error: Optional[Exception] = None
+        finished = False
+        try:
+            while time.monotonic() < deadline:
+                self._poll_reports()  # commits ckpt acks as they land
+                if len(set(_control("kv_keys", ack_prefix))) >= world:
+                    break
+                done_now, _ = ray_tpu.wait(
+                    group.run_refs, num_returns=len(group.run_refs),
+                    timeout=0.25)
+                dead = False
+                for ref in done_now:
+                    try:
+                        ray_tpu.get(ref)
+                    except Exception as e:  # noqa: BLE001
+                        error = e
+                        dead = True
+                if len(done_now) == len(group.run_refs):
+                    finished = not dead
+                    break
+                if dead:
+                    break
+            # Final harvest: the last flush's shard acks may have landed
+            # after the loop's poll.
+            self._poll_reports()
+        finally:
+            # GC the ack keys (write-once per generation; RT303).  The
+            # drain REQUEST key stays until after teardown — acked ranks
+            # park on it ("my work is durable, take me down"), and
+            # deleting it now would un-park them into manufacturing an
+            # uncommitted tail.  _gc_drain_key() runs post-teardown.
+            try:
+                for key in _control("kv_keys", ack_prefix):
+                    _control("kv_del", key)
+            except Exception as e:  # noqa: BLE001 — best-effort GC
+                telemetry.note_swallowed("train.drain_gc", e)
+        return error, finished
+
+    def _gc_drain_key(self) -> None:
+        """Delete the drain request key once the group is gone (parked
+        workers are dead; the next incarnation must not read it), and
+        sweep straggler ack keys across ALL generations — a rank that
+        acked after _handle_drain's deadline sweep would otherwise leak
+        its key in the head KV forever (RT303 invariant)."""
+        from .._private.api import _control
+        from ..util import telemetry
+        try:
+            _control("kv_del", drain_key(self.run_id))
+            for key in _control("kv_keys",
+                                drain_ack_prefix(self.run_id)):
+                _control("kv_del", key)
+        except Exception as e:  # noqa: BLE001 — best-effort GC
+            telemetry.note_swallowed("train.drain_gc", e)
+
+    def _run_incarnation(self, group: "WorkerGroupState",
+                         world: int):
+        """Submit the train fn to a freshly formed group and drive it:
+        poll reports/acks, watch for drain notices and elastic upsizes,
+        and account lost work on failure.  Returns ``(error,
+        resize_to)`` — the caller tears the group down either way."""
+        import ray_tpu
+
+        fn_blob = serialization.dumps_control(self.train_fn)
+        ckpt_cfg = self.run_config.checkpoint_config
+        if getattr(ckpt_cfg, "emergency_replica", False):
+            # Peer RAM copy of the newest shards: spawn (or find)
+            # the experiment's replica holder before workers run.
+            from ..checkpoint import replica as _replica
+            _replica.ensure_holder(self.run_config.name)
+        ctx_info = {
+            "storage_path": self.run_config.storage_path,
+            "experiment_name": self.run_config.name,
+            "latest_checkpoint": self.manager.latest(),
+            "num_slices": self.scaling.num_slices,
+            "checkpoint": {
+                "async_save": getattr(ckpt_cfg, "async_save", True),
+                "max_inflight": getattr(ckpt_cfg, "max_inflight", 2),
+                "emergency_replica": getattr(
+                    ckpt_cfg, "emergency_replica", False),
+                "generation": len(self.world_size_history),
+            },
+        }
+        group.run_refs = [
+            w.run.remote(fn_blob, self.train_loop_config, ctx_info)
+            for w in group.workers]
+        self.goodput.enter("step")
+        t_step = time.monotonic()
+        error = None
+        resize_to: Optional[int] = None
+        last_elastic_check = time.monotonic()
+        pending = list(group.run_refs)
+        while pending:
+            done, pending = ray_tpu.wait(
+                pending, num_returns=1, timeout=0.5)
+            self._poll_reports()
+            for ref in done:
+                # A finished rank legitimately stops reporting — tell
+                # the watchdog before its hang deadline can fire.
+                try:
+                    self.watchdog.note_done(group.run_refs.index(ref))
+                except ValueError:
+                    pass
+                try:
+                    ray_tpu.get(ref)
+                except Exception as e:  # noqa: BLE001
+                    error = e
+                    pending = []
+                    break
+            # Drain notices (preemption/maintenance): a DRAINING
+            # node covering live ranks triggers the graceful path —
+            # urgent checkpoint flush on every rank, then a PLANNED
+            # downsize before the deadline.  The preemption books
+            # ~0 lost work (the resize path restores the
+            # just-committed checkpoint) instead of everything
+            # since the last periodic save, and burns no
+            # max_failures budget.
+            if pending and error is None:
+                notice = self._poll_drain_notices(group)
+                if notice is not None:
+                    drain_ranks, budget_s = notice
+                    error, finished = self._handle_drain(
+                        group, world, budget_s,
+                        len(self.world_size_history))
+                    if error is None and not finished:
+                        self.num_drains += 1
+                        resize_to = max(1, world - len(drain_ranks))
+                    pending = []
+            # Elastic upsize check (reference: elastic.py monitor
+            # decision): new capacity -> teardown + re-form the world
+            # at the larger size, resuming from the latest checkpoint.
+            if pending and error is None and \
+                    time.monotonic() - last_elastic_check >= \
+                    self.scaling.elastic_check_interval_s:
+                last_elastic_check = time.monotonic()
+                d = self.policy.monitor_decision(len(group.workers))
+                if d is not None:
+                    # A crashed worker frees resources that look like
+                    # growth; drain already-failed refs first so a
+                    # crash takes the failure path (and max_failures
+                    # accounting), not the resize path.
+                    done_now, _ = ray_tpu.wait(
+                        pending, num_returns=len(pending), timeout=0)
+                    for ref in done_now:
+                        try:
+                            ray_tpu.get(ref)
+                        except Exception as e:  # noqa: BLE001
+                            error = e
+                            break
+                    if error is None:
+                        resize_to = d.num_workers
+                    pending = []
+        # Drain reports while still in the "step" phase so their
+        # ckpt_seconds reattribution has step time to pull from.
+        self._poll_reports()
+        if error is not None:
+            # Step time SINCE THE LAST COMMITTED CHECKPOINT
+            # produced no surviving work (the restart replays it):
+            # badput, not goodput (MegaScale-style lost-work
+            # accounting).  Work up to that commit survived — it
+            # must not be booked lost.
+            self.goodput.reattribute(
+                "lost", time.monotonic()
+                - max(t_step, self._last_ckpt_mono))
+        return error, resize_to
+
+    def _trip_crash_loop(self, signature: str,
+                         last_error: Exception) -> "CrashLoopError":
+        """Circuit breaker tripped: capture a diagnosis bundle (error
+        signature, failure history, goodput so far) and build the
+        terminal error.  Forensics are best-effort — the breaker itself
+        never fails."""
+        from .._private.api import _control
+        from ..util import telemetry
+        bundle_path = None
+        diagnosis = {
+            "signature": signature,
+            "consecutive": self._crash_streak,
+            "world_size_history": list(self.world_size_history),
+            "run_id": self.run_id,
+            "experiment": self.run_config.name,
+            "goodput": self.goodput.summary(),
+        }
+        try:
+            _control("export_event", "EXPORT_TRAIN_WATCHDOG",
+                     {"kind": "crash_loop", "run_id": self.run_id,
+                      "signature": signature,
+                      "consecutive": self._crash_streak})
+            bundle_path = _control("debug_dump", "crash_loop", False,
+                                   {"crash_loop": diagnosis})
+        except Exception as e:  # noqa: BLE001 — forensics best-effort
+            telemetry.note_swallowed("train.crash_loop_bundle", e)
+        return CrashLoopError(signature, self._crash_streak,
+                              last_error=last_error,
+                              bundle_path=bundle_path)
+
     # -- main loop ----------------------------------------------------------
 
     def run(self):
@@ -311,6 +613,8 @@ class TrainController:
         error: Optional[Exception] = None
         carry_target: Optional[int] = None
         self.world_size_history: List[int] = []
+        self._backoff_s = \
+            self.run_config.failure_config.restart_backoff_initial_s
         self.watchdog.start()
         try:
             while True:
@@ -332,98 +636,80 @@ class TrainController:
                 # generation tag drops straggler acks that race in late).
                 self.manager.reset_pending_acks(
                     generation=len(self.world_size_history))
-                group = self._start_group(world)
-                fn_blob = serialization.dumps_control(self.train_fn)
-                ckpt_cfg = self.run_config.checkpoint_config
-                if getattr(ckpt_cfg, "emergency_replica", False):
-                    # Peer RAM copy of the newest shards: spawn (or find)
-                    # the experiment's replica holder before workers run.
-                    from ..checkpoint import replica as _replica
-                    _replica.ensure_holder(self.run_config.name)
-                ctx_info = {
-                    "storage_path": self.run_config.storage_path,
-                    "experiment_name": self.run_config.name,
-                    "latest_checkpoint": self.manager.latest(),
-                    "num_slices": self.scaling.num_slices,
-                    "checkpoint": {
-                        "async_save": getattr(ckpt_cfg, "async_save", True),
-                        "max_inflight": getattr(ckpt_cfg, "max_inflight", 2),
-                        "emergency_replica": getattr(
-                            ckpt_cfg, "emergency_replica", False),
-                        "generation": len(self.world_size_history),
-                    },
-                }
-                group.run_refs = [
-                    w.run.remote(fn_blob, self.train_loop_config, ctx_info)
-                    for w in group.workers]
-                self.goodput.enter("step")
-                t_step = time.monotonic()
+                t_form = time.monotonic()
                 error = None
                 resize_to: Optional[int] = None
-                last_elastic_check = time.monotonic()
-                pending = list(group.run_refs)
-                while pending:
-                    done, pending = ray_tpu.wait(
-                        pending, num_returns=1, timeout=0.5)
-                    self._poll_reports()
-                    for ref in done:
-                        # A finished rank legitimately stops reporting — tell
-                        # the watchdog before its hang deadline can fire.
-                        try:
-                            self.watchdog.note_done(group.run_refs.index(ref))
-                        except ValueError:
-                            pass
-                        try:
-                            ray_tpu.get(ref)
-                        except Exception as e:  # noqa: BLE001
-                            error = e
-                            pending = []
-                            break
-                    # Elastic upsize check (reference: elastic.py monitor
-                    # decision): new capacity -> teardown + re-form the world
-                    # at the larger size, resuming from the latest checkpoint.
-                    if pending and error is None and \
-                            time.monotonic() - last_elastic_check >= \
-                            self.scaling.elastic_check_interval_s:
-                        last_elastic_check = time.monotonic()
-                        d = self.policy.monitor_decision(len(group.workers))
-                        if d is not None:
-                            # A crashed worker frees resources that look like
-                            # growth; drain already-failed refs first so a
-                            # crash takes the failure path (and max_failures
-                            # accounting), not the resize path.
-                            done_now, _ = ray_tpu.wait(
-                                pending, num_returns=len(pending), timeout=0)
-                            for ref in done_now:
-                                try:
-                                    ray_tpu.get(ref)
-                                except Exception as e:  # noqa: BLE001
-                                    error = e
-                                    break
-                            if error is None:
-                                resize_to = d.num_workers
-                            pending = []
-                # Drain reports while still in the "step" phase so their
-                # ckpt_seconds reattribution has step time to pull from.
-                self._poll_reports()
-                if error is not None:
-                    # This incarnation's step time produced no surviving work
-                    # (it restarts from the last checkpoint): badput, not
-                    # goodput (MegaScale-style lost-work accounting).
-                    self.goodput.reattribute(
-                        "lost", time.monotonic() - t_step)
+                group: Optional[WorkerGroupState] = None
+                try:
+                    group = self._start_group(world)
+                except Exception as e:  # noqa: BLE001 — restartable
+                    # Formation failure (capacity vanished between the
+                    # sizing decision and the gang forming — e.g. a node
+                    # died mid-ping): a failure like any other, not a
+                    # crash of fit().  The failure budget + backoff below
+                    # decide whether to try again.
+                    error = e
+                if group is not None:
+                    error, resize_to = self._run_incarnation(group, world)
                 self.goodput.enter("idle")
-                self._teardown_group(group)
+                if group is not None:
+                    self._teardown_group(group)
+                    self._gc_drain_key()
                 if resize_to is not None:
                     carry_target = resize_to
                     continue  # not a failure: re-run at the new size
                 if error is None:
                     break
                 failures += 1
-                if failures > self.run_config.failure_config.max_failures:
+                fc = self.run_config.failure_config
+                now = time.monotonic()
+                incarnation_lifetime = now - t_form
+                # Crash-loop circuit breaker: the same signature dying
+                # immediately, N times in a row, is deterministic — more
+                # restarts only burn quota.  Fail fast with a diagnosis
+                # bundle naming the signature.
+                sig = _error_signature(error)
+                if sig == self._last_error_sig and \
+                        incarnation_lifetime < fc.crash_loop_window_s:
+                    self._crash_streak += 1
+                else:
+                    self._crash_streak = 1
+                self._last_error_sig = sig
+                if fc.crash_loop_threshold and \
+                        self._crash_streak >= fc.crash_loop_threshold:
+                    error = self._trip_crash_loop(sig, error)
+                    break
+                # Failure budget: rolling window when configured (a long
+                # run shouldn't die on its Nth *unrelated* failure),
+                # lifetime counter otherwise.
+                if fc.failure_window_s is not None:
+                    self._failure_times.append(now)
+                    cutoff = now - fc.failure_window_s
+                    while self._failure_times and \
+                            self._failure_times[0] < cutoff:
+                        self._failure_times.popleft()
+                    over_budget = len(self._failure_times) > fc.max_failures
+                else:
+                    over_budget = failures > fc.max_failures
+                if over_budget:
                     break
                 from ..util import telemetry
                 telemetry.inc("ray_tpu_train_worker_restarts_total", world)
+                # Bounded exponential backoff between re-formations: a
+                # flapping cluster (or a slow-to-release resource pool)
+                # shouldn't be hammered with group formation attempts.
+                # An incarnation that proved stable resets the ladder.
+                if fc.restart_backoff_initial_s > 0:
+                    if incarnation_lifetime >= fc.restart_backoff_reset_s:
+                        self._backoff_s = fc.restart_backoff_initial_s
+                    delay = min(self._backoff_s, fc.restart_backoff_max_s)
+                    self._backoff_s = min(
+                        self._backoff_s * fc.restart_backoff_factor,
+                        fc.restart_backoff_max_s)
+                    telemetry.observe(
+                        "ray_tpu_train_restart_backoff_seconds", delay)
+                    self.goodput.enter("restart")
+                    time.sleep(delay)
                 # Restart: fresh group resumes from the latest committed
                 # checkpoint (reference: controller failure policy ->
                 # group teardown -> re-create -> resume, SURVEY §3.4 step 6).
@@ -451,5 +737,6 @@ class TrainController:
             error=error,
             all_reports=self._reports,
             num_failures=failures,
+            num_drains=self.num_drains,
             world_size_history=self.world_size_history,
             goodput=self.goodput.summary())

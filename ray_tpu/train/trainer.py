@@ -22,8 +22,35 @@ from .watchdog import WatchdogConfig
 
 @dataclass
 class FailureConfig:
-    """reference: train/v2/_internal/execution/failure_handling."""
+    """reference: train/v2/_internal/execution/failure_handling.
+
+    ``max_failures`` is a lifetime budget by default; setting
+    ``failure_window_s`` turns it into a rolling-window budget (a 3-day
+    run shouldn't die on its 4th *unrelated* failure — only a burst of
+    failures inside one window should end the run).  Restarts back off
+    exponentially (bounded) so a flapping cluster isn't hammered with
+    group re-formations, and an optional crash-loop circuit breaker
+    fails fast — with a diagnosis bundle — when the same error signature
+    recurs immediately ``crash_loop_threshold`` times in a row (no
+    amount of restarting fixes a deterministic crash)."""
     max_failures: int = 0
+    # Count failures against max_failures only inside this trailing
+    # window (seconds).  None = lifetime counter (legacy behavior).
+    failure_window_s: Optional[float] = None
+    # Bounded exponential backoff between group re-formations after a
+    # failure: initial * factor^n, capped at max.  0 disables.  The
+    # backoff resets once an incarnation survives reset_s (a stable run
+    # that hits a rare fault restarts promptly again).
+    restart_backoff_initial_s: float = 1.0
+    restart_backoff_max_s: float = 30.0
+    restart_backoff_factor: float = 2.0
+    restart_backoff_reset_s: float = 60.0
+    # Crash-loop circuit breaker: when the same error signature recurs
+    # this many times consecutively — each incarnation dying within
+    # crash_loop_window_s of forming — stop restarting and raise
+    # CrashLoopError with a diagnosis bundle.  0 disables.
+    crash_loop_threshold: int = 0
+    crash_loop_window_s: float = 60.0
 
 
 @dataclass
@@ -95,6 +122,10 @@ class Result:
     error: Optional[Exception] = None
     all_reports: List[Dict[str, Any]] = field(default_factory=list)
     num_failures: int = 0
+    # Drain notices handled gracefully (urgent checkpoint + planned
+    # downsize instead of a crash) — preemptions that did NOT count as
+    # failures.
+    num_drains: int = 0
     # World size of each group incarnation (len > 1 = elastic resizes /
     # failure restarts happened).
     world_size_history: List[int] = field(default_factory=list)

@@ -58,9 +58,15 @@ class WatchdogConfig:
 
 class _RankState:
     __slots__ = ("last_stamp", "last_mono", "intervals", "pid",
-                 "hung", "straggling", "done", "incarnation")
+                 "hung", "straggling", "done", "incarnation",
+                 "drain_until_mono")
 
     def __init__(self):
+        # Verdict suppression window: while a rank's node drains (planned
+        # preemption), silence and slow steps are EXPECTED — the urgent
+        # checkpoint flush stalls the step loop by design, and a "hang"
+        # verdict (plus its auto-captured bundle) would cry wolf.
+        self.drain_until_mono: float = 0.0
         # Worker-side stamp for interval math: the worker's monotonic
         # clock when available (same-process deltas are NTP-immune),
         # its wall clock as a fallback for old payloads.
@@ -173,6 +179,17 @@ class TrainWatchdog:
             if st is not None:
                 st.done = True
 
+    def note_drain(self, ranks, window_s: float) -> None:
+        """Ranks sit on a draining node: suppress hang/straggler verdicts
+        for them during the drain window.  A planned drain stalls the
+        step loop (urgent checkpoint flush, teardown wait) — that must
+        not trip a "hang" verdict or auto-capture a bundle."""
+        until = time.monotonic() + max(0.0, window_s)
+        with self._lock:
+            for rank in ranks:
+                st = self._ranks.setdefault(rank, _RankState())
+                st.drain_until_mono = max(st.drain_until_mono, until)
+
     # -- detection ---------------------------------------------------------
 
     def _median_interval_locked(self,
@@ -193,6 +210,7 @@ class TrainWatchdog:
         with self._lock:
             st = self._ranks.get(rank)
             if st is None or st.done or \
+                    time.monotonic() < st.drain_until_mono or \
                     len(st.intervals) < max(1, cfg.min_samples):
                 return
             median = self._median_interval_locked(exclude_rank=rank)
@@ -219,7 +237,8 @@ class TrainWatchdog:
             tripped = []
             with self._lock:
                 for rank, st in self._ranks.items():
-                    if st.done or st.hung or st.last_mono is None:
+                    if st.done or st.hung or st.last_mono is None or \
+                            now < st.drain_until_mono:
                         continue
                     silent = now - st.last_mono
                     if silent > cfg.hang_deadline_s:

@@ -162,6 +162,17 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "type": "counter", "tag_keys": (),
         "description": "Train workers torn down and restarted after a "
                        "failure."},
+    "ray_tpu_train_urgent_ckpt_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Urgent checkpoint flushes triggered by a drain "
+                       "notice (async writer drained + emergency "
+                       "replicas pushed before the node dies)."},
+    "ray_tpu_train_restart_backoff_seconds": {
+        "type": "histogram", "tag_keys": (),
+        "boundaries": _STEP_BUCKETS,
+        "description": "Backoff slept between group re-formations after "
+                       "a failure (bounded exponential; resets once an "
+                       "incarnation proves stable)."},
     "ray_tpu_train_goodput_ratio": {
         "type": "gauge", "tag_keys": (),
         "description": "Productive-step wall time over total run wall "
@@ -208,6 +219,22 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "type": "counter", "tag_keys": (),
         "description": "Restores that used in-memory emergency replica "
                        "shards instead of (or ahead of) cold storage."},
+    # -- node (drain / preemption lifecycle) -------------------------------
+    "ray_tpu_node_preempted_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Nodes the cloud took away while they were "
+                       "RUNNING/JOINED (spot reclaim, maintenance) — "
+                       "every preemption is counted, graceful or not."},
+    "ray_tpu_node_drain_seconds": {
+        "type": "histogram", "tag_keys": (),
+        "boundaries": _STEP_BUCKETS,
+        "description": "Drain-notice-to-node-death duration: how much of "
+                       "the advertised deadline the cluster actually got "
+                       "to evacuate work."},
+    "ray_tpu_node_draining": {
+        "type": "gauge", "tag_keys": (),
+        "description": "Nodes currently draining (unschedulable for new "
+                       "leases, waiting for work to evacuate)."},
     # -- internal ----------------------------------------------------------
     "ray_tpu_internal_swallowed_errors_total": {
         "type": "counter", "tag_keys": ("where",),

@@ -21,7 +21,7 @@ from ray_tpu.util import metrics as metrics_mod
 from ray_tpu.util import telemetry
 
 _NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
-SUBSYSTEMS = ("serve", "llm", "train", "ckpt", "data", "internal")
+SUBSYSTEMS = ("serve", "llm", "train", "ckpt", "data", "node", "internal")
 
 
 class TestCatalog:
@@ -91,6 +91,29 @@ class TestCatalog:
             assert telemetry.CATALOG[name]["description"].strip(), name
         assert telemetry.CATALOG["ray_tpu_ckpt_restore_seconds"][
             "tag_keys"] == ("source",)
+
+    def test_preemption_series_registered(self):
+        """The preemption/drain robustness series (node lifecycle +
+        train urgent-checkpoint/backoff) are declared in the catalog —
+        RT204 lints every call site against it."""
+        specs = {
+            "ray_tpu_node_preempted_total": ("counter", ()),
+            "ray_tpu_node_drain_seconds": ("histogram", ()),
+            "ray_tpu_node_draining": ("gauge", ()),
+            "ray_tpu_train_urgent_ckpt_total": ("counter", ()),
+            "ray_tpu_train_restart_backoff_seconds": ("histogram", ()),
+        }
+        for name, (typ, tags) in specs.items():
+            assert name in telemetry.CATALOG, name
+            assert telemetry.CATALOG[name]["type"] == typ, name
+            assert tuple(telemetry.CATALOG[name]["tag_keys"]) == tags
+            assert telemetry.CATALOG[name]["description"].strip(), name
+        # Exception-safe helpers record them without raising.
+        telemetry.inc("ray_tpu_node_preempted_total", 0.0)
+        telemetry.observe("ray_tpu_node_drain_seconds", 0.0)
+        telemetry.set_gauge("ray_tpu_node_draining", 0.0)
+        telemetry.inc("ray_tpu_train_urgent_ckpt_total", 0.0)
+        telemetry.observe("ray_tpu_train_restart_backoff_seconds", 0.0)
 
     def test_disagg_admission_series_registered(self):
         """The disaggregated-serving / admission-control series (PR 6)
@@ -205,6 +228,12 @@ class TestSmokeAllSubsystems:
                               parallelism=4)
         rows = ds.map(lambda r: {"x": r["x"] * 2}).take_all()
         assert len(rows) == 64
+
+        # -- node: a drain/undrain round-trip (preemption signal plane) --
+        from ray_tpu._private.api import _control
+        node_hex = _control("nodes")[0]["node_id"]
+        assert _control("drain_node", node_hex, 30.0, "smoke") is True
+        assert _control("undrain_node", node_hex) is True
 
         # -- internal: one accounted swallowed error ----------------------
         telemetry.note_swallowed("test.smoke", RuntimeError("boom"))
