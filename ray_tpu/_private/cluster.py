@@ -270,6 +270,28 @@ class UpStackExpect:
     worker_ids: List[WorkerID]
 
 
+@dataclass
+class ProfileAll:
+    """head -> node server: forward a ProfileRequest to every live
+    worker on the node (cluster half of ``ctl_profile``)."""
+    req: Any  # protocol.ProfileRequest
+
+
+@dataclass
+class UpProfileReply:
+    """node server -> head: one worker's ProfileReply."""
+    msg: Any  # protocol.ProfileReply
+
+
+@dataclass
+class UpProfileExpect:
+    """node server -> head: the worker set a ProfileAll fanned out to
+    (mirror of UpStackExpect — a remote worker that never replies is
+    reported as unresponsive, not silently missing)."""
+    profile_id: int
+    worker_ids: List[WorkerID]
+
+
 # --------------------------------------------------------------------------
 # descriptor location tagging
 # --------------------------------------------------------------------------
@@ -620,6 +642,13 @@ class RemoteNodeProxy:
         expected-reply set is empty — the collector waits out its timeout
         instead (see Runtime.ctl_stack_dump)."""
         self.send(StackDumpAll(dump_id))
+        return []
+
+    def broadcast_profile(self, req) -> list:
+        """Forward a profile capture to the remote node; records flow
+        back as UpProfileReply, the expected worker set as
+        UpProfileExpect (same contract as broadcast_stack_dump)."""
+        self.send(ProfileAll(req))
         return []
 
     def kill_actor_worker(self, worker_id: WorkerID,
@@ -1048,6 +1077,10 @@ class HeadServer:
             rt.on_stack_reply(msg.msg, nid)
         elif isinstance(msg, UpStackExpect):
             rt.on_stack_expect(msg.dump_id, msg.worker_ids)
+        elif isinstance(msg, UpProfileReply):
+            rt.on_profile_reply(msg.msg, nid)
+        elif isinstance(msg, UpProfileExpect):
+            rt.on_profile_expect(msg.profile_id, msg.worker_ids)
         elif isinstance(msg, GetRequest):
             rt.on_get_request(proxy, msg)
         elif isinstance(msg, WaitRequest):
@@ -1187,6 +1220,10 @@ class _NodeServerRuntime:
     def on_stack_reply(self, msg, node_id=None) -> None:
         # A worker's stack snapshot: route it up to the head's collector.
         self._server.send_up(UpStackReply(msg))
+
+    def on_profile_reply(self, msg, node_id=None) -> None:
+        # A worker's profile capture: route it up to the head's collector.
+        self._server.send_up(UpProfileReply(msg))
 
     def mark_escaped(self, oid) -> None:
         # Borrow escalation from a worker on this node: the owner (head)
@@ -1504,6 +1541,9 @@ class NodeServer:
         elif isinstance(msg, StackDumpAll):
             ids = self.node.broadcast_stack_dump(msg.dump_id)
             self.send_up(UpStackExpect(msg.dump_id, ids))
+        elif isinstance(msg, ProfileAll):
+            ids = self.node.broadcast_profile(msg.req)
+            self.send_up(UpProfileExpect(msg.req.profile_id, ids))
         elif isinstance(msg, KillActorWorker):
             self.node.kill_actor_worker(msg.worker_id, msg.force)
         elif isinstance(msg, Ping):

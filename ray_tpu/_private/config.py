@@ -204,6 +204,11 @@ D("debug_bundle_on_worker_death", bool, True,
 D("debug_bundle_min_interval_s", float, 60.0,
   "Minimum seconds between automatic worker-death debug bundles, so a "
   "crash loop cannot fill the disk with forensics.")
+D("debug_bundle_profile_s", float, 0.0,
+  "Attach an on-demand cluster profile of this duration to every "
+  "flight-recorder bundle (profile_trace.json); 0 disables.  The train "
+  "watchdog's bundle_profile_s knob overrides this for its own trip "
+  "bundles.")
 
 # --- Syncer ----------------------------------------------------------------
 D("syncer_period_s", float, 1.0,

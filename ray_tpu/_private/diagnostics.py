@@ -106,10 +106,18 @@ def write_debug_bundle(rt, reason: str,
                        stacks: Optional[Dict[str, Any]] = None,
                        capture_stacks: bool = True,
                        stack_timeout_s: float = 2.0,
-                       extra: Optional[Dict[str, Any]] = None) -> str:
+                       extra: Optional[Dict[str, Any]] = None,
+                       profile_s: Optional[float] = None) -> str:
     """Write a postmortem bundle for the given driver Runtime; returns the
     bundle directory path.  Every section is best-effort: a broken
-    subsystem must never stop the remaining forensics from landing."""
+    subsystem must never stop the remaining forensics from landing.
+
+    ``profile_s`` > 0 attaches an on-demand cluster profile
+    (``profile_trace.json`` — the same merged Chrome trace ``ray-tpu
+    profile`` produces) so a watchdog-trip bundle carries WHERE the time
+    was going, not just where the threads were stuck.  None defers to
+    the ``debug_bundle_profile_s`` config (default off: a profile holds
+    the bundle open for its whole capture window)."""
     ts = time.strftime("%Y%m%d-%H%M%S")
     frac = int((time.time() % 1) * 1e6)
     path = os.path.join(rt.session_dir, "debug",
@@ -167,6 +175,18 @@ def write_debug_bundle(rt, reason: str,
             return None
         return json.dumps(rep, indent=1, default=str)
     section("lock_findings.json", _locks)
+
+    def _profile():
+        # On-demand cluster profile for the incident window (opt-in:
+        # the capture blocks for its duration).
+        from .config import Config
+        dur = Config.get("debug_bundle_profile_s") \
+            if profile_s is None else profile_s
+        if not dur or dur <= 0:
+            return None
+        out = rt.ctl_profile(duration_s=dur, save=False)
+        return json.dumps(out["trace"], default=str)
+    section("profile_trace.json", _profile)
 
     def _leaks():
         # Leak-sanitizer registries (RAY_TPU_SANITIZE=1): the live

@@ -38,8 +38,9 @@ from .ids import ActorID, NodeID, TaskID, WorkerID
 from .object_store import NativeArenaStore, create_store
 from .protocol import (ActorStateMsg, AllocReply, AllocRequest,
                        BorrowRetained, ContainedRefs, GetRequest,
-                       KillWorker, PutFromWorker, ReadDone, RpcCall,
-                       RunTask, SealObject, StackDumpReply, StackDumpRequest,
+                       KillWorker, ProfileReply, ProfileRequest,
+                       PutFromWorker, ReadDone, RpcCall, RunTask,
+                       SealObject, StackDumpReply, StackDumpRequest,
                        SubmitFromWorker, TaskDone, TaskSpec, WaitRequest,
                        WorkerReady)
 from .resources import ResourceSet, TPU
@@ -947,6 +948,19 @@ class NodeManager:
             self._send(h, StackDumpRequest(dump_id))
         return [h.worker_id for h in handles]
 
+    def broadcast_profile(self, req: ProfileRequest) -> List[WorkerID]:
+        """Ship a ProfileRequest to every registered live worker (same
+        ready-gating as broadcast_stack_dump: a worker still booting
+        would just hold the capture open past its window); returns the
+        worker ids a reply is expected from."""
+        with self._lock:
+            handles = [h for h in self._workers.values()
+                       if h.state != DEAD and h.ready.is_set()
+                       and h.conn is not None]
+        for h in handles:
+            self._send(h, req)
+        return [h.worker_id for h in handles]
+
     # -- receive ------------------------------------------------------------
 
     def _handle_msg(self, handle: WorkerHandle, msg) -> None:
@@ -1042,6 +1056,8 @@ class NodeManager:
             rt.note_contained(msg.outer, msg.inner)
         elif isinstance(msg, StackDumpReply):
             rt.on_stack_reply(msg, self.info.node_id)
+        elif isinstance(msg, ProfileReply):
+            rt.on_profile_reply(msg, self.info.node_id)
         elif isinstance(msg, RpcCall):
             rt.on_rpc_call(self, msg)
 

@@ -235,6 +235,33 @@ class StackDumpReply:
 
 
 @dataclass
+class ProfileRequest:
+    """node -> worker: profile this process for ``duration_s`` (host
+    thread sampling at ``hz``; optionally a jax.profiler window) and
+    reply with the capture record.  Received on the worker's RECEIVE
+    thread — like stack capture — but the blocking capture itself runs
+    on a spawned thread so replies/tasks keep flowing meanwhile.
+    ``driver_wall_s`` is the driver's clock at send time: the worker
+    reports its clock offset against it so the driver can merge every
+    process's events onto one clock."""
+    profile_id: int
+    duration_s: float
+    hz: float = 67.0
+    jax_profile: bool = False
+    driver_wall_s: float = 0.0
+
+
+@dataclass
+class ProfileReply:
+    """worker -> node: one process's capture record (see
+    profiler/capture.py for the shape; ``record["error"]`` set when the
+    capture could not run, e.g. one was already in flight)."""
+    profile_id: int
+    worker_id: WorkerID
+    record: Dict
+
+
+@dataclass
 class RpcCall:
     """worker -> node: generic control-plane call (KV, actor lookup, ...)."""
     request_id: int

@@ -391,6 +391,11 @@ class Runtime:
         self._stack_lock = threading.Lock()
         self._stack_dump_seq = 0
         self._stack_dumps: Dict[int, Dict[str, Any]] = {}
+        # profile_id -> same collection-entry shape as _stack_dumps
+        # (cluster profiler shares the stack-capture fan-out/settle
+        # machinery; see ctl_profile).
+        self._profile_seq = 0
+        self._profiles: Dict[int, Dict[str, Any]] = {}
         # Rate limiter for the worker-death flight recorder.
         # None = no bundle written yet (0.0 would suppress the first
         # bundle on a freshly booted host: monotonic ~= uptime).
@@ -2143,7 +2148,7 @@ class Runtime:
     # stack_dump/debug_dump wait for StackDumpReplies that arrive ON the
     # poller thread — running them there would deadlock the collection.
     _BLOCKING_CTL = frozenset({"kv_wait", "pubsub_poll", "stack_dump",
-                               "debug_dump"})
+                               "debug_dump", "profile"})
 
     def on_rpc_call(self, node, msg: RpcCall) -> None:
         def run():
@@ -2485,11 +2490,25 @@ class Runtime:
                         entry["expects_pending"] -= 1
         with self._stack_lock:
             entry["want"].update(w.hex() for w in expected)
+        self._settle_collect(entry, timeout_s)
+        with self._stack_lock:
+            self._stack_dumps.pop(dump_id, None)
+            replies = dict(entry["replies"])
+            want = set(entry["want"])
+        driver = capture_process_stacks("driver", is_driver=True)
+        driver["node_id"] = self.node_id.hex()
+        stacks = [driver] + [replies[k] for k in sorted(replies)]
+        return {"time": time.time(), "stacks": stacks,
+                "unresponsive": sorted(want - set(replies))}
+
+    def _settle_collect(self, entry: Dict[str, Any], timeout_s: float,
+                        settle_s: float = 0.5) -> None:
+        """Wait for a broadcast collection (stack dump / profile) to
+        complete: every wanted reply present AND every remote node's
+        expect set landed — or replies stopped arriving for
+        ``settle_s`` (a node server that dies before answering with its
+        expect set must not hold the collection to the full timeout)."""
         deadline = time.monotonic() + max(0.0, timeout_s)
-        # A node server that dies before answering with its expect set
-        # would otherwise hold the collection to the full timeout; the
-        # settle window closes it shortly after replies stop arriving.
-        settle_s = 0.5
         last_change = time.monotonic()
         prev_progress = -1
         while time.monotonic() < deadline:
@@ -2508,24 +2527,130 @@ class Runtime:
             entry["event"].clear()
             entry["event"].wait(min(0.05, max(
                 0.0, deadline - time.monotonic())))
+
+    # -- cluster profiler (see ray_tpu/profiler/) ------------------------ #
+
+    def on_profile_reply(self, msg, node_id: Optional[NodeID] = None
+                         ) -> None:
+        """A worker's ProfileReply landed (local node or a remote's
+        UpProfileReply): file it under its profile id."""
         with self._stack_lock:
-            self._stack_dumps.pop(dump_id, None)
+            entry = self._profiles.get(msg.profile_id)
+            if entry is None:
+                return  # collector already timed out and left
+            record = dict(msg.record)
+            record["node_id"] = node_id.hex() if node_id is not None \
+                else None
+            entry["replies"][msg.worker_id.hex()] = record
+            evt = entry["event"]
+        evt.set()
+
+    def on_profile_expect(self, profile_id: int, worker_ids: List) -> None:
+        """A remote node answered ProfileAll with its worker set (see
+        on_stack_expect — wedged remote workers must surface as
+        unresponsive)."""
+        with self._stack_lock:
+            entry = self._profiles.get(profile_id)
+            if entry is None:
+                return
+            entry["want"].update(w.hex() for w in worker_ids)
+            entry["expects_pending"] -= 1
+            evt = entry["event"]
+        evt.set()
+
+    def ctl_profile(self, duration_s: float = 2.0, hz: float = 67.0,
+                    jax_profile: bool = False,
+                    timeout_s: Optional[float] = None,
+                    save: bool = True) -> Dict[str, Any]:
+        """Cluster-wide on-demand profile: every live worker (plus the
+        driver) samples its threads for ``duration_s``; the records are
+        merged into ONE clock-aligned Chrome-trace JSON written under
+        ``<session>/profiles/`` and returned inline.
+
+        Blocking for duration + collection timeout: listed in
+        _BLOCKING_CTL so a worker-originated call never runs on the
+        node poller thread that must route the replies."""
+        from ray_tpu.profiler.capture import capture_profile
+        from ray_tpu.profiler.merge import (merge_records, write_jax_artifacts,
+                                            write_trace)
+        from .protocol import ProfileRequest
+        if timeout_s is None:
+            timeout_s = Config.get("stack_dump_timeout_s")
+        duration_s = max(0.1, float(duration_s))
+        nodes = list(self.nodes.values())
+        remote_nodes = [n for n in nodes if getattr(n, "is_remote", False)]
+        t0_wall = time.time()
+        with self._stack_lock:
+            self._profile_seq += 1
+            profile_id = self._profile_seq
+            entry: Dict[str, Any] = {"replies": {}, "want": set(),
+                                     "expects_pending": len(remote_nodes),
+                                     "event": threading.Event()}
+            self._profiles[profile_id] = entry
+        req = ProfileRequest(profile_id, duration_s, hz=hz,
+                             jax_profile=jax_profile,
+                             driver_wall_s=t0_wall)
+        expected: List[WorkerID] = []
+        for node in nodes:
+            try:
+                ids = node.broadcast_profile(req)
+                if not getattr(node, "is_remote", False):
+                    expected.extend(ids)
+            except Exception:  # noqa: BLE001 — a dead node can't stop it
+                with self._stack_lock:
+                    if getattr(node, "is_remote", False):
+                        entry["expects_pending"] -= 1
+        with self._stack_lock:
+            entry["want"].update(w.hex() for w in expected)
+        # The driver samples itself on THIS thread (ctl_profile is
+        # blocking-listed) while the workers capture in parallel.
+        driver_record = capture_profile(
+            "driver", duration_s, hz=hz, jax_profile=jax_profile,
+            driver_wall_s=t0_wall, is_driver=True)
+        self._settle_collect(entry, timeout_s)
+        with self._stack_lock:
+            self._profiles.pop(profile_id, None)
             replies = dict(entry["replies"])
             want = set(entry["want"])
-        driver = capture_process_stacks("driver", is_driver=True)
-        driver["node_id"] = self.node_id.hex()
-        stacks = [driver] + [replies[k] for k in sorted(replies)]
-        return {"time": time.time(), "stacks": stacks,
-                "unresponsive": sorted(want - set(replies))}
+        t1_wall = time.time()
+        records = [driver_record] + [replies[k] for k in sorted(replies)]
+        doc = merge_records(
+            records,
+            timeline_events=self.events.chrome_trace(),
+            # Wall clock on purpose: the window selects timeline events
+            # by their wall-anchored positions, not a duration.
+            window=(t0_wall - 1.0, t1_wall + 1.0),  # ray-tpu: noqa[RT203]
+            meta={"profile_id": profile_id, "duration_s": duration_s,
+                  "hz": hz, "driver_t0_wall_s": t0_wall,
+                  "unresponsive": sorted(want - set(replies))})
+        path = None
+        if save:
+            pdir = os.path.join(self.session_dir, "profiles",
+                                f"{time.strftime('%Y%m%d-%H%M%S')}-"
+                                f"{profile_id:04d}")
+            path = write_trace(os.path.join(pdir, "trace.json"), doc)
+            write_jax_artifacts(pdir, records)
+        telemetry.inc("ray_tpu_profiler_captures_total")
+        return {
+            "path": path,
+            "trace": doc,
+            "num_events": len(doc["traceEvents"]),
+            "workers": sorted(replies),
+            "unresponsive": sorted(want - set(replies)),
+        }
 
     def ctl_debug_dump(self, reason: str = "manual",
                        capture_stacks: bool = True,
-                       extra: Optional[Dict[str, Any]] = None) -> str:
+                       extra: Optional[Dict[str, Any]] = None,
+                       profile_s: Optional[float] = None) -> str:
         """Write a postmortem bundle under <session>/debug/; returns its
-        path (flight recorder, `ray-tpu debug dump`)."""
+        path (flight recorder, `ray-tpu debug dump`).  ``profile_s`` > 0
+        attaches an on-demand cluster profile of that duration (None =
+        the debug_bundle_profile_s config default)."""
         from .diagnostics import write_debug_bundle
         return write_debug_bundle(self, reason,
-                                  capture_stacks=capture_stacks, extra=extra)
+                                  capture_stacks=capture_stacks,
+                                  extra=extra, profile_s=profile_s)
 
     def ctl_export_event(self, source_type: str, event: Dict[str, Any]):
         """Append a structured record to <session>/logs/events.jsonl on

@@ -26,10 +26,10 @@ from .ids import ActorID, ObjectID, TaskID, WorkerID
 from .object_store import ArenaReader, RemoteObjectReader
 from .protocol import (ActorStateMsg, AllocReply, AllocRequest,
                        BorrowRetained, GetReply, GetRequest, KillWorker,
-                       PutFromWorker, ReadDone, RpcCall, RpcReply, RunTask,
-                       SealObject, StackDumpReply, StackDumpRequest,
-                       SubmitFromWorker, TaskDone, WaitReply, WaitRequest,
-                       WorkerReady)
+                       ProfileReply, ProfileRequest, PutFromWorker,
+                       ReadDone, RpcCall, RpcReply, RunTask, SealObject,
+                       StackDumpReply, StackDumpRequest, SubmitFromWorker,
+                       TaskDone, WaitReply, WaitRequest, WorkerReady)
 
 
 def _materialize(desc, keepalives: List, rt=None) -> Any:
@@ -900,6 +900,26 @@ class WorkerLoop:
                 rt.send(StackDumpReply(msg.dump_id, rt.worker_id, record))
             except Exception:  # noqa: BLE001 — diagnostics must not kill us
                 traceback.print_exc()
+        elif isinstance(msg, ProfileRequest):
+            # Received here (not the executor pool) so a busy worker
+            # still starts the capture; the capture itself blocks for
+            # the whole duration, so it runs on its own thread — the
+            # receive loop must keep routing replies meanwhile.
+            def _capture(req=msg):
+                try:
+                    from ray_tpu.profiler.capture import capture_profile
+                    record = capture_profile(
+                        rt.worker_id.hex(), req.duration_s, hz=req.hz,
+                        jax_profile=req.jax_profile,
+                        driver_wall_s=req.driver_wall_s)
+                except Exception as e:  # noqa: BLE001 — reported upward
+                    record = {"worker_id": rt.worker_id.hex(),
+                              "pid": os.getpid(), "samples": [],
+                              "error": f"{type(e).__name__}: {e}"}
+                rt.send(ProfileReply(req.profile_id, rt.worker_id,
+                                     record))
+            from . import sanitizer
+            sanitizer.spawn(_capture, name="profile-capture")
         elif isinstance(msg, KillWorker):
             return False
         return True

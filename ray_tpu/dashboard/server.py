@@ -14,6 +14,8 @@ Endpoints (reference: dashboard/modules/*):
     GET /api/metrics/summary    — built-in telemetry by subsystem + goodput
     GET /api/stacks             — cluster-wide stack capture (`ray stack`)
     POST /api/debug/dump        — write a flight-recorder bundle
+    POST /api/profile           — on-demand cluster profile (merged
+                                  clock-aligned Chrome trace)
     GET /metrics                — Prometheus exposition (user + built-in)
     GET /-/healthz              — liveness
 """
@@ -185,6 +187,24 @@ class DashboardServer:
                 None, lambda: rt.ctl_debug_dump(reason))
             return self._json({"path": path})
 
+        async def profile(req):
+            # On-demand cluster profile: blocks for the whole capture
+            # window, so off-loop like /api/stacks.  ?include_trace=0
+            # returns only the summary (the merged trace is on disk).
+            import asyncio
+            try:
+                duration = float(req.query.get("duration_s", "2"))
+                hz = float(req.query.get("hz", "67"))
+            except ValueError:
+                return web.Response(status=400,
+                                    text="bad duration_s/hz")
+            jax_profile = req.query.get("jax") == "1"
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: rt.ctl_profile(duration, hz, jax_profile))
+            if req.query.get("include_trace") == "0":
+                out = {k: v for k, v in out.items() if k != "trace"}
+            return self._json(out)
+
         async def healthz(req):
             return web.Response(text="ok")
 
@@ -201,6 +221,7 @@ class DashboardServer:
         app.router.add_get("/api/metrics/summary", metrics_summary)
         app.router.add_get("/api/stacks", stacks)
         app.router.add_post("/api/debug/dump", debug_dump)
+        app.router.add_post("/api/profile", profile)
         app.router.add_get("/api/node_views", node_views)
         app.router.add_get("/api/logs", logs)
         app.router.add_get("/api/logs/{fname}", log_tail)

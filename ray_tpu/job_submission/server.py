@@ -143,6 +143,24 @@ class JobServer:
             path = await call(_control, "debug_dump", reason)
             return web.json_response({"path": path})
 
+        async def cluster_profile(request):
+            """On-demand cluster profile (`ray-tpu profile`): blocks
+            for the capture window in the executor, returns the merged
+            clock-aligned Chrome trace (+ its on-disk path)."""
+            from ray_tpu._private.api import _control
+            try:
+                duration = float(request.query.get("duration_s", "2"))
+                hz = float(request.query.get("hz", "67"))
+            except ValueError:
+                return web.json_response(
+                    {"error": "bad duration_s/hz"}, status=400)
+            jax_profile = request.query.get("jax") == "1"
+            out = await call(_control, "profile", duration, hz,
+                             jax_profile)
+            if request.query.get("include_trace") == "0":
+                out = {k: v for k, v in out.items() if k != "trace"}
+            return web.json_response(out)
+
         async def cluster_drain_node(request):
             """Operator-initiated drain (`ray-tpu drain`): the node
             becomes unschedulable and drain-aware controllers evacuate
@@ -187,6 +205,7 @@ class JobServer:
             app.router.add_get("/api/cluster/stacks", cluster_stacks)
             app.router.add_post("/api/cluster/debug_dump",
                                 cluster_debug_dump)
+            app.router.add_post("/api/cluster/profile", cluster_profile)
             app.router.add_post("/api/cluster/drain_node",
                                 cluster_drain_node)
             app.router.add_get("/metrics", metrics)

@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional
 
 from ..._private import sanitizer
 from ...serve.api import OverloadError
-from ...util import telemetry
+from ...util import telemetry, tracing
 from ..engine import InferenceEngine, SamplingParams
 from .handoff import export_handoff, import_handoff
 from .prefill import PrefillWorker
@@ -140,6 +140,14 @@ class _Pending:
     #: Token budget released exactly once (a caller-timeout _abandon can
     #: race the engine finishing the same request).
     released: bool = False
+    #: W3C trace linkage (util/tracing): the submitter's context and the
+    #: request's own root span context.  Pipeline stages complete on the
+    #: dispatcher/driver threads, so the contexts ride the request
+    #: instead of thread-locals — queue-wait / prefill / KV-transfer /
+    #: decode-admission spans all land in ONE trace tree.
+    trace_parent: Any = None
+    trace_root: Any = None
+    t_submit_wall: float = 0.0
 
 
 class DisaggServer:
@@ -235,6 +243,11 @@ class DisaggServer:
                         total, now, now + rc.queue_deadline_s,
                         abandon_deadline=now
                         + float(body.get("timeout_s", 300)) + 10.0)
+        # Trace linkage: inherit the submitter's context (e.g. the serve
+        # replica's execute span) so the LLM request renders as one tree.
+        item.trace_parent = tracing.current()
+        item.trace_root = tracing.new_child(item.trace_parent)
+        item.t_submit_wall = time.time()
         ev = threading.Event()
         with self._lock:
             self._events[item.pub_id] = ev
@@ -279,6 +292,15 @@ class DisaggServer:
         pub_id = self.submit(body)
         return self.result(pub_id,
                            timeout_s=float(body.get("timeout_s", 300)))
+
+    def _trace_phase(self, item: _Pending, name: str, start_wall: float,
+                     attrs: Optional[Dict[str, Any]] = None) -> None:
+        """Record one pipeline-phase span under the request's root (a
+        no-op when the request carries no trace context)."""
+        if item.trace_root is None:
+            return
+        tracing.record_span(item.trace_root, name, start_wall,
+                            time.time(), attrs or {})
 
     def _release_budget(self, item: Optional[_Pending]) -> None:
         """Return the class token budget exactly once per request (a
@@ -344,6 +366,8 @@ class DisaggServer:
                 self._work.wait(0.02)
                 self._work.clear()
                 continue
+            self._trace_phase(item, "queue_wait", item.t_submit_wall,
+                              {"class": item.clazz})
             if time.perf_counter() > item.deadline:
                 self._finish_shed(item, "deadline")
                 continue
@@ -388,6 +412,7 @@ class DisaggServer:
         """Single-engine modes: hand to the engine once its own waiting
         list has room — until then the request stays the ROUTER's,
         where deadline shedding applies."""
+        t_adm = time.time()
         while not self._stop.is_set():
             if self._gone(item):
                 self.admission.note_dequeued(item.clazz)
@@ -402,14 +427,20 @@ class DisaggServer:
             self._finish_shed(item, "deadline")
             return
         rid = self.engine.add_request(item.prompt, item.params)
+        self._trace_phase(item, "decode_admission", t_adm,
+                          {"engine_rid": rid})
         self._map_or_cancel(item, rid)
 
     def _dispatch_disagg(self, item: _Pending) -> None:
         """Disagg mode: prefill on the prefill tier, hand KV pages to
         the decode engine through the shm object store (zero-copy on
         the same host), retry import under decode backpressure."""
+        t_pf = time.time()
         handoff = self.prefill_worker.prefill(
             item.prompt, item.params, t_submit=item.t_submit)
+        self._trace_phase(item, "prefill", t_pf,
+                          {"prompt_tokens": len(item.prompt)})
+        t_kv = time.time()
         oid = None
         keepalive = None
         if self._store is not None:
@@ -420,6 +451,11 @@ class DisaggServer:
                 handoff, keepalive = import_handoff(desc)
             else:
                 oid = None  # store full: direct in-process handoff
+        self._trace_phase(
+            item, "kv_transfer", t_kv,
+            {"transport": "shm_store" if oid is not None else "inline",
+             "pages": getattr(handoff, "num_pages", None)})
+        t_adm = time.time()
         rid = None
         gone = False
         while not self._stop.is_set():
@@ -445,6 +481,10 @@ class DisaggServer:
         if rid is None:
             self._finish_shed(item, "deadline")
             return
+        # Admission wait INTO the decode batch (import retries under KV
+        # backpressure) — distinct from the transfer itself.
+        self._trace_phase(item, "decode_admission", t_adm,
+                          {"engine_rid": rid})
         self._map_or_cancel(item, rid)
 
     def _finish_shed(self, item: _Pending, reason: str) -> None:
@@ -490,11 +530,21 @@ class DisaggServer:
     def _publish(self, pub_id: int, result: Dict[str, Any]) -> None:
         with self._lock:
             ev = self._events.get(pub_id)
+            item = self._meta.get(pub_id)
             if ev is None:       # abandoned while in flight: drop
                 self._meta.pop(pub_id, None)
                 self._pub_to_rid.pop(pub_id, None)
                 return
             self._results[pub_id] = result
+        if item is not None and item.trace_root is not None:
+            # Close the request's root span (the phases above are its
+            # children) under the submitter's context.
+            tracing.record_span(
+                item.trace_parent, "llm_request", item.t_submit_wall,
+                time.time(),
+                {"mode": self.mode, "class": item.clazz,
+                 "finish_reason": result.get("finish_reason")},
+                ctx=item.trace_root)
         ev.set()
 
     # -- introspection / lifecycle ------------------------------------------

@@ -1,0 +1,49 @@
+"""Cluster-wide performance profiler.
+
+Reference analog: the OpenTelemetry/OpenCensus observability substrate
+the reference ships in its native layer (src/ray/observability/, the
+dashboard's py-spy integration) — here TPU-native: on-demand merged
+captures, always-on step attribution, and recompile detection.
+
+Three pieces:
+
+* **On-demand capture** — :func:`profile` (surfaced as ``ray-tpu
+  profile`` and ``POST /api/profile``): every selected process samples
+  its Python threads (and optionally brackets the window with
+  ``jax.profiler``) for N seconds; the driver merges the records into
+  one clock-aligned Chrome-trace JSON under ``<session>/profiles/``.
+* **Always-on step attribution** — :class:`step_phase` / :func:`fence`
+  (re-exported by ``ray_tpu.train``) decompose every training step into
+  data-wait / h2d / compute / collective / ckpt_block / other, feeding
+  ``ray_tpu_train_step_phase_seconds{phase}`` and the goodput tracker.
+* **Recompile detection** — :func:`track` / :func:`install_recompile_
+  detector`: per-site XLA compile count/seconds telemetry and a
+  once-per-site warning when a warm site recompiles, naming the
+  argument shapes that churned.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .attribution import fence, pop_phases, step_phase
+from .recompile import install as install_recompile_detector
+from .recompile import track, uninstall as uninstall_recompile_detector
+
+
+def profile(duration_s: float = 2.0, hz: float = 67.0,
+            jax_profile: bool = False,
+            timeout_s: Optional[float] = None) -> Dict[str, Any]:
+    """Capture a cluster-wide profile: every live worker plus the driver
+    samples for ``duration_s``; returns ``{"path", "trace", "workers",
+    "unresponsive", "num_events"}`` with the merged Chrome-trace JSON
+    written under ``<session>/profiles/`` (load ``path`` in
+    chrome://tracing or https://ui.perfetto.dev)."""
+    from .._private.api import _control
+    return _control("profile", duration_s, hz, jax_profile, timeout_s)
+
+
+__all__ = [
+    "profile", "step_phase", "fence", "pop_phases", "track",
+    "install_recompile_detector", "uninstall_recompile_detector",
+]

@@ -1,0 +1,155 @@
+"""Merge per-process capture records into one Chrome-trace/Perfetto JSON.
+
+Reference: python/ray/_private/state.py:471 (chrome_tracing_dump) — same
+output dialect (trace-event JSON, ``ph: X`` complete events + ``ph: M``
+metadata), loadable in chrome://tracing, Perfetto and speedscope.
+
+Every record's events are shifted by its ``clock_offset_s`` so the whole
+trace sits on the DRIVER's clock: a slice at t on worker A and a slice
+at t on worker B happened at the same driver-observed instant, which is
+what makes cross-worker straggler analysis readable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+def _slices_for_record(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Fold a record's stack samples into trace slices: consecutive
+    samples of one thread with the same leaf frame coalesce into one
+    ``X`` event named by that leaf (a poor man's flame timeline)."""
+    events: List[Dict[str, Any]] = []
+    offset = rec.get("clock_offset_s") or 0.0
+    period = 1.0 / max(1.0, rec.get("hz") or 67.0)
+    who = "driver" if rec.get("is_driver") \
+        else f"worker:{(rec.get('worker_id') or '?')[:8]}"
+    pid = f"{who} pid={rec.get('pid')}"
+    events.append({"ph": "M", "name": "process_name", "pid": pid,
+                   "tid": 0, "args": {"name": pid}})
+    # thread ident -> (leaf, start_wall, last_wall, stack, name)
+    open_slices: Dict[int, List[Any]] = {}
+
+    def close(tid: int) -> None:
+        leaf, start, last, stack, name = open_slices.pop(tid)
+        events.append({
+            "name": leaf, "cat": "sample", "ph": "X",
+            "ts": (start - offset) * 1e6,
+            "dur": max(period, last - start + period) * 1e6,
+            "pid": pid, "tid": f"{name} ({tid})",
+            "args": {"stack": stack},
+        })
+
+    for sample in rec.get("samples", ()):
+        t = sample["t"]
+        threads = sample.get("threads", {})
+        for tid in list(open_slices):
+            cur = open_slices[tid]
+            new = threads.get(tid)
+            # A gap (thread died / sampler stalled) or a leaf change
+            # closes the slice.
+            if new is None or new["leaf"] != cur[0] \
+                    or t - cur[2] > 4 * period:
+                close(tid)
+        for tid, th in threads.items():
+            if tid in open_slices:
+                open_slices[tid][2] = t
+            else:
+                open_slices[tid] = [th["leaf"], t, t,
+                                    list(th.get("stack", ())),
+                                    th.get("name", f"t{tid}")]
+    for tid in list(open_slices):
+        close(tid)
+    return events
+
+
+def merge_records(records: List[Dict[str, Any]],
+                  timeline_events: Optional[List[Dict[str, Any]]] = None,
+                  window: Optional[tuple] = None,
+                  meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Build the merged Chrome-trace document.
+
+    ``records`` are capture_profile outputs (driver + workers);
+    ``timeline_events`` are the driver's existing chrome_trace events
+    (profile spans, task slices) — filtered to ``window`` (wall seconds,
+    driver clock) so the on-demand capture carries the framework's own
+    span context for the same interval.
+    """
+    events: List[Dict[str, Any]] = []
+    processes: List[Dict[str, Any]] = []
+    for rec in records:
+        if rec.get("error"):
+            processes.append({"worker_id": rec.get("worker_id"),
+                              "pid": rec.get("pid"),
+                              "error": rec["error"]})
+            continue
+        events.extend(_slices_for_record(rec))
+        processes.append({
+            "worker_id": rec.get("worker_id"),
+            "pid": rec.get("pid"),
+            "is_driver": bool(rec.get("is_driver")),
+            "clock_offset_s": rec.get("clock_offset_s"),
+            "num_samples": len(rec.get("samples", ())),
+            "jax_profile": {
+                "attempted": rec.get("jax_profile", {}).get("attempted"),
+                "num_files": len(rec.get("jax_profile", {})
+                                 .get("files", {})),
+                "error": rec.get("jax_profile", {}).get("error"),
+            },
+            "memory": rec.get("memory", []),
+        })
+    if timeline_events:
+        lo = (window[0] * 1e6) if window else None
+        hi = (window[1] * 1e6) if window else None
+        for ev in timeline_events:
+            ts = ev.get("ts")
+            if ts is None:
+                continue
+            if lo is not None and (ts + ev.get("dur", 0.0) < lo
+                                   or ts > hi):
+                continue
+            events.append(ev)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": dict(meta or {}, processes=processes),
+    }
+
+
+def write_trace(path: str, doc: Dict[str, Any]) -> str:
+    """Publish the merged trace atomically (tmp + rename: a reader —
+    the dashboard, a human mid-download — never sees a torn file)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def write_jax_artifacts(profile_dir: str,
+                        records: List[Dict[str, Any]]) -> List[str]:
+    """Land each record's shipped jax.profiler artifact files under
+    ``<profile_dir>/jax/<worker8>/``; returns the written paths."""
+    written: List[str] = []
+    for rec in records:
+        files = (rec.get("jax_profile") or {}).get("files") or {}
+        if not files:
+            continue
+        who = (rec.get("worker_id") or "proc")[:8]
+        for rel, blob in files.items():
+            # The artifact relpaths come from the profiled process's own
+            # tempdir walk, but normalize defensively anyway.
+            rel = os.path.normpath(rel).lstrip(os.sep)
+            if rel.startswith(".."):
+                continue
+            dest = os.path.join(profile_dir, "jax", who, rel)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            tmp = dest + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, dest)
+            written.append(dest)
+    return written

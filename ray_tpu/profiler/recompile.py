@@ -1,0 +1,279 @@
+"""Recompile detector: per-site XLA compile accounting + shape-churn
+warnings.
+
+Shape churn — a batch dimension that wobbles, a dtype that flips — makes
+``jax.jit`` silently recompile, and on TPU a recompile is seconds of
+stalled devices that shows up as nothing but a mysteriously slow step.
+This module hooks ``jax.monitoring``'s compile-duration events and
+attributes them to *tracked call sites*:
+
+* :func:`track` wraps a (usually jitted) callable; every XLA backend
+  compile that fires while the wrapped call runs is charged to the
+  site's telemetry series (``ray_tpu_profiler_compile_total`` /
+  ``_seconds{fn}``).
+* A site is **warm** once a call completes with no compile (the cache
+  hit proves steady state).  A compile AFTER that is a post-warmup
+  recompilation: ``ray_tpu_profiler_recompiles_total`` is bumped and a
+  once-per-site warning names the argument shapes/dtypes that changed —
+  the culprit, not just the symptom.
+* :func:`install` additionally patches ``jax.jit`` so functions jitted
+  after the install are tracked automatically (train workers install
+  this by default; ``RAY_TPU_RECOMPILE_DETECT=0`` opts out).
+
+Everything degrades to a no-op when jax (or its monitoring API) is
+absent — the module never imports jax on its own.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..util import telemetry
+
+logger = logging.getLogger("ray_tpu.profiler")
+
+#: jax.monitoring event that marks one real XLA compilation.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_listener_registered = False
+_enabled = False
+_jit_patched = False
+_orig_jit = None
+
+#: site name -> _SiteState
+_sites: Dict[str, "_SiteState"] = {}
+
+_tls = threading.local()
+
+
+class _SiteState:
+    __slots__ = ("name", "signatures", "compiles", "compile_s", "warm",
+                 "recompiles", "warned", "last_signature")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.signatures: List[str] = []
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.warm = False
+        self.recompiles = 0
+        self.warned = False
+        self.last_signature: Optional[str] = None
+
+
+def _on_event_duration(event: str, duration_s: float, **_kw) -> None:
+    """jax.monitoring listener: charge backend compiles to whichever
+    tracked site is currently executing on this thread."""
+    if not _enabled or event != _COMPILE_EVENT:
+        return
+    frame = getattr(_tls, "site", None)
+    if frame is None:
+        return
+    frame["compiles"] += 1
+    frame["compile_s"] += duration_s
+
+
+def _ensure_listener() -> bool:
+    global _listener_registered
+    if _listener_registered:
+        return True
+    if "jax" not in sys.modules:
+        return False
+    try:
+        import jax
+        register = getattr(jax.monitoring,
+                           "register_event_duration_secs_listener", None)
+        if register is None:
+            return False
+        with _lock:
+            if not _listener_registered:
+                register(_on_event_duration)
+                _listener_registered = True
+    except Exception:  # noqa: BLE001 — detector must never break user code
+        return False
+    return True
+
+
+def _signature(args: tuple, kwargs: dict) -> str:
+    """Compact shape/dtype signature of a call's arguments.  Only
+    computed when a compile actually fired (never on the per-step hot
+    path), so an O(tree) walk here is fine."""
+    def leaf(x: Any) -> str:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return f"{dtype}[{','.join(str(d) for d in shape)}]"
+        if isinstance(x, (bool, int, float, complex, str, bytes,
+                          type(None))):
+            return f"{type(x).__name__}={x!r}"
+        return type(x).__name__
+
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+    except Exception:  # noqa: BLE001
+        leaves = list(args) + list(kwargs.values())
+    parts = [leaf(x) for x in leaves]
+    if len(parts) > 64:
+        parts = parts[:64] + [f"...(+{len(parts) - 64} leaves)"]
+    return "(" + ", ".join(parts) + ")"
+
+
+class TrackedFunction:
+    """Transparent wrapper around a (jitted) callable: forwards every
+    attribute (``.lower``, ``.compile``, ...) to the wrapped function so
+    AOT workflows keep working."""
+
+    def __init__(self, fn, site: str):
+        self.__wrapped__ = fn
+        self._site = _site_state(site)
+
+    def __getattr__(self, name: str):
+        if name == "__wrapped__":
+            # Instance dict not populated yet (unpickle path): avoid
+            # recursing through this very lookup.
+            raise AttributeError(name)
+        return getattr(self.__wrapped__, name)
+
+    def __call__(self, *args, **kwargs):
+        if not _enabled or not _ensure_listener():
+            return self.__wrapped__(*args, **kwargs)
+        frame = {"compiles": 0, "compile_s": 0.0}
+        prev = getattr(_tls, "site", None)
+        _tls.site = frame
+        try:
+            return self.__wrapped__(*args, **kwargs)
+        finally:
+            # Nested tracked calls shadow this frame while they run, so
+            # their compiles are charged to the INNER site only.
+            _tls.site = prev
+            if frame["compiles"]:
+                self._note_compiles(frame, args, kwargs)
+            else:
+                self._site.warm = True
+
+    def _note_compiles(self, frame: Dict[str, float], args, kwargs) -> None:
+        site = self._site
+        tags = {"fn": site.name}
+        telemetry.inc("ray_tpu_profiler_compile_total",
+                      frame["compiles"], tags=tags)
+        telemetry.observe("ray_tpu_profiler_compile_seconds",
+                          frame["compile_s"], tags=tags)
+        sig = _signature(args, kwargs)
+        with _lock:
+            site.compiles += frame["compiles"]
+            site.compile_s += frame["compile_s"]
+            known = sig in site.signatures
+            if not known:
+                site.signatures.append(sig)
+            site.last_signature = sig
+            post_warmup = site.warm and not known
+            if post_warmup:
+                site.recompiles += 1
+                warn_now = not site.warned
+                site.warned = True
+            else:
+                warn_now = False
+            prior = [s for s in site.signatures if s != sig]
+        if post_warmup:
+            telemetry.inc("ray_tpu_profiler_recompiles_total", tags=tags)
+            if warn_now:
+                logger.warning(
+                    "post-warmup recompilation of %r (%.2fs of XLA "
+                    "compile): argument shapes/dtypes changed to %s "
+                    "(previously seen: %s).  Pad or bucket the varying "
+                    "dimension — every distinct shape compiles its own "
+                    "program.  (warned once per site; "
+                    "ray_tpu_profiler_recompiles_total{fn=%r} keeps "
+                    "counting)",
+                    site.name, frame["compile_s"], sig,
+                    "; ".join(prior[-3:]) or "<none recorded>", site.name)
+
+
+def _site_state(name: str) -> _SiteState:
+    with _lock:
+        st = _sites.get(name)
+        if st is None:
+            st = _sites[name] = _SiteState(name)
+        return st
+
+
+def track(fn, name: Optional[str] = None):
+    """Wrap ``fn`` (typically a jitted function) with per-site compile
+    accounting and post-warmup recompile detection."""
+    if isinstance(fn, TrackedFunction):
+        return fn
+    site = name or getattr(fn, "__name__", None) \
+        or type(fn).__name__
+    global _enabled
+    _enabled = True
+    return TrackedFunction(fn, site)
+
+
+def install(patch_jit: bool = True) -> bool:
+    """Enable the detector process-wide.  With ``patch_jit``, functions
+    jitted AFTER this call are tracked automatically (named by the
+    decorated function's ``__name__``).  Safe to call repeatedly."""
+    global _enabled, _jit_patched, _orig_jit
+    _enabled = True
+    if not patch_jit or _jit_patched:
+        return _ensure_listener()
+    if "jax" not in sys.modules:
+        # Deliberately NOT importing jax here; callers install after
+        # their own jax import (the train worker does).
+        return False
+    import jax
+    _orig_jit = jax.jit
+
+    def _tracking_jit(*args, **kwargs):
+        out = _orig_jit(*args, **kwargs)
+        if args and callable(args[0]) and callable(out):
+            name = getattr(args[0], "__name__", None) or "jit"
+            return track(out, name=name)
+        return out
+
+    try:
+        jax.jit = _tracking_jit
+        _jit_patched = True
+    except Exception:  # noqa: BLE001 — fall back to explicit track()
+        return _ensure_listener()
+    return _ensure_listener()
+
+
+def uninstall() -> None:
+    """Disable the detector (the monitoring listener stays registered
+    but inert — jax has no per-listener deregistration) and restore
+    ``jax.jit``."""
+    global _enabled, _jit_patched
+    _enabled = False
+    if _jit_patched and _orig_jit is not None:
+        try:
+            import jax
+            jax.jit = _orig_jit
+        except Exception as e:  # noqa: BLE001
+            telemetry.note_swallowed("profiler.recompile.uninstall", e)
+        _jit_patched = False
+
+
+def report() -> Dict[str, Any]:
+    """Per-site compile accounting snapshot (diagnostics / tests)."""
+    with _lock:
+        return {name: {
+            "compiles": st.compiles,
+            "compile_seconds": round(st.compile_s, 4),
+            "warm": st.warm,
+            "recompiles": st.recompiles,
+            "signatures": list(st.signatures),
+            "last_signature": st.last_signature,
+        } for name, st in _sites.items()}
+
+
+def _reset_for_tests() -> None:
+    global _enabled
+    with _lock:
+        _sites.clear()
+    _enabled = False

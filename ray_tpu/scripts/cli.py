@@ -496,6 +496,46 @@ def drain(address, deadline_s, reason, undrain, node):
                    f"(deadline {deadline_s:g}s, reason {reason})")
 
 
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--duration-s", type=float, default=2.0, show_default=True,
+              help="How long every process samples its threads.")
+@click.option("--hz", type=float, default=67.0, show_default=True,
+              help="Host sampling rate.")
+@click.option("--jax", "jax_profile", is_flag=True,
+              help="Also bracket the window with jax.profiler on every "
+                   "worker that has jax loaded (TensorBoard artifacts "
+                   "land under <session>/profiles/<id>/jax/).")
+@click.option("--output", "-o", default="profile_trace.json",
+              show_default=True,
+              help="Write the merged Chrome-trace JSON here (load in "
+                   "chrome://tracing or https://ui.perfetto.dev).")
+def profile(address, duration_s, hz, jax_profile, output):
+    """Capture a cluster-wide performance profile: every live worker
+    (plus the driver) samples for the duration, and the head merges the
+    records into ONE clock-aligned Chrome trace — the first thing to run
+    when step time regresses and the stack dump looks healthy."""
+    from urllib.parse import urlencode
+    client = _client(address)
+    q = {"duration_s": duration_s, "hz": hz}
+    if jax_profile:
+        q["jax"] = "1"
+    out = client._request("POST",
+                          "/api/cluster/profile?" + urlencode(q))
+    trace = out.pop("trace", None)
+    if trace is not None:
+        with open(output, "w") as f:
+            json.dump(trace, f)
+        click.echo(f"wrote {len(trace.get('traceEvents', []))} events "
+                   f"to {output}")
+    click.echo(f"head copy: {out.get('path')}")
+    click.echo(f"workers captured: {len(out.get('workers', []))}")
+    if out.get("unresponsive"):
+        click.echo("unresponsive (no capture in time): "
+                   + ", ".join(w[:12] for w in out["unresponsive"]))
+        raise SystemExit(1)
+
+
 @cli.group()
 def debug():
     """Failure forensics (flight recorder)."""

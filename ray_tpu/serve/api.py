@@ -561,8 +561,9 @@ class DeploymentHandle:
                                 self._stream)
 
     def remote(self, *args, **kwargs):
-        from ..util import telemetry
+        from ..util import telemetry, tracing
         t_route = time.perf_counter()
+        t_route_wall = time.time()
         tags = {"deployment": self._name}
 
         def _note_latency():
@@ -594,6 +595,15 @@ class DeploymentHandle:
             time.sleep(0.05)
             router._refresh(force=True)
         hexid, replica = picked
+        # Handle-path queue wait as a trace span: admission + replica
+        # pick, parented under the caller's context — and installed as
+        # the parent of the actor submit below, so the whole request
+        # (route -> submit -> execute -> engine phases) is ONE tree even
+        # when the caller had no ambient context.
+        route_ctx = tracing.record_span(
+            tracing.current(), f"serve_route {self._name}",
+            t_route_wall, t_route_wall + (time.perf_counter() - t_route),
+            {"deployment": self._name, "replica": hexid[:12]})
         telemetry.inc("ray_tpu_serve_requests_total", tags=tags)
         router.note_start(hexid)
         if self._model_id is not None:
@@ -603,11 +613,18 @@ class DeploymentHandle:
         submit = getattr(replica, method)
         if self._stream:
             submit = submit.options(num_returns="streaming")
-        if self._model_id is not None:
-            ref = submit.remote(self._method, args, kwargs,
-                                multiplexed_model_id=self._model_id)
-        else:
-            ref = submit.remote(self._method, args, kwargs)
+        prev_ctx = tracing.current()
+        if route_ctx is not None:
+            tracing.set_current(route_ctx)
+        try:
+            if self._model_id is not None:
+                ref = submit.remote(self._method, args, kwargs,
+                                    multiplexed_model_id=self._model_id)
+            else:
+                ref = submit.remote(self._method, args, kwargs)
+        finally:
+            if route_ctx is not None:
+                tracing.set_current(prev_ctx)
         if self._stream:
             # Streamed request: the wrapper decrements in-flight when the
             # consumer finishes (or abandons) the stream.

@@ -8,11 +8,16 @@ from .controller import CrashLoopError
 from .trainer import (CheckpointConfig, FailureConfig, JaxTrainer, Result,
                       RunConfig, ScalingConfig)
 from .watchdog import TrainWatchdog, WatchdogConfig
+# Step-phase attribution (ray_tpu.profiler): declare what each slice of
+# a step was — train.step_phase("data_wait") / train.fence(arrays) —
+# and report() decomposes every step into
+# ray_tpu_train_step_phase_seconds{phase}.
+from ..profiler.attribution import fence, step_phase
 
 __all__ = [
     "JaxTrainer", "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Result", "Checkpoint", "CheckpointManager",
     "get_context", "report", "TrainContext", "save_pytree", "load_pytree",
     "save_checkpoint", "load_checkpoint", "CrashLoopError",
-    "WatchdogConfig", "TrainWatchdog",
+    "WatchdogConfig", "TrainWatchdog", "step_phase", "fence",
 ]

@@ -128,10 +128,19 @@ def report(metrics: Dict[str, Any],
     ctx = get_context()
     ctx._report_seq += 1
     from .._private.api import _control
+    from ..profiler import attribution
     from ..util import telemetry
     now = time.time()
     now_mono = time.monotonic()
     ckpt_s = telemetry.pop_checkpoint_seconds()
+    # Step-phase attribution: whatever this step declared through
+    # train.step_phase(), plus checkpoint-blocking time and the derived
+    # unattributed remainder ("other").  seq 1's window is init/compile,
+    # not a step — no remainder is derived for it.
+    step_s = (now_mono - ctx._last_report_mono) \
+        if ctx._report_seq > 1 else None
+    phases = attribution.finalize_step_phases(
+        attribution.pop_phases(), step_s, ckpt_s)
     payload = {
         "metrics": dict(metrics),
         "rank": ctx.get_world_rank(),
@@ -151,8 +160,12 @@ def report(metrics: Dict[str, Any],
         # Checkpoint seconds inside this report window (goodput
         # reattribution at the controller).
         "ckpt_seconds": ckpt_s,
+        # Per-phase step decomposition (data_wait/h2d/compute/.../other):
+        # the controller aggregates Result.step_phases from rank 0 and
+        # reattributes data-wait out of goodput's productive phase.
+        "phases": phases,
     }
-    _note_step(ctx, now, now_mono, metrics)
+    _note_step(ctx, now, now_mono, metrics, phases)
     _control("kv_put",
              f"train/{ctx.run_id}/report/{ctx.get_world_rank()}/"
              f"{ctx._incarnation}/{ctx._report_seq}",
@@ -265,10 +278,12 @@ def _maybe_drain_flush(ctx: "TrainContext") -> None:
 
 
 def _note_step(ctx: "TrainContext", now: float, now_mono: float,
-               metrics: Dict[str, Any]) -> None:
+               metrics: Dict[str, Any],
+               phases: Optional[Dict[str, float]] = None) -> None:
     """Built-in train metrics from the report stream: each rank-0
     report-to-report interval is one step (histogram + timeline span);
     token counts ride along when the user metrics carry a tokens key."""
+    from ..profiler import attribution
     from ..util import telemetry
     telemetry.inc("ray_tpu_train_reports_total")
     for key in ("tokens", "num_tokens", "tokens_per_step"):
@@ -276,6 +291,9 @@ def _note_step(ctx: "TrainContext", now: float, now_mono: float,
         if isinstance(v, (int, float)) and v > 0:
             telemetry.inc("ray_tpu_train_tokens_total", v)
             break
+    # Per-device HBM used/peak gauges (rate-limited; absent on backends
+    # without memory_stats) — creeping HBM is a silent step-time killer.
+    attribution.note_hbm_gauges()
     # seq 1 measures from context construction — that window is
     # init/JIT compile, not a step (the controller's goodput tracker
     # accounts it as "init"); report-to-report starts at seq 2.
@@ -283,10 +301,15 @@ def _note_step(ctx: "TrainContext", now: float, now_mono: float,
         dur = now_mono - ctx._last_report_mono
         if dur > 0:
             telemetry.observe("ray_tpu_train_step_seconds", dur)
+            for phase, seconds in (phases or {}).items():
+                telemetry.observe("ray_tpu_train_step_phase_seconds",
+                                  seconds, tags={"phase": phase})
             # Span: wall anchor for position, monotonic length.
             telemetry._emit_span(
                 "train_step", "train", ctx._last_report_wall,
                 ctx._last_report_wall + dur,
-                extra={"seq": ctx._report_seq, "run_id": ctx.run_id})
+                extra={"seq": ctx._report_seq, "run_id": ctx.run_id,
+                       "phases": {k: round(v, 6)
+                                  for k, v in (phases or {}).items()}})
     ctx._last_report_wall = now
     ctx._last_report_mono = now_mono

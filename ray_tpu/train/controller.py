@@ -114,6 +114,18 @@ class TrainWorker:
         _context.set_context(ctx)
         try:
             fn = serialization.loads_control(fn_blob)
+            # Recompile detector: shape churn in the user's jitted step
+            # fn is the #1 silent TPU step-time regression — every train
+            # worker watches for it by default
+            # (RAY_TPU_RECOMPILE_DETECT=0 opts out).  install() only
+            # engages once jax is imported, so it runs AFTER the train
+            # fn deserialized (unpickling restores the fn's module
+            # imports, incl. jax) and after any setup_dist import;
+            # fns that only import jax lazily inside their body wrap
+            # explicitly with ray_tpu.profiler.track().
+            if os.environ.get("RAY_TPU_RECOMPILE_DETECT", "1") != "0":
+                from ..profiler import recompile
+                recompile.install()
             if config is not None:
                 fn(config)
             else:
@@ -163,6 +175,9 @@ class TrainController:
         self._reports: List[Dict[str, Any]] = []
         self._seen_report_keys: set = set()
         self._seen_ack_keys: set = set()
+        # Rank-0 step-phase attribution totals (seconds per phase) from
+        # the report stream — Result.step_phases.
+        self._phase_totals: Dict[str, float] = {}
         # Goodput accounting (reference analog: MegaScale-style wall-time
         # partitioning): init/step/checkpoint/restart/idle phases; the
         # ratio lands on the ray_tpu_train_goodput_ratio gauge live.
@@ -279,6 +294,16 @@ class TrainController:
                 # the driver observes as the "step" phase: reattribute.
                 self.goodput.reattribute(
                     "checkpoint", payload.get("ckpt_seconds", 0.0) or 0.0)
+                phases = payload.get("phases") or {}
+                for phase, seconds in phases.items():
+                    if seconds > 0:
+                        self._phase_totals[phase] = \
+                            self._phase_totals.get(phase, 0.0) + seconds
+                # Data-wait is idle devices, not productive step time:
+                # an input-bound run's goodput should sag even though
+                # the step loop never stops "stepping".
+                self.goodput.reattribute(
+                    "data_wait", phases.get("data_wait", 0.0) or 0.0)
                 if payload.get("checkpoint_dir"):
                     self.manager.register(payload["checkpoint_dir"],
                                           payload["metrics"])
@@ -731,6 +756,14 @@ class TrainController:
                        key=lambda r: r["time"])
         last_metrics = rank0[-1]["metrics"] if rank0 else {}
         latest = self.manager.latest()
+        total_phase_s = sum(self._phase_totals.values())
+        step_phases = {
+            "seconds": {k: round(v, 6)
+                        for k, v in sorted(self._phase_totals.items())},
+            "fraction": {k: round(v / total_phase_s, 4)
+                         for k, v in sorted(self._phase_totals.items())}
+            if total_phase_s > 0 else {},
+        } if self._phase_totals else None
         return Result(
             metrics=last_metrics,
             checkpoint=Checkpoint(latest) if latest else None,
@@ -739,4 +772,5 @@ class TrainController:
             num_failures=failures,
             num_drains=self.num_drains,
             world_size_history=self.world_size_history,
-            goodput=self.goodput.summary())
+            goodput=self.goodput.summary(),
+            step_phases=step_phases)

@@ -132,6 +132,12 @@ class Result:
     # Goodput accounting for this run: {goodput_ratio, total_s,
     # productive_s, phases_s} (telemetry.GoodputTracker.summary()).
     goodput: Optional[Dict[str, Any]] = None
+    # Rank-0 step-phase attribution: {"seconds": {phase: s},
+    # "fraction": {phase: f}} summed over the run (None when no rank-0
+    # report carried phases — e.g. zero completed steps).  Phases are
+    # data_wait / h2d / compute / collective / ckpt_block / other; see
+    # ray_tpu.train.step_phase.
+    step_phases: Optional[Dict[str, Any]] = None
 
 
 class JaxTrainer:

@@ -54,6 +54,12 @@ class WatchdogConfig:
     capture_stacks: bool = True
     # Write a flight-recorder bundle on each verdict.
     write_bundle: bool = True
+    # Attach an on-demand cluster profile of this duration to the trip
+    # bundle (profile_trace.json: merged clock-aligned Chrome trace of
+    # every worker — WHERE the time goes, on top of the stack snapshot's
+    # where-the-threads-are).  0 disables (default: a profile holds the
+    # bundle writer open for its whole capture window).
+    bundle_profile_s: float = 0.0
 
 
 class _RankState:
@@ -274,7 +280,8 @@ class TrainWatchdog:
                     from .._private.api import _control
                     _control("debug_dump", f"watchdog_{kind}_rank{rank}",
                              self.config.capture_stacks,
-                             {"verdict": verdict})
+                             {"verdict": verdict},
+                             self.config.bundle_profile_s or None)
                 except Exception:  # noqa: BLE001 — forensics best-effort
                     pass
             bt = threading.Thread(target=_write, name="watchdog-bundle",

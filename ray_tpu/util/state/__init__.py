@@ -98,9 +98,24 @@ def debug_dump(reason: str = "manual") -> str:
     return _control("debug_dump", reason)
 
 
+def profile(duration_s: float = 2.0, hz: float = 67.0,
+            jax_profile: bool = False,
+            timeout_s: Optional[float] = None) -> Dict[str, Any]:
+    """On-demand cluster profile (``ray-tpu profile``): every live
+    worker plus the driver samples for ``duration_s``; returns
+    ``{"path", "trace", "workers", "unresponsive", "num_events"}`` with
+    the merged clock-aligned Chrome trace (see ray_tpu.profiler)."""
+    return _control("profile", duration_s, hz, jax_profile, timeout_s)
+
+
 class profile_span:
     """Context manager recording a user span onto the timeline
     (reference: ray.profiling / ProfileEvent, core_worker/profile_event.h).
+
+    Nesting-aware and re-entrant: spans share the per-thread open-span
+    stack with ``telemetry.profile_span``, so an inner span links to its
+    parent (``extra["parent_id"]``) and the parent's ``extra["self_s"]``
+    excludes nested time instead of double counting it.
 
     Example::
 
@@ -118,21 +133,32 @@ class profile_span:
         self.pid = pid
         self.tid = tid or f"pid:{os.getpid()}:{threading.get_ident() % 10000}"
         self.extra = extra
+        self._frames: List[Dict[str, Any]] = []
 
     def __enter__(self):
         import time
+
+        from ..telemetry import _span_enter
+
         # Wall clock anchors the span's position on the timeline; the
         # DURATION comes from the monotonic clock so an NTP step mid-span
         # cannot produce a negative/garbage length.
-        self._start = time.time()
-        self._start_mono = time.monotonic()
+        self._frames.append(_span_enter({"start": time.time(),
+                                         "start_mono": time.monotonic()}))
         return self
 
     def __exit__(self, *exc):
         import time
-        end = self._start + (time.monotonic() - self._start_mono)
-        _control("add_profile_span", self.name, self.category, self._start,
-                 end, self.pid, self.tid, self.extra)
+
+        from ..telemetry import _span_exit
+
+        entry = self._frames.pop()
+        dur = time.monotonic() - entry["start_mono"]
+        extra = dict(self.extra or {})
+        extra.update(_span_exit(entry, dur))
+        _control("add_profile_span", self.name, self.category,
+                 entry["start"], entry["start"] + dur, self.pid, self.tid,
+                 extra)
         return False
 
 

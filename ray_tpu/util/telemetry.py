@@ -177,6 +177,26 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "type": "gauge", "tag_keys": (),
         "description": "Productive-step wall time over total run wall "
                        "time (goodput accounting; see GoodputTracker)."},
+    "ray_tpu_train_step_phase_seconds": {
+        "type": "histogram", "tag_keys": ("phase",),
+        "boundaries": _STEP_BUCKETS,
+        "description": "Per-step device-time attribution: seconds each "
+                       "reporting step spent in a declared phase "
+                       "(data_wait|h2d|compute|collective|ckpt_block|"
+                       "other; ray_tpu.train.step_phase fences with "
+                       "block_until_ready at phase boundaries so async "
+                       "dispatch cannot smear compute into the next "
+                       "phase)."},
+    "ray_tpu_train_hbm_used_bytes": {
+        "type": "gauge", "tag_keys": ("device",),
+        "description": "Per-device accelerator memory in use (jax "
+                       "memory_stats; absent on backends that do not "
+                       "report it).  Creeping HBM is the classic silent "
+                       "step-time killer."},
+    "ray_tpu_train_hbm_peak_bytes": {
+        "type": "gauge", "tag_keys": ("device",),
+        "description": "Per-device peak accelerator memory since process "
+                       "start (jax memory_stats peak_bytes_in_use)."},
     "ray_tpu_train_straggler_total": {
         "type": "counter", "tag_keys": (),
         "description": "Watchdog straggler verdicts: a rank's step time "
@@ -235,6 +255,29 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "type": "gauge", "tag_keys": (),
         "description": "Nodes currently draining (unschedulable for new "
                        "leases, waiting for work to evacuate)."},
+    # -- profiler (cluster-wide performance profiling subsystem) -----------
+    "ray_tpu_profiler_compile_total": {
+        "type": "counter", "tag_keys": ("fn",),
+        "description": "XLA compilations attributed to a tracked "
+                       "call site (jax.monitoring backend_compile "
+                       "events; fn=<site name>)."},
+    "ray_tpu_profiler_compile_seconds": {
+        "type": "histogram", "tag_keys": ("fn",),
+        "boundaries": _STEP_BUCKETS,
+        "description": "Seconds spent in XLA backend compilation per "
+                       "tracked call site."},
+    "ray_tpu_profiler_recompiles_total": {
+        "type": "counter", "tag_keys": ("fn",),
+        "description": "POST-WARMUP recompilations: a tracked site that "
+                       "had reached steady state compiled again (shape/"
+                       "dtype churn — the #1 silent TPU step-time "
+                       "regression).  Each also logs a once-per-site "
+                       "warning naming the offending shapes."},
+    "ray_tpu_profiler_captures_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "On-demand cluster profile captures served "
+                       "(`ray-tpu profile` / POST /api/profile / "
+                       "flight-recorder auto-attach)."},
     # -- internal ----------------------------------------------------------
     "ray_tpu_internal_swallowed_errors_total": {
         "type": "counter", "tag_keys": ("where",),
@@ -369,7 +412,10 @@ def _emit_span(name: str, category: str, start_s: float, end_s: float,
     if rt is None:
         return
     pid = category
-    tid = f"pid:{os.getpid()}"
+    # One timeline row per THREAD, not per process: concurrent spans from
+    # different threads on a shared row would interleave and break the
+    # viewer's nesting of same-thread parent/child spans.
+    tid = f"pid:{os.getpid()}:t{threading.get_ident() % 100000}"
     try:
         if hasattr(rt, "ctl_add_profile_span"):
             rt.ctl_add_profile_span(name, category, start_s, end_s,
@@ -386,6 +432,58 @@ def _emit_span(name: str, category: str, start_s: float, end_s: float,
         pass  # telemetry is never allowed to fail the instrumented path
 
 
+# Per-thread open-span stack: gives nested profile_spans parent linkage
+# and lets a parent subtract its children's time (``self_s``), so an
+# inner span's duration is never silently attributed to both levels.
+# Shared by telemetry.profile_span and util.state.profile_span.
+_span_tls = threading.local()
+_span_seq_lock = threading.Lock()
+_span_seq = 0
+
+
+def _next_span_id() -> int:
+    global _span_seq
+    with _span_seq_lock:
+        _span_seq += 1
+        return _span_seq
+
+
+def _span_stack() -> list:
+    stack = getattr(_span_tls, "stack", None)
+    if stack is None:
+        stack = _span_tls.stack = []
+    return stack
+
+
+def _span_enter(entry: Dict[str, Any]) -> Dict[str, Any]:
+    """Push one open-span frame; returns it annotated with its id and
+    its parent's id (None at the top level)."""
+    stack = _span_stack()
+    entry["span_id"] = _next_span_id()
+    entry["parent_id"] = stack[-1]["span_id"] if stack else None
+    entry["child_s"] = 0.0
+    stack.append(entry)
+    return entry
+
+
+def _span_exit(entry: Dict[str, Any], dur_s: float) -> Dict[str, Any]:
+    """Pop a frame (tolerating mismatched exits), charge the duration to
+    the parent's child time, and return linkage extras for the span:
+    span_id/parent_id plus ``self_s`` — the duration EXCLUSIVE of nested
+    spans, which is what nesting used to misattribute."""
+    stack = _span_stack()
+    if entry in stack:
+        # Normal case pops the top; an out-of-order exit (generator
+        # suspension etc.) drops everything above it rather than
+        # corrupting later pairings.
+        del stack[stack.index(entry):]
+    if stack:
+        stack[-1]["child_s"] += dur_s
+    return {"span_id": entry["span_id"],
+            "parent_id": entry["parent_id"],
+            "self_s": max(0.0, dur_s - entry["child_s"])}
+
+
 class profile_span:
     """Cheap system-span context manager for framework hot paths.
 
@@ -393,26 +491,38 @@ class profile_span:
     runtime and does a blocking control call), this one no-ops without a
     runtime and never waits on a reply — safe inside the engine decode
     loop or a bench process that never called ``ray_tpu.init()``.
+
+    Re-entrant and nesting-aware: a span opened inside another span is
+    linked to its parent (``extra["parent_id"]``) and the parent's
+    ``extra["self_s"]`` excludes nested time, so inner durations are
+    attributed exactly once.  One instance may be entered recursively
+    (per-entry state lives on a stack, not the instance).
     """
 
-    __slots__ = ("name", "category", "extra", "_start", "_start_mono")
+    __slots__ = ("name", "category", "extra", "_frames")
 
     def __init__(self, name: str, category: str = "system",
                  extra: Optional[Dict[str, Any]] = None):
         self.name = name
         self.category = category
         self.extra = extra
+        self._frames: list = []
 
     def __enter__(self) -> "profile_span":
         # Wall clock positions the span; monotonic measures its length so
         # an NTP step mid-span can't yield a negative/garbage duration.
-        self._start = time.time()
-        self._start_mono = time.monotonic()
+        entry = _span_enter({"start": time.time(),
+                             "start_mono": time.monotonic()})
+        self._frames.append(entry)
         return self
 
     def __exit__(self, *exc) -> bool:
-        end = self._start + (time.monotonic() - self._start_mono)
-        _emit_span(self.name, self.category, self._start, end, self.extra)
+        entry = self._frames.pop()
+        dur = time.monotonic() - entry["start_mono"]
+        extra = dict(self.extra or {})
+        extra.update(_span_exit(entry, dur))
+        _emit_span(self.name, self.category, entry["start"],
+                   entry["start"] + dur, extra)
         return False
 
 

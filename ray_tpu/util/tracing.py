@@ -161,6 +161,91 @@ class task_span:
         return False
 
 
+def record_span(parent: Optional[SpanContext], name: str,
+                start_s: float, end_s: float,
+                attributes: Optional[Dict[str, Any]] = None,
+                kind: str = "INTERNAL",
+                ctx: Optional[SpanContext] = None) -> Optional[SpanContext]:
+    """Record one finished span with explicit parent linkage and return
+    its context (None when tracing is off and no parent exists).
+
+    This is the cross-thread escape hatch: pipeline stages that finish
+    on a different thread than the one that opened the request (the
+    serve router, the disagg dispatcher/driver loops) carry the parent
+    ``SpanContext`` in their request state and record phases as they
+    complete — same trace tree, no thread-local context needed."""
+    if ctx is None:
+        # An explicit ctx means the trace is already in flight (allocated
+        # while tracing was on) — record it even if tracing was toggled
+        # off meanwhile; otherwise the usual gate applies.
+        if parent is None and not _enabled:
+            return None
+        ctx = SpanContext(parent.trace_id if parent else _rand_hex(16),
+                          _rand_hex(8))
+    _record({
+        "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+        "parent_span_id": parent.span_id if parent else None,
+        "name": name, "kind": kind,
+        "start_s": start_s, "end_s": end_s,
+        "attributes": attributes or {},
+    })
+    return ctx
+
+
+def new_child(parent: Optional[SpanContext]) -> Optional[SpanContext]:
+    """Allocate a child span context NOW (so sub-spans can parent onto
+    it) for a span whose end — and therefore whose record — comes later.
+    Pair with ``record_span(..., ctx=child)``."""
+    if parent is None and not _enabled:
+        return None
+    return SpanContext(parent.trace_id if parent else _rand_hex(16),
+                       _rand_hex(8))
+
+
+class span:
+    """In-thread span context manager: child of the current context,
+    installed as current for the duration (nested spans and ``.remote``
+    submits inside the block join the same trace)::
+
+        with tracing.span("serve_route", {"deployment": name}):
+            ...
+    """
+
+    def __init__(self, name: str,
+                 attributes: Optional[Dict[str, Any]] = None,
+                 kind: str = "INTERNAL"):
+        self._name = name
+        self._attrs = attributes
+        self._kind = kind
+        self._ctx: Optional[SpanContext] = None
+        self._prev: Optional[SpanContext] = None
+        self._t0 = 0.0
+        self._t0_mono = 0.0
+
+    def __enter__(self) -> "span":
+        parent = current()
+        self._ctx = new_child(parent)
+        if self._ctx is None:
+            return self
+        self._prev = parent
+        set_current(self._ctx)
+        self._t0 = time.time()
+        self._t0_mono = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, _exc, _tb) -> bool:
+        if self._ctx is None:
+            return False
+        set_current(self._prev)
+        attrs = dict(self._attrs or {})
+        if exc_type is not None:
+            attrs["error"] = exc_type.__name__
+        record_span(self._prev, self._name, self._t0,
+                    self._t0 + (time.monotonic() - self._t0_mono),
+                    attrs, self._kind, ctx=self._ctx)
+        return False
+
+
 # -- consumption ----------------------------------------------------------- #
 
 def get_trace(trace_id: str) -> List[Dict[str, Any]]:

@@ -33,8 +33,15 @@ def _pg_info(cluster, pg):
 class TestClusterBasics:
     def test_join_and_resources(self, cluster):
         assert cluster.alive_node_count() == 4  # head + 3
-        total = ray_tpu.cluster_resources()
-        assert total.get("CPU", 0) == 6.0
+        # A node can be alive before its resource view lands in the
+        # head's aggregate — under full-suite CPU contention that sync
+        # lags join by a beat, so poll briefly instead of reading once.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) == 6.0:
+                break
+            time.sleep(0.1)
+        assert ray_tpu.cluster_resources().get("CPU", 0) == 6.0
 
     def test_remote_dispatch_and_spread(self, cluster):
         @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")

@@ -21,7 +21,8 @@ from ray_tpu.util import metrics as metrics_mod
 from ray_tpu.util import telemetry
 
 _NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
-SUBSYSTEMS = ("serve", "llm", "train", "ckpt", "data", "node", "internal")
+SUBSYSTEMS = ("serve", "llm", "train", "ckpt", "data", "node", "profiler",
+              "internal")
 
 
 class TestCatalog:
@@ -141,6 +142,31 @@ class TestCatalog:
         telemetry.observe("ray_tpu_llm_kv_transfer_seconds", 0.0,
                           tags={"op": "export"})
 
+    def test_profiler_series_registered(self):
+        """The profiler subsystem's series (PR 10: step-phase
+        attribution, HBM gauges, compile accounting, capture counter)
+        are declared in the catalog — RT204 lints every call site."""
+        specs = {
+            "ray_tpu_train_step_phase_seconds": ("histogram", ("phase",)),
+            "ray_tpu_train_hbm_used_bytes": ("gauge", ("device",)),
+            "ray_tpu_train_hbm_peak_bytes": ("gauge", ("device",)),
+            "ray_tpu_profiler_compile_total": ("counter", ("fn",)),
+            "ray_tpu_profiler_compile_seconds": ("histogram", ("fn",)),
+            "ray_tpu_profiler_recompiles_total": ("counter", ("fn",)),
+            "ray_tpu_profiler_captures_total": ("counter", ()),
+        }
+        for name, (typ, tags) in specs.items():
+            assert name in telemetry.CATALOG, name
+            assert telemetry.CATALOG[name]["type"] == typ, name
+            assert tuple(telemetry.CATALOG[name]["tag_keys"]) == tags
+            assert telemetry.CATALOG[name]["description"].strip(), name
+        # The exception-safe helpers record them without raising.
+        telemetry.observe("ray_tpu_train_step_phase_seconds", 0.0,
+                          tags={"phase": "data_wait"})
+        telemetry.inc("ray_tpu_profiler_compile_total", 0.0,
+                      tags={"fn": "smoke"})
+        telemetry.inc("ray_tpu_profiler_captures_total", 0.0)
+
 
 def _base_series(prom_text):
     """Distinct catalog-level metric names present in an exposition."""
@@ -221,6 +247,12 @@ class TestSmokeAllSubsystems:
         toks = eng.generate([[3, 17, 92, 5, 41]],
                             SamplingParams(max_tokens=8))
         assert len(toks[0]) == 8
+
+        # -- profiler: tracked-jit compile accounting ---------------------
+        from ray_tpu import profiler
+        tracked = profiler.track(jax.jit(lambda x: x + 1),
+                                 name="telemetry_smoke_inc")
+        tracked(jnp.ones((4,), jnp.float32))
 
         # -- data: a small pipeline through the streaming executor --------
         import ray_tpu.data as rdata
