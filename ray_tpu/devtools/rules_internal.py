@@ -376,6 +376,95 @@ class NonAtomicPublish(Rule):
                 f"into place (see checkpoint.format.write_bytes_atomic)")
 
 
+#: Modules that dispatch host buffers to devices on mesh/pipeline paths
+#: (RT207): the PR 6 aliasing hazard class — on the CPU/zero-copy
+#: substrate jax.device_put may alias the host ndarray, so a later
+#: in-place write silently corrupts the already-dispatched device value.
+_DEVICE_DISPATCH_MODULES = ("/parallel/", "train/mesh/", "llm/disagg/")
+
+
+@register
+class DevicePutAliasedHostBuffer(Rule):
+    id = "RT207"
+    example_bad = (
+        "buf = np.zeros((8, 128))\n"
+        "x = jax.device_put(buf, sharding)\n"
+        "buf[0] = 1.0   # mutates the device value it aliases\n")
+    example_good = (
+        "buf = np.zeros((8, 128))\n"
+        "x = jax.device_put(buf.copy(), sharding)\n"
+        "buf[0] = 1.0   # device copy is independent\n")
+    scope = "internal"
+    summary = "jax.device_put of a host buffer mutated in the same scope"
+    rationale = ("On CPU (and zero-copy shm-store views) jax.device_put "
+                 "may alias the host ndarray instead of copying; an "
+                 "in-place write to that buffer after dispatch silently "
+                 "corrupts the device value (the mesh/pipeline dispatch "
+                 "aliasing hazard).  Pass a real copy (.copy()) — NOT "
+                 "np.ascontiguousarray, which returns the SAME object "
+                 "for an already-contiguous buffer — or stop mutating "
+                 "the buffer.")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not any(m in ctx.module_key for m in _DEVICE_DISPATCH_MODULES):
+            return
+        if "device_put" not in ctx.source:
+            return
+        scopes: List[ast.AST] = [ctx.tree]
+        scopes += ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef)
+        for scope in scopes:
+            mutated = self._mutated_lines(scope)
+            if not mutated:
+                continue
+            for node in walk_same_scope(scope):
+                if not (isinstance(node, ast.Call) and
+                        (dotted(node.func) or "").endswith("device_put")
+                        and node.args):
+                    continue
+                arg = node.args[0]
+                # Only mutations AFTER the dispatch can corrupt the
+                # device value; fill-then-dispatch is the normal safe
+                # init pattern.  (Line order approximates execution
+                # order: a loop that mutates textually above a dispatch
+                # inside it is not caught — keep dispatches out of
+                # mutate-loops anyway.)
+                if isinstance(arg, ast.Name) and any(
+                        line > node.lineno
+                        for line in mutated.get(arg.id, ())):
+                    yield ctx.finding(
+                        self, node,
+                        f"jax.device_put({arg.id!r}) of a host buffer "
+                        f"mutated after dispatch: device_put may alias "
+                        f"instead of copy — dispatch a real copy "
+                        f"({arg.id}.copy(); ascontiguousarray does NOT "
+                        f"copy contiguous buffers)")
+
+    @staticmethod
+    def _mutated_lines(scope: ast.AST) -> Dict[str, List[int]]:
+        """Line numbers of in-place writes per name: subscript-store
+        targets (``buf[i] = ...``) and augmented assignments
+        (``buf += ...`` / ``buf[i] += ...``).  Rebinding (``buf = ...``)
+        is NOT mutation — the old buffer the device aliased is
+        unchanged."""
+        out: Dict[str, List[int]] = {}
+        for node in walk_same_scope(scope):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            for t in targets:
+                name = None
+                if isinstance(t, ast.Subscript):
+                    name = dotted(t.value)
+                elif isinstance(node, ast.AugAssign) and \
+                        isinstance(t, ast.Name):
+                    name = t.id
+                if name:
+                    out.setdefault(name, []).append(node.lineno)
+        return out
+
+
 @register
 class ProtocolHandlerMissing(Rule):
     id = "RT205"

@@ -106,6 +106,10 @@ class JobServer:
             except Exception:  # noqa: BLE001
                 return None
 
+        def _mesh_status():
+            from ray_tpu.train.mesh.runtime import read_mesh_status
+            return read_mesh_status()
+
         async def cluster_status(request):
             from ray_tpu._private.api import _control
             import ray_tpu
@@ -120,6 +124,9 @@ class JobServer:
                 # goodput ratio + the watchdog's last verdict.
                 "goodput": await call(_goodput),
                 "watchdog": await call(_watchdog_verdict),
+                # Live SPMD mesh shape of the last-formed train group
+                # (train/mesh runtime; None before any mesh-parallel run).
+                "mesh": await call(_mesh_status),
             }
             return web.json_response(payload)
 

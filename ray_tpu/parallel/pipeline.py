@@ -92,15 +92,32 @@ def pipeline_blocks(stacked_params, x, stage_body: Callable, *,
     x_mb = x.reshape(M, B // M, S, E)
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
 
-    island = jax.shard_map(
-        partial(_pipeline_island, stage_body=stage_body,
-                axis_name=axis_name, num_stages=num_stages,
-                num_microbatches=M),
-        mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
-        axis_names={axis_name},  # manual over pp only; rest stays GSPMD
-        check_vma=False,
-    )
+    body = partial(_pipeline_island, stage_body=stage_body,
+                   axis_name=axis_name, num_stages=num_stages,
+                   num_microbatches=M)
+    if hasattr(jax, "shard_map"):
+        island = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            axis_names={axis_name},  # manual over pp only; rest GSPMD
+            check_vma=False,
+        )
+    else:
+        # Pre-stable API (jax < 0.6): manual-over-pp-only is spelled as
+        # "every OTHER axis stays automatic".  Size-1 axes are dropped
+        # from the auto set — nothing shards over them, and an empty
+        # auto set takes the fully-manual lowering, which legacy
+        # XLA-CPU supports (partial-auto lowers a PartitionId op it
+        # cannot partition).
+        from jax.experimental.shard_map import shard_map as _shard_map
+        island = _shard_map(
+            body, mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            check_rep=False,
+            auto=frozenset(a for a in mesh.axis_names
+                           if a != axis_name and mesh.shape[a] > 1),
+        )
     out = island(stacked_params, x_mb)
     return out.reshape(B, S, E)

@@ -20,6 +20,39 @@ from .sharding import (ShardingRules, default_rules, logical_to_pspec,
                        named_sharding)
 
 
+def _mirror_param_shardings(opt_state_shape, params_shape,
+                            param_shardings, mesh):
+    """Sharding pytree for an optimizer state: each state leaf whose key
+    path ends with a parameter's key path AND has that parameter's shape
+    (optax's mu/nu mirror the param tree) takes the param's sharding;
+    everything else — step counts, empty states, shape-reduced factored
+    statistics like adafactor's v_row/v_col — replicates (a full-rank
+    PartitionSpec pinned onto a reduced-rank leaf is a pjit error)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+    by_path = {tuple(str(k) for k in path): sh for path, sh in flat}
+    pflat, _ = jax.tree_util.tree_flatten_with_path(params_shape)
+    shape_by_path = {tuple(str(k) for k in path): leaf.shape
+                     for path, leaf in pflat}
+
+    def match(path, leaf):
+        keys = tuple(str(k) for k in path)
+        for start in range(len(keys)):
+            sh = by_path.get(keys[start:])
+            if sh is not None:
+                if getattr(leaf, "shape", None) \
+                        == shape_by_path.get(keys[start:]):
+                    return sh
+                return replicated
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(match, opt_state_shape)
+
+
 def batch_pspec(mesh, rules: Optional[ShardingRules] = None):
     """Token batches: [B, S] -> (dp,fsdp) on batch, sp on seq."""
     import jax
@@ -81,10 +114,19 @@ def make_lm_train_step(cfg, mesh, *, rules: Optional[ShardingRules] = None,
         opt_state = optimizer.init(params)
         return params, opt_state
 
-    # Opt-state sharding follows params: mu/nu are zeros_like(param) so
-    # GSPMD propagates the param layout; only explicit out_shardings for
-    # params are pinned.
-    init_fn = jax.jit(init_all, out_shardings=(param_shardings, None))
+    # Opt-state shardings are pinned EXPLICITLY to mirror the params
+    # (mu/nu shard like their param — the ZeRO-style optimizer-state
+    # sharding; scalars like adam's count replicate).  Leaving them to
+    # GSPMD (out_shardings=None) lets init and step choose DIFFERENT
+    # layouts, which breaks buffer donation at the first real
+    # multi-device execution ("aliased input/output sub-shape size"
+    # runtime errors) and silently double-materializes the state.
+    params_shape, opt_state_shape = jax.eval_shape(
+        init_all, jax.random.key(0))
+    opt_shardings = _mirror_param_shardings(
+        opt_state_shape, params_shape, param_shardings, mesh)
+    init_fn = jax.jit(init_all,
+                      out_shardings=(param_shardings, opt_shardings))
 
     def step(params, opt_state, batch):
         if grad_accum > 1:
@@ -130,8 +172,8 @@ def make_lm_train_step(cfg, mesh, *, rules: Optional[ShardingRules] = None,
 
     step_fn = jax.jit(
         step,
-        in_shardings=(param_shardings, None, bsharding),
-        out_shardings=(param_shardings, None, None),
+        in_shardings=(param_shardings, opt_shardings, bsharding),
+        out_shardings=(param_shardings, opt_shardings, None),
         donate_argnums=(0, 1) if donate else ())
 
     def place_batch(batch: Dict[str, Any]):

@@ -194,6 +194,13 @@ def status(address):
                    f"total {g['total_s']:.1f}s)")
     else:
         click.echo("train goodput: n/a (no training run observed)")
+    m = s.get("mesh")
+    if m:
+        click.echo(f"train mesh: {m.get('descriptor')} "
+                   f"(world {m.get('world')} x "
+                   f"{m.get('devices_per_worker')} devices)")
+    else:
+        click.echo("train mesh: n/a (no mesh-parallel run observed)")
     w = s.get("watchdog")
     if w:
         if w.get("status") == "ok":

@@ -2,9 +2,11 @@
 
 from ._checkpoint import (Checkpoint, CheckpointManager, load_pytree,
                           save_pytree)
-from ._context import (TrainContext, get_context, load_checkpoint, report,
-                       save_checkpoint)
+from ._context import (TrainContext, get_context, get_mesh, load_checkpoint,
+                       load_sharded, report, save_checkpoint, shard,
+                       shard_batch)
 from .controller import CrashLoopError
+from .mesh.config import MeshConfig
 from .trainer import (CheckpointConfig, FailureConfig, JaxTrainer, Result,
                       RunConfig, ScalingConfig)
 from .watchdog import TrainWatchdog, WatchdogConfig
@@ -20,4 +22,5 @@ __all__ = [
     "get_context", "report", "TrainContext", "save_pytree", "load_pytree",
     "save_checkpoint", "load_checkpoint", "CrashLoopError",
     "WatchdogConfig", "TrainWatchdog", "step_phase", "fence",
+    "MeshConfig", "get_mesh", "shard", "shard_batch", "load_sharded",
 ]

@@ -25,7 +25,8 @@ class TrainContext:
                  experiment_name: str,
                  latest_checkpoint: Optional[str] = None,
                  slice_id: int = 0, num_slices: int = 1,
-                 checkpoint_options: Optional[Dict[str, Any]] = None):
+                 checkpoint_options: Optional[Dict[str, Any]] = None,
+                 mesh_info: Optional[Dict[str, Any]] = None):
         self.run_id = run_id
         self._rank = rank
         self._world_size = world_size
@@ -53,6 +54,41 @@ class TrainContext:
         self._generation = self._ckpt_options.get("generation")
         self._last_drain_check_mono = 0.0
         self._drain_acked = False
+        # Mesh runtime (train/mesh): the controller resolves the axis
+        # sizes for THIS incarnation's world; the worker builds the
+        # global jax mesh lazily on first get_mesh()/shard() use.
+        self._mesh_info = dict(mesh_info or {})
+        self._mesh = None
+
+    # -- mesh runtime -------------------------------------------------------
+
+    def mesh(self):
+        """The group's global SPMD mesh (built on first use over the
+        jax.distributed world's full device set; falls back to a pure
+        data-parallel mesh when no MeshConfig was configured)."""
+        if self._mesh is None:
+            import jax
+
+            from ..parallel.mesh import MeshSpec
+            from .mesh.runtime import build_worker_mesh
+            axes = self._mesh_info.get("axes") or {}
+            num_slices = int(self._mesh_info.get("num_slices",
+                                                 self.num_slices) or 1)
+            if axes:
+                spec = MeshSpec(num_slices=num_slices,
+                                **{a: int(s) for a, s in axes.items()})
+            else:
+                spec = MeshSpec(dp=len(jax.devices()),
+                                num_slices=num_slices)
+            self._mesh = build_worker_mesh(spec)
+        return self._mesh
+
+    def sharding_rules(self):
+        """Logical-axis rules: defaults + the MeshConfig's overrides
+        (same merge as MeshConfig.sharding_rules — one implementation,
+        so worker-side resolution can never drift from config-side)."""
+        from .mesh.config import rules_with_overrides
+        return rules_with_overrides(self._mesh_info.get("rules"))
 
     def get_world_rank(self) -> int:
         return self._rank
@@ -191,6 +227,11 @@ def save_checkpoint(tree: Any, metrics: Optional[Dict[str, Any]] = None,
     of a global array this rank holds (see
     ``ray_tpu.checkpoint.even_shard_spec``)."""
     ctx = get_context()
+    if ctx._mesh is not None:
+        # Stamp the saving mesh's shape so a later restore can tell a
+        # same-shape resume from a mesh reshape (reshape counter).
+        from .mesh.reshape import save_metrics as _mesh_save_metrics
+        metrics = _mesh_save_metrics(ctx._mesh, metrics)
     return ctx.checkpoint_client().save(tree, metrics=metrics,
                                         shard_spec=shard_spec, step=step,
                                         sync=sync)
@@ -208,6 +249,55 @@ def load_checkpoint(placement=None) -> Optional[Any]:
         return None
     return ctx.checkpoint_client().load(ctx._latest_checkpoint,
                                         placement=placement)
+
+
+def get_mesh():
+    """The worker group's global SPMD mesh (inside a train fn).  Built
+    from the controller-resolved MeshConfig axes; without a MeshConfig
+    it is a pure data-parallel mesh over every device in the world."""
+    return get_context().mesh()
+
+
+def shard(tree: Any, logical_tree: Any):
+    """Place a pytree of host arrays onto the group mesh per a parallel
+    pytree of logical-axis tuples (``parallel.sharding`` rules + the
+    MeshConfig's overrides).  Every process passes the same full host
+    values; each device materializes only its shard."""
+    ctx = get_context()
+    from .mesh.runtime import shard_tree
+    return shard_tree(tree, logical_tree, ctx.mesh(),
+                      rules=ctx.sharding_rules())
+
+
+def shard_batch(batch: Any):
+    """Place this process's LOCAL batch rows onto the mesh's data axes
+    (leading dim over (dp, fsdp), seq over sp when sized): together the
+    processes' rows form one global batch array."""
+    ctx = get_context()
+    from .mesh.runtime import shard_batch_tree
+    return shard_batch_tree(batch, ctx.mesh(),
+                            rules=ctx.sharding_rules())
+
+
+def load_sharded(logical_tree: Any) -> Optional[Any]:
+    """Restore the latest committed checkpoint directly onto the group
+    mesh (mesh-reshape restore: the saved mesh shape may differ — each
+    process reads only the index slices its devices own).  Returns None
+    when the run has no checkpoint yet."""
+    ctx = get_context()
+    if not ctx._latest_checkpoint or \
+            not os.path.exists(ctx._latest_checkpoint):
+        return None
+    from .mesh.reshape import restore_to_mesh, sharding_tree
+    shardings = sharding_tree(logical_tree, ctx.mesh(),
+                              rules=ctx.sharding_rules())
+    client = ctx.checkpoint_client()
+    return restore_to_mesh(
+        ctx._latest_checkpoint, shardings,
+        loader=lambda path, placement: client.load(path,
+                                                   placement=placement),
+        # One reshape event per GROUP restore, not one per process.
+        count_reshape=ctx.get_world_rank() == 0)
 
 
 def drain_key(run_id: str) -> str:

@@ -110,7 +110,8 @@ class TrainWorker:
             slice_id=int(os.environ.get(
                 "MEGASCALE_SLICE_ID", ctx_info.get("slice_id", 0))),
             num_slices=ctx_info.get("num_slices", 1),
-            checkpoint_options=ctx_info.get("checkpoint"))
+            checkpoint_options=ctx_info.get("checkpoint"),
+            mesh_info=ctx_info.get("mesh"))
         _context.set_context(ctx)
         try:
             fn = serialization.loads_control(fn_blob)
@@ -168,6 +169,14 @@ class TrainController:
         self.scaling = scaling_config
         self.run_config = run_config
         self.run_id = uuid.uuid4().hex[:12]
+        # Fail fast on a mesh no configured world size can tile (the
+        # sizing error belongs at fit(), not one group-formation later).
+        self.mesh_config = getattr(scaling_config, "mesh_config", None)
+        if self.mesh_config is not None:
+            self.mesh_config.validate_scaling(scaling_config)
+        #: Mesh axis sizes of the current incarnation (Result.mesh; a
+        #: change between incarnations is a mesh reshape).
+        self._mesh_axes: Optional[Dict[str, int]] = None
         self.policy = make_scaling_policy(scaling_config)
         self.manager = CheckpointManager(
             run_config.storage_path, run_config.name,
@@ -207,6 +216,16 @@ class TrainController:
             env.setdefault("JAX_PLATFORMS", "cpu")
             env.setdefault("PALLAS_AXON_POOL_IPS", "")
             env.setdefault("XLA_FLAGS", "")
+            dpw = self.mesh_config.devices_per_worker \
+                if self.mesh_config is not None else 1
+            if dpw > 1:
+                # Multi-device worker processes on the CPU substrate:
+                # force XLA host-platform devices so tier-1 and the
+                # bench exercise REAL multi-device meshes (on TPU the
+                # chips-per-worker resource grant does this instead).
+                from .mesh.runtime import xla_host_device_flags
+                env["XLA_FLAGS"] = xla_host_device_flags(
+                    env.get("XLA_FLAGS"), dpw)
         if self.scaling.num_slices > 1:
             from ..accelerators.tpu import get_tpu_coordinator_env_vars
             # Slice layout follows the ACTUAL group size (elastic groups
@@ -218,10 +237,66 @@ class TrainController:
                 coordinator_address=self._megascale_addr))
         return env
 
+    def _devices_per_worker(self) -> int:
+        if self.mesh_config is not None:
+            return self.mesh_config.devices_per_worker
+        # No mesh config: TPU workers still own chips_per_worker chips
+        # (the status/Result display must not undercount them).
+        if self.scaling.use_tpu and self.scaling.chips_per_worker:
+            return self.scaling.chips_per_worker
+        return 1
+
+    def _resolved_axes(self, world: int) -> Dict[str, int]:
+        """Mesh axis sizes a group of ``world`` processes forms (raises
+        ValueError when the mesh cannot tile that world — callers treat
+        it as a formation failure)."""
+        total = world * self._devices_per_worker()
+        if self.mesh_config is not None:
+            spec = self.mesh_config.spec_for(total,
+                                             self.scaling.num_slices)
+        else:
+            from ..parallel.mesh import MeshSpec
+            spec = MeshSpec(dp=total)
+        return {a: s for a, s in spec.shape()}
+
+    def _valid_resize(self, target: int) -> int:
+        """Snap a resize target to a world size the mesh can tile (the
+        drain-to-invalid-size fix: never plan a group the MeshConfig
+        cannot factor).  Falls back to ``target`` when nothing in range
+        is valid — formation then fails into the failure budget."""
+        if self.mesh_config is None:
+            return target
+        ceiling = self.scaling.max_workers or max(
+            self.scaling.num_workers, target)
+        v = self.mesh_config.nearest_valid_world(
+            target, floor=1, ceiling=ceiling,
+            num_slices=self.scaling.num_slices)
+        return v if v is not None else target
+
+    def _note_mesh_formed(self, world: int) -> None:
+        """Record a SUCCESSFULLY formed group's mesh shape: axis gauges,
+        the reshape counter (shape changed across incarnations), the KV
+        status record `ray-tpu status` reads, and Result.mesh.  Called
+        after the gang forms — a formation attempt that dies must not
+        count as a reshape or publish a mesh that never existed."""
+        from ..util import telemetry
+        from .mesh.runtime import note_mesh_axes, publish_mesh_status
+        axes = self._resolved_axes(world)
+        if self._mesh_axes is not None and axes != self._mesh_axes:
+            telemetry.inc("ray_tpu_train_mesh_reshapes_total")
+        self._mesh_axes = axes
+        note_mesh_axes(axes)
+        publish_mesh_status(self.run_id, axes, world,
+                            self._devices_per_worker())
+
     def _start_group(self, n: Optional[int] = None) -> WorkerGroupState:
         import ray_tpu
 
         n = n if n is not None else self.scaling.num_workers
+        # The mesh must tile this world BEFORE actors spawn: a shape
+        # mismatch is a formation failure here, not a cryptic per-worker
+        # jax error after the gang formed.
+        self._resolved_axes(n)
         self._megascale_addr = f"127.0.0.1:{_free_port()}"
         resources = dict(self.scaling.resources_per_worker or {})
         if self.scaling.use_tpu and self.scaling.chips_per_worker:
@@ -506,6 +581,21 @@ class TrainController:
             "experiment_name": self.run_config.name,
             "latest_checkpoint": self.manager.latest(),
             "num_slices": self.scaling.num_slices,
+            # Resolved mesh for THIS incarnation's world: workers build
+            # the global mesh from it (train.get_mesh()).  The rules
+            # overrides ride along so every rank shards identically.
+            # Without a MeshConfig no axes are sent — the worker falls
+            # back to a dp mesh over whatever devices it actually sees
+            # (the controller cannot know a TPU worker's chip count).
+            "mesh": {
+                "axes": dict(self._mesh_axes or {})
+                    if self.mesh_config is not None else {},
+                "num_slices": self.scaling.num_slices,
+                "devices_per_worker": self._devices_per_worker(),
+                "rules": dict(self.mesh_config.rules or {})
+                    if self.mesh_config is not None else {},
+                "configured": self.mesh_config is not None,
+            },
             "checkpoint": {
                 "async_save": getattr(ckpt_cfg, "async_save", True),
                 "max_inflight": getattr(ckpt_cfg, "max_inflight", 2),
@@ -557,7 +647,11 @@ class TrainController:
                         len(self.world_size_history))
                     if error is None and not finished:
                         self.num_drains += 1
-                        resize_to = max(1, world - len(drain_ranks))
+                        # Snap to a world the mesh can tile: a drain
+                        # that strands an un-factorable worker count
+                        # must not plan an unformable group.
+                        resize_to = self._valid_resize(
+                            max(1, world - len(drain_ranks)))
                     pending = []
             # Elastic upsize check (reference: elastic.py monitor
             # decision): new capacity -> teardown + re-form the world
@@ -667,6 +761,7 @@ class TrainController:
                 group: Optional[WorkerGroupState] = None
                 try:
                     group = self._start_group(world)
+                    self._note_mesh_formed(world)
                 except Exception as e:  # noqa: BLE001 — restartable
                     # Formation failure (capacity vanished between the
                     # sizing decision and the gang forming — e.g. a node
@@ -773,4 +868,5 @@ class TrainController:
             num_drains=self.num_drains,
             world_size_history=self.world_size_history,
             goodput=self.goodput.summary(),
-            step_phases=step_phases)
+            step_phases=step_phases,
+            mesh=dict(self._mesh_axes) if self._mesh_axes else None)

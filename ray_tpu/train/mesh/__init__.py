@@ -1,0 +1,38 @@
+"""Mesh runtime: SPMD mesh formation for distributed train worker groups.
+
+The seed shipped a full SPMD stack (``ray_tpu/parallel``: MeshSpec, GPipe
+pipeline, logical-axis sharding rules; ``ray_tpu/ops``: ring/ulysses
+attention, MoE dispatch) that ``ray_tpu.train`` never used — every train
+worker group ran pure data-parallel on one device per process.  This
+package closes that seam:
+
+* ``MeshConfig`` (config.py) — declarative axis sizes (or ``auto``
+  factorization) carried on ``ScalingConfig``; validated against
+  ``num_workers x devices_per_worker`` and consulted by the elastic
+  scaling policy so the controller never forms a group the mesh cannot
+  tile.
+* runtime.py — worker-side global-mesh construction over the
+  jax.distributed world (the controller plumbs
+  ``--xla_force_host_platform_device_count`` so the CPU substrate
+  exercises real multi-device meshes), mesh telemetry gauges, and the
+  ``train.get_mesh()`` / ``train.shard()`` data-placement helpers.
+* reshape.py — the mesh's shard layout flowed into checkpoint
+  ``shard_spec``/``placement`` index algebra: a restore onto a mesh of a
+  different shape is a *mesh reshape* (each process reads only the index
+  slices its devices own), which is what lets an elastic drain/downsize
+  re-form at the nearest valid mesh factorization instead of refusing.
+"""
+
+from .config import MeshConfig
+from .reshape import (mesh_descriptor, process_index, restore_to_mesh,
+                      sharding_tree)
+from .runtime import (MESH_KV_KEY, addressable_param_bytes,
+                      build_worker_mesh, note_mesh_axes,
+                      note_param_shard_bytes, publish_mesh_status)
+
+__all__ = [
+    "MeshConfig", "build_worker_mesh", "mesh_descriptor",
+    "sharding_tree", "process_index", "restore_to_mesh",
+    "addressable_param_bytes", "note_param_shard_bytes",
+    "note_mesh_axes", "publish_mesh_status", "MESH_KV_KEY",
+]

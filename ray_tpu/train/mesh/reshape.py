@@ -1,0 +1,169 @@
+"""Mesh-reshape checkpoint restore: shard layout <-> index algebra.
+
+A mesh-sharded save needs no special casing — ``checkpoint.format
+.snapshot_tree`` decomposes jax Arrays through ``addressable_shards``,
+recording the GLOBAL index of every chunk — so the work all lives on the
+restore side: given the TARGET mesh's sharding layout, each process
+computes the index slices its devices own (``process_index``), restores
+only those byte ranges through the checkpoint index algebra, and
+reassembles per-device arrays into global jax Arrays.  Saved-mesh shape
+and target-mesh shape are independent: dp8 -> fsdp8, fsdp8 -> dp2xfsdp4,
+pp2xfsdp4 -> fsdp8 all reduce to index intersection (the
+``tests/test_train_mesh.py`` reshape matrix locks this down bit-exactly).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ...checkpoint import sharding as idx
+from ...util import telemetry
+
+#: Axis print order for descriptors ("dp2xfsdp4") — outer-to-inner, same
+#: as parallel.mesh.CANONICAL_ORDER.
+_DESC_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+def mesh_descriptor(mesh_or_axes) -> str:
+    """Canonical short name of a mesh shape: axes > 1 in outer-to-inner
+    order (``"dp2xfsdp4"``), ``"single"`` for an all-ones mesh."""
+    if isinstance(mesh_or_axes, dict):
+        axes = mesh_or_axes
+    else:
+        axes = dict(zip(mesh_or_axes.axis_names,
+                        mesh_or_axes.devices.shape))
+    parts = [f"{a}{axes[a]}" for a in _DESC_ORDER
+             if int(axes.get(a, 1)) > 1]
+    parts += [f"{a}{s}" for a, s in axes.items()
+              if a not in _DESC_ORDER and int(s) > 1]
+    return "x".join(parts) if parts else "single"
+
+
+def sharding_tree(logical_tree, mesh, rules=None):
+    """Pytree of logical-axis tuples -> pytree of NamedShardings on
+    ``mesh`` (None leaves stay None: host-side scalars/objects)."""
+    import jax
+
+    from ...parallel.sharding import default_rules, named_sharding
+    rules = rules or default_rules()
+    return jax.tree.map(
+        lambda ax: None if ax is None else named_sharding(mesh, ax, rules),
+        logical_tree,
+        is_leaf=lambda x: x is None or isinstance(x, tuple))
+
+
+def process_index(sharding, global_shape) -> Optional[idx.Index]:
+    """The (bounding-box) slice of a global array THIS process's devices
+    own under ``sharding`` — the restore placement, so a process never
+    reads checkpoint byte ranges outside its shard."""
+    if not global_shape:
+        return None
+    boxes = [idx.index_from_slices(slices, global_shape)
+             for slices in
+             sharding.addressable_devices_indices_map(
+                 tuple(int(d) for d in global_shape)).values()]
+    if not boxes:
+        return idx.full_index(global_shape)
+    return tuple(
+        (min(b[d][0] for b in boxes), max(b[d][1] for b in boxes))
+        for d in range(len(global_shape)))
+
+
+def _key_shardings(sharding_tree_) -> Dict[str, Any]:
+    import jax
+
+    from ...checkpoint.format import _key_str
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        sharding_tree_, is_leaf=lambda x: x is None or _is_sharding(x))
+    return {_key_str(path): sh for path, sh in flat}
+
+
+def _is_sharding(x) -> bool:
+    return hasattr(x, "addressable_devices_indices_map")
+
+
+def placement_for(sharding_tree_) -> Callable:
+    """checkpoint ``placement`` callable from a sharding pytree: each
+    leaf restores only the process-owned bounding box."""
+    by_key = _key_shardings(sharding_tree_)
+    def placement(key: str, global_shape) -> Optional[idx.Index]:
+        sh = by_key.get(key)
+        if sh is None or not global_shape:
+            return None
+        return process_index(sh, global_shape)
+    return placement
+
+
+def save_metrics(mesh, metrics: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Stamp the saving mesh's shape into checkpoint metrics so a later
+    restore can tell a same-shape resume from a mesh reshape.  The
+    ``"mesh"`` metrics key is RESERVED on mesh-active saves: the stamp
+    is unconditional — a user value left in its place would make every
+    restore's descriptor comparison misfire as a reshape."""
+    out = dict(metrics or {})
+    out["mesh"] = mesh_descriptor(mesh)
+    return out
+
+
+def restore_to_mesh(path: str, sharding_tree_, *,
+                    loader: Optional[Callable] = None,
+                    count_reshape: bool = True):
+    """Restore a committed checkpoint onto a (possibly different) mesh.
+
+    ``sharding_tree_``: pytree of NamedShardings (None leaves restore to
+    host values unchanged) matching the saved tree's structure.
+    ``loader(path, placement)`` overrides the raw restore (the train
+    context passes its replica-aware WorkerCheckpointClient.load).
+    ``count_reshape=False`` suppresses the reshape-counter bump — the
+    trainer path counts once per GROUP (rank 0), not once per process.
+    Returns a pytree of global jax Arrays laid out per the shardings.
+    """
+    import jax
+    import numpy as np
+
+    from ...checkpoint import format as ckpt_format
+
+    manifest = ckpt_format.read_manifest(path)
+    by_key = _key_shardings(sharding_tree_)
+    placement = placement_for(sharding_tree_)
+    if loader is not None:
+        host = loader(path, placement)
+    else:
+        host = ckpt_format.restore_tree(path, placement=placement)
+
+    saved_desc = (manifest.get("metrics") or {}).get("mesh")
+    target_mesh = next((sh.mesh for sh in by_key.values()
+                        if sh is not None), None)
+    if count_reshape and isinstance(saved_desc, str) and \
+            target_mesh is not None and \
+            saved_desc != mesh_descriptor(target_mesh):
+        telemetry.inc("ray_tpu_train_mesh_reshapes_total")
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(host)
+    leaves = []
+    for hpath, block in flat:
+        key = ckpt_format._key_str(hpath)
+        sh = by_key.get(key)
+        gshape_l = (manifest.get("leaves") or {}).get(key, {}) \
+            .get("global_shape")
+        if sh is None or gshape_l is None:
+            leaves.append(block)
+            continue
+        gshape = tuple(int(d) for d in gshape_l)
+        box = process_index(sh, gshape) or idx.full_index(gshape)
+        block = np.asarray(block)
+        per_dev = []
+        for dev, slices in sh.addressable_devices_indices_map(
+                gshape).items():
+            didx = idx.index_from_slices(slices, gshape)
+            rel = tuple(slice(lo - b0, hi - b0)
+                        for (lo, hi), (b0, _) in zip(didx, box))
+            per_dev.append(jax.device_put(
+                np.ascontiguousarray(block[rel]), dev))
+        leaves.append(jax.make_array_from_single_device_arrays(
+            gshape, sh, per_dev))
+    out = jax.tree_util.tree_unflatten(treedef, leaves)
+    from .runtime import note_param_shard_bytes
+    note_param_shard_bytes(out)
+    return out

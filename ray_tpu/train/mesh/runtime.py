@@ -1,0 +1,187 @@
+"""Worker/controller mesh runtime: build, observe, and place onto meshes.
+
+Worker side: ``build_worker_mesh`` turns the resolved MeshSpec into a
+``jax.sharding.Mesh`` over the GLOBAL device set of the jax.distributed
+world the controller formed (installed as the ambient mesh so
+``ops.ring_attention``/``parallel.pipeline`` find it), and the
+``train.shard()`` helpers place params/batches onto it.
+
+Controller side: ``publish_mesh_status`` drops the live mesh shape into
+the head KV store so ``ray-tpu status`` (and the dashboard's
+``/api/cluster/status``) show it without touching the training job.
+
+Telemetry (all declared in util/telemetry.py CATALOG, RT204):
+``ray_tpu_train_mesh_axis_size{axis}``, ``ray_tpu_train_param_shard_bytes``
+and ``ray_tpu_train_mesh_reshapes_total``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Optional
+
+from ...util import telemetry
+
+def xla_host_device_flags(flags: Optional[str], n: int) -> str:
+    """XLA_FLAGS with ``--xla_force_host_platform_device_count`` pinned
+    to ``n`` (any existing setting replaced) — the one spelling of the
+    CPU multi-device recipe, shared by the controller's worker env and
+    the bench's re-exec."""
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   flags or "")
+    return (flags.strip()
+            + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+#: KV key the controller publishes the live mesh shape under (the
+#: ``ray-tpu status`` / cluster_status "mesh" section; same last-writer
+#: pattern — and same ``diagnostics/`` namespace — as the watchdog's
+#: VERDICT_KV_KEY.  NOT under ``train/``: that namespace is
+#: consumed-and-deleted per run (RT303), while this record must outlive
+#: the run so status shows the last known shape).
+MESH_KV_KEY = "diagnostics/mesh/last"
+
+
+def build_worker_mesh(spec, devices=None):
+    """Build the global mesh for this worker's SPMD world, install it as
+    the ambient mesh, and refresh the axis-size gauges."""
+    from ...parallel.mesh import build_mesh, set_global_mesh
+    mesh = build_mesh(spec, devices)
+    set_global_mesh(mesh)
+    note_mesh_axes(dict(zip(mesh.axis_names, mesh.devices.shape)))
+    return mesh
+
+
+def note_mesh_axes(axes: Dict[str, int]) -> None:
+    for axis, size in axes.items():
+        telemetry.set_gauge("ray_tpu_train_mesh_axis_size", float(size),
+                            tags={"axis": axis})
+
+
+def addressable_param_bytes(tree) -> int:
+    """Bytes of ``tree`` this PROCESS holds: the sum over leaves of the
+    distinct addressable shards' bytes (a sharded 7B on an 8-process
+    fsdp8 mesh reports ~ total/8 per process)."""
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            total += getattr(leaf, "nbytes", 0) or 0
+            continue
+        seen = set()
+        for sh in shards:
+            idx = tuple(
+                (s.start, s.stop) for s in sh.index) if sh.index else ()
+            if idx in seen:
+                continue  # replicas of one shard count once
+            seen.add(idx)
+            total += sh.data.nbytes
+    return total
+
+
+def per_device_param_bytes(tree) -> Dict[str, int]:
+    """Bytes of ``tree`` resident per addressable device — the
+    shard-balance evidence the bench emits (max/device ~ total/N when
+    parameters are truly sharded)."""
+    import jax
+    out: Dict[str, int] = {}
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            continue
+        for sh in shards:
+            key = str(sh.device)
+            out[key] = out.get(key, 0) + sh.data.nbytes
+    return out
+
+
+def note_param_shard_bytes(tree) -> int:
+    n = addressable_param_bytes(tree)
+    telemetry.set_gauge("ray_tpu_train_param_shard_bytes", float(n))
+    return n
+
+
+def publish_mesh_status(run_id: str, axes: Dict[str, int], world: int,
+                        devices_per_worker: int) -> None:
+    """Controller-side: record the live mesh shape in the head KV (best
+    effort — status display must never fail a training run)."""
+    from .reshape import mesh_descriptor
+    try:
+        from ..._private.api import _control
+        _control("kv_put", MESH_KV_KEY, json.dumps({
+            "run_id": run_id,
+            "descriptor": mesh_descriptor(axes),
+            "axes": {a: int(s) for a, s in axes.items()},
+            "world": int(world),
+            "devices_per_worker": int(devices_per_worker),
+            "time": time.time(),
+        }).encode())
+    except Exception as e:  # noqa: BLE001 — observability is best-effort
+        telemetry.note_swallowed("train.mesh.publish_status", e)
+
+
+def read_mesh_status() -> Optional[Dict[str, Any]]:
+    """The last published mesh shape (cluster_status / `ray-tpu status`)."""
+    try:
+        from ..._private.api import _control
+        raw = _control("kv_get", MESH_KV_KEY)
+        return json.loads(raw) if raw else None
+    except Exception as e:  # noqa: BLE001
+        telemetry.note_swallowed("train.mesh.read_status", e)
+        return None
+
+
+# -- data placement helpers (train.shard / train.shard_batch) ---------------
+
+
+def shard_tree(tree, logical_tree, mesh, rules=None):
+    """Place a pytree of host arrays onto ``mesh`` per logical axes.
+
+    Works in multi-process SPMD worlds: every process passes the same
+    full host values (the usual replicated-init pattern) and each device
+    materializes only its shard via ``jax.make_array_from_callback``.
+    Refreshes ``ray_tpu_train_param_shard_bytes`` with the process's
+    resulting addressable bytes.
+    """
+    import jax
+    import numpy as np
+
+    from ...parallel.sharding import default_rules, named_sharding
+    rules = rules or default_rules()
+
+    def place(x, logical):
+        if logical is None:
+            sharding = named_sharding(mesh, (None,) * np.ndim(x), rules)
+        else:
+            sharding = named_sharding(mesh, logical, rules)
+        host = np.asarray(x)
+        # A REAL copy per shard, never a view: on the CPU substrate jax
+        # may alias the callback's buffer zero-copy, and host[idx] of a
+        # full-extent/replicated slice IS the caller's array — a later
+        # in-place write to their host tree would silently corrupt the
+        # placed device values (ascontiguousarray does not copy
+        # already-contiguous views, so it is not a guard here).
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx].copy())
+
+    out = jax.tree.map(place, tree, logical_tree,
+                       is_leaf=lambda x: x is None)
+    note_param_shard_bytes(out)
+    return out
+
+
+def shard_batch_tree(batch, mesh, rules=None):
+    """Place per-process batch leaves onto the mesh's data axes: each
+    process contributes its LOCAL rows of the global batch (leading dim
+    over (dp, fsdp), seq over sp when sized)."""
+    import jax
+
+    from ...parallel.spmd import batch_pspec
+    from jax.sharding import NamedSharding
+    sharding = NamedSharding(mesh, batch_pspec(mesh, rules))
+    return jax.tree.map(
+        lambda v: jax.make_array_from_process_local_data(sharding, v),
+        batch)

@@ -34,16 +34,28 @@ class FixedScalingPolicy:
 
 
 class ElasticScalingPolicy:
-    """Size groups to current cluster capacity in [min, max] workers."""
+    """Size groups to current cluster capacity in [min, max] workers,
+    snapped down to a world size the MeshConfig can tile (a group the
+    mesh cannot factor must never form — resizing to it would only die
+    in mesh construction and burn failure budget)."""
 
     def __init__(self, scaling_config):
         self.scaling = scaling_config
+        self.mesh = getattr(scaling_config, "mesh_config", None)
         self.min = scaling_config.min_workers or 1
         self.max = scaling_config.max_workers or max(
             scaling_config.num_workers, self.min)
         if self.min > self.max:
             raise ValueError(
                 f"min_workers ({self.min}) > max_workers ({self.max})")
+
+    def _snap(self, n: int) -> int:
+        """Largest mesh-tileable world size <= n (0 when none is)."""
+        if self.mesh is None or n <= 0:
+            return n
+        v = self.mesh.nearest_valid_world(
+            n, floor=1, num_slices=self.scaling.num_slices)
+        return v if v is not None else 0
 
     def _per_worker_resources(self) -> Dict[str, float]:
         res = dict(self.scaling.resources_per_worker or {})
@@ -64,7 +76,7 @@ class ElasticScalingPolicy:
             fit = min(fit, int(avail.get(name, 0.0) // amount))
         if fit is math.inf:
             fit = self.max
-        return max(min(int(fit), self.max), 0)
+        return self._snap(max(min(int(fit), self.max), 0))
 
     def initial_decision(self, timeout_s: float = 120.0,
                          prefer: Optional[int] = None) -> ScalingDecision:
@@ -76,11 +88,13 @@ class ElasticScalingPolicy:
         size before settling for whatever fits."""
         deadline = time.monotonic() + timeout_s
         prefer_deadline = time.monotonic() + 10.0 if prefer else None
+        prefer_target = self._snap(min(prefer, self.max)) \
+            if prefer is not None else None
         while True:
             fit = self._fit_count()
-            if prefer is not None and fit >= min(prefer, self.max):
-                return ScalingDecision(min(prefer, self.max),
-                                       f"resized to {prefer}")
+            if prefer_target is not None and fit >= prefer_target > 0:
+                return ScalingDecision(prefer_target,
+                                       f"resized to {prefer_target}")
             if fit >= self.min and (
                     prefer_deadline is None
                     or time.monotonic() > prefer_deadline):
@@ -93,9 +107,11 @@ class ElasticScalingPolicy:
 
     def monitor_decision(self, current: int) -> Optional[ScalingDecision]:
         """Upsize when new capacity appears (downsizing happens naturally
-        through the failure path when workers/nodes die)."""
+        through the failure path when workers/nodes die).  The upsize
+        target snaps down to a mesh-tileable size — growth the mesh
+        cannot use is not worth a teardown + restore."""
         headroom = self._fit_count()
-        target = min(current + headroom, self.max)
+        target = self._snap(min(current + headroom, self.max))
         if target > current:
             return ScalingDecision(
                 target, f"capacity grew: {current} -> {target}")

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ._checkpoint import Checkpoint
 from .controller import TrainController
+from .mesh.config import MeshConfig
 from .watchdog import WatchdogConfig
 
 
@@ -82,6 +83,11 @@ class ScalingConfig:
     env_per_worker: Optional[Dict[str, str]] = None
     # Form a jax.distributed world even for num_workers == 1.
     force_distributed: bool = False
+    # SPMD mesh shape for the worker group (train/mesh/config.py): axis
+    # sizes (or auto factorization) validated against num_workers x
+    # devices_per_worker at every group (re)formation.  None = the
+    # legacy pure-data-parallel path (one device per worker, no mesh).
+    mesh_config: Optional["MeshConfig"] = None
     # Elastic scaling (reference: train/v2/_internal/execution/
     # scaling_policy/elastic.py): when min/max are set, the controller
     # sizes each (re)started group to what the cluster can currently fit,
@@ -138,6 +144,9 @@ class Result:
     # data_wait / h2d / compute / collective / ckpt_block / other; see
     # ray_tpu.train.step_phase.
     step_phases: Optional[Dict[str, Any]] = None
+    # Mesh axis sizes of the final worker-group incarnation (elastic
+    # resizes re-form the mesh; world_size_history says how often).
+    mesh: Optional[Dict[str, int]] = None
 
 
 class JaxTrainer:

@@ -207,6 +207,24 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "description": "Watchdog hang verdicts: a rank produced no "
                        "report within the hang deadline (one per "
                        "incident)."},
+    "ray_tpu_train_mesh_axis_size": {
+        "type": "gauge", "tag_keys": ("axis",),
+        "description": "Live SPMD mesh axis sizes of the current train "
+                       "worker group (axis=dp|fsdp|tp|sp|ep|pp; "
+                       "refreshed at every group (re)formation — an "
+                       "elastic resize shows up as the shape changing)."},
+    "ray_tpu_train_param_shard_bytes": {
+        "type": "gauge", "tag_keys": (),
+        "description": "This process's addressable parameter-shard "
+                       "bytes after train.shard() / a mesh restore "
+                       "(~ total/N when parameters are truly sharded; "
+                       "~ total means the model is replicated)."},
+    "ray_tpu_train_mesh_reshapes_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Mesh reshape events: a worker group re-formed "
+                       "at a different mesh shape than its predecessor, "
+                       "or a checkpoint restored onto a mesh other than "
+                       "the one that saved it (resharding restore)."},
     # -- ckpt (distributed checkpointing subsystem) ------------------------
     "ray_tpu_ckpt_save_blocking_seconds": {
         "type": "histogram", "tag_keys": (),

@@ -508,6 +508,77 @@ def load(path):
                         path="ray_tpu/checkpoint/manager.py") == []
 
 
+class TestDevicePutAliasRT207:
+    BAD = """
+import jax
+import numpy as np
+
+def dispatch(sharding):
+    buf = np.zeros((8, 128), np.float32)
+    x = jax.device_put(buf, sharding)
+    buf[0] = 1.0
+    return x
+"""
+
+    GOOD = """
+import jax
+import numpy as np
+
+def dispatch(sharding):
+    buf = np.zeros((8, 128), np.float32)
+    x = jax.device_put(np.ascontiguousarray(buf), sharding)
+    buf[0] = 1.0
+    return x
+"""
+
+    def test_subscript_mutation_positive(self):
+        assert rule_ids(self.BAD, internal=True,
+                        path="ray_tpu/train/mesh/runtime.py") == ["RT207"]
+
+    def test_augassign_mutation_positive(self):
+        src = self.BAD.replace("buf[0] = 1.0", "buf += 1.0")
+        assert rule_ids(src, internal=True,
+                        path="ray_tpu/parallel/spmd.py") == ["RT207"]
+
+    def test_copy_dispatch_negative(self):
+        assert rule_ids(self.GOOD, internal=True,
+                        path="ray_tpu/train/mesh/runtime.py") == []
+
+    def test_fill_then_dispatch_negative(self):
+        # All mutation happens BEFORE the dispatch — the normal buffer
+        # init pattern; nothing can corrupt the device value.
+        src = """
+import jax
+import numpy as np
+
+def dispatch(sharding):
+    buf = np.zeros((8, 128), np.float32)
+    buf[0] = 1.0
+    return jax.device_put(buf, sharding)
+"""
+        assert rule_ids(src, internal=True,
+                        path="ray_tpu/train/mesh/runtime.py") == []
+
+    def test_rebinding_is_not_mutation(self):
+        # buf = ... after dispatch rebinds the name; the device value's
+        # aliased buffer is unchanged.
+        src = self.BAD.replace("buf[0] = 1.0", "buf = buf + 1.0")
+        assert rule_ids(src, internal=True,
+                        path="ray_tpu/train/mesh/runtime.py") == []
+
+    def test_out_of_scope_module_negative(self):
+        # Only mesh/pipeline/disagg dispatch sites are in scope.
+        assert rule_ids(self.BAD, internal=True,
+                        path="ray_tpu/serve/api.py") == []
+
+    def test_suppression(self):
+        patched = self.BAD.replace(
+            "x = jax.device_put(buf, sharding)",
+            "x = jax.device_put(buf, sharding)  # ray-tpu: noqa[RT207]")
+        assert rule_ids(patched, internal=True,
+                        path="ray_tpu/train/mesh/runtime.py") == []
+
+
 class TestProtocolCoverageRT205:
     def test_unhandled_message_positive(self, tmp_path):
         private = tmp_path / "_private"
@@ -536,6 +607,16 @@ class TestSelfLint:
         pkg = os.path.dirname(os.path.abspath(ray_tpu.__file__))
         res = lint_paths([pkg])
         assert res.files_checked > 100
+        assert res.ok, "\n" + format_text(res)
+
+    def test_train_mesh_subsystem_is_covered(self):
+        """train/mesh/ is inside the self-lint gate from day one: its
+        files are walked with the internal (RT2xx/RT3xx) rules on, and
+        they pass clean."""
+        import ray_tpu
+        pkg = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+        res = lint_paths([os.path.join(pkg, "train", "mesh")])
+        assert res.files_checked >= 4
         assert res.ok, "\n" + format_text(res)
 
     def test_bad_corpus_fails(self):

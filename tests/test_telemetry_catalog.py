@@ -142,6 +142,27 @@ class TestCatalog:
         telemetry.observe("ray_tpu_llm_kv_transfer_seconds", 0.0,
                           tags={"op": "export"})
 
+    def test_mesh_series_registered(self):
+        """The mesh-runtime series (train/mesh: live axis sizes,
+        per-process parameter shard bytes, reshape events) are declared
+        in the catalog — RT204 lints every call site against it."""
+        specs = {
+            "ray_tpu_train_mesh_axis_size": ("gauge", ("axis",)),
+            "ray_tpu_train_param_shard_bytes": ("gauge", ()),
+            "ray_tpu_train_mesh_reshapes_total": ("counter", ()),
+        }
+        for name, (typ, tags) in specs.items():
+            assert name in telemetry.CATALOG, name
+            assert telemetry.CATALOG[name]["type"] == typ, name
+            assert tuple(telemetry.CATALOG[name]["tag_keys"]) == tags
+            assert telemetry.CATALOG[name]["description"].strip(), name
+            assert name.split("_")[2] == "train", name
+        # The exception-safe helpers record them without raising.
+        telemetry.set_gauge("ray_tpu_train_mesh_axis_size", 8.0,
+                            tags={"axis": "fsdp"})
+        telemetry.set_gauge("ray_tpu_train_param_shard_bytes", 0.0)
+        telemetry.inc("ray_tpu_train_mesh_reshapes_total", 0.0)
+
     def test_profiler_series_registered(self):
         """The profiler subsystem's series (PR 10: step-phase
         attribution, HBM gauges, compile accounting, capture counter)

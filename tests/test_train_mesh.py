@@ -1,0 +1,345 @@
+"""Mesh runtime tests: MeshConfig validation/factorization, elastic
+sizing snapped to mesh-tileable worlds (the drain-to-invalid-size fix),
+the mesh-reshape restore matrix at the checkpoint-format level, and a
+2-worker trainer e2e under ``xla_force_host_platform_device_count`` that
+saves on one mesh shape and restores — bit-exactly — onto another, each
+process reading only the index slices its devices own."""
+
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from ray_tpu.train import MeshConfig
+from ray_tpu.train.mesh import reshape as R
+
+
+class TestMeshConfig:
+    def test_parse(self):
+        mc = MeshConfig.parse("dp2xfsdp4")
+        assert (mc.dp, mc.fsdp) == (2, 4)
+        assert MeshConfig.parse("auto").auto
+        mc = MeshConfig.parse("pp2xfsdp4")
+        assert (mc.pp, mc.fsdp) == (2, 4)
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            MeshConfig.parse("dp2xbogus4")
+        with pytest.raises(ValueError):
+            MeshConfig.parse("dp2xdp4")  # repeated axis
+
+    def test_spec_resolution_and_absorb(self):
+        spec = MeshConfig.parse("dp2xfsdp4").spec_for(8)
+        assert (spec.dp, spec.fsdp) == (2, 4)
+        spec = MeshConfig(dp=-1, fsdp=2).spec_for(6)
+        assert (spec.dp, spec.fsdp) == (3, 2)
+        with pytest.raises(ValueError):
+            MeshConfig.parse("dp2xfsdp4").spec_for(6)
+
+    def test_auto_factorization(self):
+        # fsdp = largest divisor <= 8 (one host's ICI domain), dp rest.
+        spec = MeshConfig(auto=True).spec_for(8)
+        assert (spec.dp, spec.fsdp) == (1, 8)
+        spec = MeshConfig(auto=True).spec_for(16)
+        assert (spec.dp, spec.fsdp) == (2, 8)
+        spec = MeshConfig(auto=True).spec_for(12)
+        assert (spec.dp, spec.fsdp) == (2, 6)
+        # Multi-slice: dp must stay divisible by num_slices.
+        spec = MeshConfig(auto=True).spec_for(16, num_slices=2)
+        assert spec.dp % 2 == 0
+
+    def test_valid_and_nearest_world(self):
+        mc = MeshConfig(dp=-1, fsdp=2)
+        assert [w for w in range(1, 9) if mc.valid_world(w)] == [2, 4, 6, 8]
+        # The drain-to-invalid-size case: 3 survivors snap DOWN to 2.
+        assert mc.nearest_valid_world(3) == 2
+        # Nothing valid below: snap UP within the ceiling.
+        assert mc.nearest_valid_world(1, ceiling=4) == 2
+        assert mc.nearest_valid_world(1) is None
+
+    def test_devices_per_worker_scales_tiling(self):
+        mc = MeshConfig(fsdp=8, devices_per_worker=4)
+        assert mc.valid_world(2)        # 2 workers x 4 devices = fsdp8
+        assert not mc.valid_world(3)
+
+    def test_validate_scaling_fails_fast(self):
+        from ray_tpu.train import ScalingConfig
+        mc = MeshConfig(fsdp=8)
+        with pytest.raises(ValueError):
+            mc.validate_scaling(ScalingConfig(num_workers=6))
+        # Elastic range containing no tileable world.
+        with pytest.raises(ValueError):
+            MeshConfig(fsdp=8).validate_scaling(
+                ScalingConfig(min_workers=2, max_workers=5))
+        # A tileable size inside the range passes.
+        MeshConfig(fsdp=4).validate_scaling(
+            ScalingConfig(min_workers=2, max_workers=5))
+
+    def test_rules_overrides(self):
+        rules = MeshConfig(tp=4, rules={"embed": "tp",
+                                        "heads": None}).sharding_rules()
+        assert rules.axes_for("embed") == "tp"
+        assert rules.axes_for("heads") is None
+        assert rules.axes_for("mlp") == "tp"  # default untouched
+
+
+class TestElasticMeshSizing:
+    """Elastic sizing must never plan a group the mesh cannot tile."""
+
+    def _scaling(self, **kw):
+        from ray_tpu.train import ScalingConfig
+        kw.setdefault("mesh_config", MeshConfig(dp=-1, fsdp=2))
+        kw.setdefault("min_workers", 2)
+        kw.setdefault("max_workers", 8)
+        return ScalingConfig(resources_per_worker={"CPU": 1}, **kw)
+
+    def test_fit_count_snaps_to_valid_world(self, monkeypatch):
+        import ray_tpu
+        from ray_tpu.train.scaling_policy import ElasticScalingPolicy
+        policy = ElasticScalingPolicy(self._scaling())
+        monkeypatch.setattr(ray_tpu, "available_resources",
+                            lambda: {"CPU": 5.0})
+        assert policy._fit_count() == 4  # 5 fit, snapped to 4
+
+    def test_monitor_decision_skips_unusable_growth(self, monkeypatch):
+        import ray_tpu
+        from ray_tpu.train.scaling_policy import ElasticScalingPolicy
+        policy = ElasticScalingPolicy(self._scaling())
+        # One more CPU than the current world: 5 total, but 5 is not
+        # tileable — growth the mesh cannot use is not worth a restart.
+        monkeypatch.setattr(ray_tpu, "available_resources",
+                            lambda: {"CPU": 1.0})
+        assert policy.monitor_decision(4) is None
+
+    def test_controller_drain_resize_snaps(self, tmp_path):
+        """Regression: a drain leaving an un-factorable worker count
+        must downsize to the nearest valid mesh world, not refuse (or
+        form a group that dies in mesh construction)."""
+        from ray_tpu.train import RunConfig
+        from ray_tpu.train.controller import TrainController
+        controller = TrainController(
+            lambda: None, None,
+            self._scaling(),
+            RunConfig(name="snap", storage_path=str(tmp_path)))
+        assert controller._valid_resize(3) == 2
+        assert controller._valid_resize(4) == 4
+        # Nothing valid at or below the target: snap up to the ceiling.
+        assert controller._valid_resize(1) == 2
+
+    def test_controller_worker_env_forces_host_devices(self, tmp_path):
+        from ray_tpu.train import RunConfig, ScalingConfig
+        from ray_tpu.train.controller import TrainController
+        controller = TrainController(
+            lambda: None, None,
+            ScalingConfig(num_workers=2,
+                          mesh_config=MeshConfig(
+                              fsdp=-1, devices_per_worker=3)),
+            RunConfig(name="env", storage_path=str(tmp_path)))
+        env = controller._worker_env(0, 2)
+        assert "--xla_force_host_platform_device_count=3" \
+            in env["XLA_FLAGS"]
+
+    def test_controller_resolved_axes_fallback(self, tmp_path):
+        """Without a MeshConfig the resolved mesh is pure dp (the
+        legacy path, now visible in Result.mesh / `ray-tpu status`)."""
+        from ray_tpu.train import RunConfig, ScalingConfig
+        from ray_tpu.train.controller import TrainController
+        controller = TrainController(
+            lambda: None, None, ScalingConfig(num_workers=4),
+            RunConfig(name="dponly", storage_path=str(tmp_path)))
+        axes = controller._resolved_axes(4)
+        assert axes["dp"] == 4
+        assert all(s == 1 for a, s in axes.items() if a != "dp")
+
+
+def _build_meshes(desc_a: str, desc_b: str):
+    import jax
+
+    from ray_tpu.parallel import build_mesh
+    devices = jax.devices()[:8]
+    return (build_mesh(MeshConfig.parse(desc_a).spec_for(8), devices),
+            build_mesh(MeshConfig.parse(desc_b).spec_for(8), devices))
+
+
+_LOGICAL = {"w": ("embed", None), "stacked": ("layers", "embed", None),
+            "b": (None,), "step": None}
+
+
+def _host_tree():
+    return {"w": np.arange(64, dtype=np.float32).reshape(8, 8),
+            "stacked": np.arange(256, dtype=np.float32).reshape(4, 8, 8),
+            "b": np.arange(8, dtype=np.float32), "step": 7}
+
+
+def _save_on_mesh(mesh, dirpath, rules=None):
+    """Single-process world=1 sharded save: snapshot decomposes the jax
+    Arrays through addressable_shards, recording global indexes."""
+    from ray_tpu.checkpoint import format as F
+    from ray_tpu.train.mesh.runtime import shard_tree
+    host = _host_tree()
+    tree = shard_tree({k: host[k] for k in ("w", "stacked", "b")},
+                      {k: _LOGICAL[k] for k in ("w", "stacked", "b")},
+                      mesh, rules=rules)
+    tree["step"] = host["step"]
+    snap = F.snapshot_tree(tree)
+    index, blob = F.build_shard(snap, 0, 1, 0)
+    F.write_shard(dirpath, index, blob, skeleton_pkl=snap.skeleton_pkl)
+    manifest = F.build_manifest(dirpath, 0, 1,
+                                metrics=R.save_metrics(mesh))
+    F.commit_manifest(dirpath, manifest)
+    return host
+
+
+class TestMeshReshapeMatrix:
+    """Checkpoint-format-level reshape restores, bit-exact across the
+    {dp8 -> fsdp8, fsdp8 -> dp2xfsdp4, pp2xfsdp4 -> fsdp8} matrix."""
+
+    @pytest.mark.parametrize("desc_a,desc_b", [
+        ("dp8", "fsdp8"),
+        ("fsdp8", "dp2xfsdp4"),
+        ("pp2xfsdp4", "fsdp8"),
+    ])
+    def test_reshape_bit_exact(self, desc_a, desc_b, tmp_path):
+        from ray_tpu.parallel.sharding import default_rules
+        mesh_a, mesh_b = _build_meshes(desc_a, desc_b)
+
+        def rules_for(desc):
+            # pp meshes shard the stacked layer axis over pp (the GPipe
+            # resident-stage layout, parallel/pipeline.py).
+            return default_rules().replace(layers="pp") \
+                if "pp" in desc else default_rules()
+
+        host = _save_on_mesh(mesh_a, str(tmp_path), rules=rules_for(desc_a))
+        shardings = R.sharding_tree(_LOGICAL, mesh_b,
+                                    rules=rules_for(desc_b))
+        out = R.restore_to_mesh(str(tmp_path), shardings)
+        for key in ("w", "stacked", "b"):
+            np.testing.assert_array_equal(np.asarray(out[key]), host[key])
+        assert out["step"] == 7
+
+    def test_reshape_counter_bumps_only_across_shapes(self, tmp_path):
+        from ray_tpu.util import metrics as metrics_mod
+        metrics_mod._reset_for_tests()
+        mesh_a, mesh_b = _build_meshes("fsdp8", "dp2xfsdp4")
+        _save_on_mesh(mesh_a, str(tmp_path))
+        # Same-shape restore: no reshape.
+        R.restore_to_mesh(str(tmp_path), R.sharding_tree(_LOGICAL, mesh_a))
+        text = metrics_mod.prometheus_text()
+        assert "ray_tpu_train_mesh_reshapes_total 1.0" not in text
+        # Cross-shape restore: one reshape event.
+        R.restore_to_mesh(str(tmp_path), R.sharding_tree(_LOGICAL, mesh_b))
+        text = metrics_mod.prometheus_text()
+        assert "ray_tpu_train_mesh_reshapes_total 1.0" in text
+        metrics_mod._reset_for_tests()
+
+    def test_param_shard_bytes_gauge(self, tmp_path):
+        from ray_tpu.train.mesh.runtime import (addressable_param_bytes,
+                                                shard_tree)
+        mesh, _ = _build_meshes("fsdp8", "dp8")
+        host = _host_tree()
+        tree = shard_tree({"w": host["w"]}, {"w": ("embed", None)}, mesh)
+        # Single process owns all 8 devices -> addressable == total, but
+        # per-DEVICE bytes must be ~ total/8 for the sharded leaf.
+        from ray_tpu.train.mesh.runtime import per_device_param_bytes
+        per_dev = per_device_param_bytes(tree)
+        assert len(per_dev) == 8
+        assert all(b == host["w"].nbytes // 8 for b in per_dev.values())
+        assert addressable_param_bytes(tree) == host["w"].nbytes
+
+    def test_descriptor(self):
+        assert R.mesh_descriptor({"dp": 2, "fsdp": 4, "tp": 1}) \
+            == "dp2xfsdp4"
+        assert R.mesh_descriptor({"dp": 1, "fsdp": 1}) == "single"
+        assert R.mesh_descriptor({"pp": 2, "fsdp": 4}) == "pp2xfsdp4"
+
+
+def _mesh_save_fn(config=None):
+    import numpy as np
+
+    import ray_tpu.train as train
+
+    mesh = train.get_mesh()
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    b = np.arange(8, dtype=np.float32)
+    tree = train.shard({"w": w, "b": b},
+                       {"w": ("embed", None), "b": (None,)})
+    train.save_checkpoint(tree, metrics={"step": 1})
+    train.report({"fsdp": axes["fsdp"], "step": 1})
+
+
+def _mesh_restore_fn(config=None):
+    import jax
+    import numpy as np
+
+    import ray_tpu.train as train
+    from ray_tpu.checkpoint.sharding import index_size
+    from ray_tpu.train.mesh import reshape as R
+
+    ctx = train.get_context()
+    mesh = train.get_mesh()
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert axes["fsdp"] == 4, axes
+    assert len(jax.devices()) == 4      # 2 workers x 2 forced devices
+    assert jax.local_device_count() == 2
+
+    logical = {"w": ("embed", None), "b": (None,)}
+    tree = train.load_sharded(logical)
+    assert tree is not None
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    # Bit-exact per addressable shard (device_get of the full global
+    # array is impossible here: half of it lives on the peer process).
+    for sh in tree["w"].addressable_shards:
+        np.testing.assert_array_equal(np.asarray(sh.data), w[sh.index])
+    # Ownership: this process's restore placement is a strict subset —
+    # exactly its half of the rows — so it never read the peer's slices.
+    box = R.process_index(
+        R.sharding_tree(logical, mesh)["w"], w.shape)
+    assert index_size(box) * 2 == w.size, box
+    train.report({"step": 2, "rows": box[0][1] - box[0][0]})
+
+
+class TestTrainerMeshE2E:
+    def test_two_worker_reshape_restore(self, ray_start):
+        """Save on a 2-process fsdp2 mesh (one device each), restore on
+        a 2-process fsdp4 mesh (two forced host devices each): an
+        elastic-style mesh reshape through the real trainer path."""
+        from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+        with tempfile.TemporaryDirectory() as tmp:
+            save = JaxTrainer(
+                _mesh_save_fn,
+                scaling_config=ScalingConfig(
+                    num_workers=2,
+                    mesh_config=MeshConfig(fsdp=-1)),
+                run_config=RunConfig(name="mesh_e2e",
+                                     storage_path=tmp)).fit()
+            assert save.error is None
+            assert save.mesh and save.mesh["fsdp"] == 2
+            assert {r["metrics"].get("fsdp")
+                    for r in save.all_reports} == {2}
+
+            restore = JaxTrainer(
+                _mesh_restore_fn,
+                scaling_config=ScalingConfig(
+                    num_workers=2,
+                    mesh_config=MeshConfig(fsdp=-1,
+                                           devices_per_worker=2)),
+                run_config=RunConfig(name="mesh_e2e",
+                                     storage_path=tmp)).fit()
+            assert restore.error is None
+            assert restore.mesh and restore.mesh["fsdp"] == 4
+            rows = [r["metrics"]["rows"] for r in restore.all_reports
+                    if "rows" in r["metrics"]]
+            assert rows == [4, 4]  # each process owned half the rows
+
+    def test_mesh_status_published(self, ray_start):
+        from ray_tpu.train.mesh.runtime import (publish_mesh_status,
+                                                read_mesh_status)
+        publish_mesh_status("testrun", {"dp": 2, "fsdp": 4}, 2, 4)
+        status = read_mesh_status()
+        assert status is not None
+        assert status["descriptor"] == "dp2xfsdp4"
+        assert status["world"] == 2
+        assert status["devices_per_worker"] == 4
