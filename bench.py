@@ -618,7 +618,8 @@ def _preempt_lost_steps(reports) -> int:
 
 
 def _fit_under_chaos(trainer, runner, min_step: int = 2,
-                     arm_timeout_s: float = 90.0):
+                     arm_timeout_s: float = 90.0,
+                     join_timeout_s: Optional[float] = None):
     """fit() with the chaos schedule armed only once training has made
     real progress (reported step >= min_step): every mode's fault lands
     mid-step-loop, not in the formation race, so the three recovery
@@ -637,7 +638,10 @@ def _fit_under_chaos(trainer, runner, min_step: int = 2,
         except BaseException as e:  # noqa: BLE001 — surfaced below
             box["raised"] = e
 
-    t = threading.Thread(target=run, name="bench-preempt-fit")
+    # Daemon: an abandoned fit (join timeout below) must not block
+    # interpreter exit — the raise is the hard wall, not the thread.
+    t = threading.Thread(target=run, name="bench-preempt-fit",
+                         daemon=True)
     t.start()
     deadline = time.monotonic() + arm_timeout_s
     while time.monotonic() < deadline and t.is_alive():
@@ -646,7 +650,10 @@ def _fit_under_chaos(trainer, runner, min_step: int = 2,
             break
         time.sleep(0.1)
     runner.start()  # t=0 of the schedule = "progress observed"
-    t.join()
+    t.join(timeout=join_timeout_s)
+    if t.is_alive():
+        raise TimeoutError(
+            f"fit under chaos still running after {join_timeout_s}s")
     if "raised" in box:
         raise box["raised"]
     return box["result"]
@@ -814,6 +821,534 @@ def bench_preempt(fast: bool = False) -> None:
           file=sys.stderr)
     if not doc["sla"]["pass"]:
         raise SystemExit(1)
+
+
+def _spotfleet_train_fn(config):
+    """Per-worker loop for the spot-fleet bench: a fixed GLOBAL amount
+    of work per step split evenly over the live world (the dp truth —
+    half the fleet means twice the wall per step), one saved+reported
+    step at a time, resumable from the sharded checkpoints.  Reports
+    carry the world size so the bench can account fleet-scaled goodput
+    from the report stream."""
+    import time as _t
+
+    import numpy as np
+
+    import ray_tpu.train as train
+    from ray_tpu._private.api import _control
+
+    ctx = train.get_context()
+    world = ctx.get_world_size()
+
+    def barrier(step):
+        prefix = f"sfsync/{ctx.experiment_name}/{step}/"
+        _control("kv_put", prefix + str(ctx.get_world_rank()), b"1")
+        deadline = _t.monotonic() + 60
+        while _t.monotonic() < deadline:
+            if len(_control("kv_keys", prefix)) >= world:
+                return
+            _t.sleep(0.02)
+
+    state = train.load_checkpoint()
+    start = 0 if state is None else int(state["step"])
+    w = np.zeros((64,), np.float32) if state is None else state["w"]
+    for step in range(start, config["steps"]):
+        _t.sleep(config["work_s"] / max(1, world))
+        w = w + 1.0
+        train.save_checkpoint({"w": w, "step": step + 1},
+                              metrics={"step": step + 1})
+        train.report({"step": step + 1, "start": start, "world": world})
+        barrier(step)
+
+
+def _run_spotfleet_mode(mode: str, *, seed: int, steps: int,
+                        work_s: float, rate: float, horizon_s: float,
+                        deadline_range, no_notice_frac: float,
+                        boot_delay_s: float, fleet: int,
+                        write_delay: float) -> dict:
+    """One recovery policy under the identical seeded spot-market
+    schedule: an autoscaler-managed fleet of subprocess nodes churns
+    continuously (Poisson preempts with jittered deadlines, occasional
+    no-notice kills) while an elastic train run rides it.
+
+    ``graceful`` attaches the GoodputAutoscalePolicy (pre-buy on notice,
+    buy on goodput sag) and lets the trainer upsize at checkpoint
+    boundaries; ``naive`` is the preemption-naive reconciler — no
+    pre-buy, no upsize — so every loss shrinks the fleet for good."""
+    import shutil
+    import tempfile
+    import threading
+
+    import ray_tpu
+    from ray_tpu.autoscaler import (Autoscaler, AutoscalerConfig,
+                                    GoodputAutoscalePolicy,
+                                    GoodputPolicyConfig,
+                                    LocalSubprocessProvider,
+                                    NodeTypeConfig)
+    from ray_tpu.devtools.chaos import ChaosRunner, ChaosSchedule
+    from ray_tpu.train import (CheckpointConfig, FailureConfig,
+                               JaxTrainer, MeshConfig, RunConfig,
+                               ScalingConfig)
+
+    graceful = mode == "graceful"
+    store = tempfile.mkdtemp(prefix=f"bench_spotfleet_{mode}_")
+    token = b"sftok"
+    # Prompt death fan-out: a spot reclaim is not a network blip, and
+    # the reconnect grace window would stall the surviving ranks'
+    # lockstep barrier (and ghost freshly-killed nodes in the victim
+    # picker) for its full duration after every kill.
+    os.environ["RAY_TPU_NODE_RECONNECT_GRACE_S"] = "0"
+    rt = ray_tpu.init(num_cpus=0, num_tpus=0, head_port=0,
+                      cluster_token=token)
+    provider = LocalSubprocessProvider(rt.head_server.address, token,
+                                       boot_delay_s=boot_delay_s)
+    policy = None
+    if graceful:
+        policy = GoodputAutoscalePolicy(GoodputPolicyConfig(
+            goodput_floor=0.6, sustain_s=2.0, cooldown_s=8.0,
+            window_s=12.0, max_pending_prebuys=2,
+            default_node_type="spot"))
+    # max_workers == fleet: buys only ever REPLACE lost/doomed capacity
+    # (pre-buy headroom comes from discounting draining victims), so
+    # goodput-sag buys fire exactly when the fleet is short — after a
+    # no-notice kill — and the two arms face identical victim odds.
+    asc = Autoscaler(rt, provider, AutoscalerConfig(
+        node_types={"spot": NodeTypeConfig(
+            resources={"CPU": 2}, min_workers=fleet,
+            max_workers=fleet)},
+        idle_timeout_s=3600.0, update_interval_s=0.25, policy=policy))
+
+    def alive_workers():
+        return {n.node_id.hex() for n in rt.controller.alive_nodes()
+                if not n.is_head}
+
+    # Membership samples for the join-before-deadline evidence.
+    samples: list = []
+    stop_sampling = threading.Event()
+
+    def sampler():
+        while not stop_sampling.is_set():
+            samples.append((time.monotonic(), frozenset(alive_workers())))
+            stop_sampling.wait(0.1)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True,
+                                 name=f"spotfleet-sampler-{mode}")
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and \
+                len(alive_workers()) < fleet:
+            time.sleep(0.1)
+        if len(alive_workers()) < fleet:
+            raise RuntimeError(
+                f"initial fleet never formed: {len(alive_workers())}"
+                f"/{fleet}")
+        sampler_t.start()
+        env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+               "XLA_FLAGS": "",
+               "RAY_TPU_CKPT_TEST_WRITE_DELAY_S": str(write_delay)}
+        trainer = JaxTrainer(
+            _spotfleet_train_fn,
+            train_loop_config={"steps": steps, "work_s": work_s},
+            scaling_config=ScalingConfig(
+                resources_per_worker={"CPU": 2},  # one worker per node
+                min_workers=1, max_workers=fleet,
+                elastic_check_interval_s=1.0 if graceful else 3600.0,
+                mesh_config=MeshConfig(dp=-1),
+                formation_timeout_s=30.0,
+                env_per_worker=env),
+            run_config=RunConfig(
+                name=f"bench_spotfleet_{mode}", storage_path=store,
+                failure_config=FailureConfig(
+                    max_failures=30, failure_window_s=60.0,
+                    restart_backoff_initial_s=0.2),
+                checkpoint_config=CheckpointConfig(
+                    async_save=True, max_inflight=2)))
+        schedule = ChaosSchedule.spot_fleet(
+            seed, rate, horizon_s, deadline_range=deadline_range,
+            no_notice_frac=no_notice_frac)
+        runner = ChaosRunner(None, schedule, name=mode,
+                             provider=provider, victim_seed=seed)
+        t0 = time.monotonic()
+        try:
+            res = _fit_under_chaos(trainer, runner, min_step=2,
+                                   arm_timeout_s=120.0,
+                                   join_timeout_s=300.0)
+        finally:
+            runner.stop()
+        wall_s = time.monotonic() - t0
+        reports = list(res.all_reports)
+        lost_steps = _preempt_lost_steps(reports)
+        unique_steps = len({r["metrics"]["step"] for r in reports
+                            if r["rank"] == 0
+                            and "step" in r["metrics"]})
+        # Fleet-scaled goodput: useful work delivered (each step is
+        # ``work_s`` chip-seconds by construction, regardless of the
+        # world that ran it) over the full-fleet chip-seconds the wall
+        # clock offered.  A policy that keeps the fleet whole converts
+        # more of the wall into work; one limping at n-1 (or 1) sags.
+        scaled_goodput = (unique_steps * work_s) / (wall_s * fleet) \
+            if wall_s > 0 else 0.0
+        worlds = [r["metrics"]["world"] for r in reports
+                  if r["rank"] == 0 and "world" in r["metrics"]]
+        # Join-before-deadline: for every noticed preempt, did a node
+        # that was NOT alive at notice time join before the advertised
+        # kill deadline?  (The pre-buy's whole point.)
+        prebuy_windows = []
+        for rec in runner.log:
+            if rec["action"] != "drain" or not rec["ok"] \
+                    or rec.get("skipped"):
+                continue
+            t_notice = t0 + rec["fired_s"]
+            t_kill = t_notice + next(
+                (e.deadline_s for e in schedule.events
+                 if e.action == "preempt"
+                 and abs(e.at_s - rec["at_s"]) < 1e-6), 0.0)
+            base = None
+            joined_at = None
+            for t, members in samples:
+                if t <= t_notice:
+                    base = members
+                elif base is not None and members - base:
+                    joined_at = t
+                    break
+            prebuy_windows.append({
+                "deadline_s": round(t_kill - t_notice, 3),
+                "join_after_notice_s":
+                    round(joined_at - t_notice, 3)
+                    if joined_at is not None else None,
+                "joined_before_deadline":
+                    joined_at is not None and joined_at < t_kill,
+            })
+        status = asc.status()
+        return {
+            "mode": mode,
+            "error": repr(res.error) if res.error else None,
+            "completed": res.error is None
+            and res.metrics.get("step") == steps,
+            "final_step": res.metrics.get("step"),
+            "world_size_history": res.world_size_history,
+            "mean_reported_world": round(sum(worlds) / len(worlds), 3)
+            if worlds else 0.0,
+            "num_failures": res.num_failures,
+            "num_drains": res.num_drains,
+            "lost_steps": lost_steps,
+            "lost_step_ratio": round(lost_steps / steps, 4),
+            "scaled_goodput": round(scaled_goodput, 4),
+            "goodput_ratio": round(
+                (res.goodput or {}).get("goodput_ratio", 0.0), 4),
+            "prebuy_total": status.get("prebuy_total", 0),
+            "prebuy_windows": prebuy_windows,
+            "chaos_log": list(runner.log),
+            "wall_s": round(wall_s, 2),
+        }
+    finally:
+        stop_sampling.set()
+        if sampler_t.is_alive():
+            sampler_t.join(timeout=5)
+        asc.stop()
+        provider.shutdown()
+        ray_tpu.shutdown()
+        shutil.rmtree(store, ignore_errors=True)
+
+
+def _spotfleet_prebuy_timing() -> dict:
+    """Deterministic pre-buy timing over the declarative layer: a
+    FakeCloudProvider posts a preemption notice and the InstanceManager
+    must REQUEST the replacement on its next pass and have it RUNNING
+    before the victim's deadline (provisioning time << deadline here, as
+    on a spot market with capacity)."""
+    from ray_tpu.autoscaler.instance_manager import (FakeCloudProvider,
+                                                     InstanceManager,
+                                                     JOINED, RUNNING)
+
+    provider = FakeCloudProvider(run_delay_s=0.4)
+    mgr = InstanceManager(provider, drain_hook=lambda *a: None,
+                          prebuy=True, max_pending_prebuys=2)
+    desired = {"tpu": 2}
+    deadline_s = 5.0
+    # Converge to steady state.
+    t_end = time.monotonic() + 10
+    while time.monotonic() < t_end:
+        mgr.reconcile(desired)
+        insts = [i for i in mgr.store.alive() if i.status == RUNNING]
+        if len(insts) == 2:
+            break
+        time.sleep(0.05)
+    victim = next(i for i in mgr.store.alive() if i.status == RUNNING)
+    n_before = len(provider.request_log)
+    t_notice = time.monotonic()
+    provider.preempt_notice(victim.cloud_id, deadline_s=deadline_s)
+    t_request = t_running = None
+    t_end = time.monotonic() + deadline_s + 5
+    while time.monotonic() < t_end:
+        mgr.reconcile(desired)
+        if t_request is None and len(provider.request_log) > n_before:
+            t_request = time.monotonic()
+        fresh = [i for i in mgr.store.alive()
+                 if i.status in (RUNNING, JOINED)
+                 and i.cloud_id != victim.cloud_id
+                 and i.instance_id != victim.instance_id
+                 and i.request_id != victim.request_id]
+        if t_request is not None and fresh:
+            t_running = time.monotonic()
+            break
+        time.sleep(0.05)
+    # The victim then actually dies; the fleet is already whole.
+    provider.lose_instance(victim.cloud_id)
+    mgr.reconcile(desired)
+    return {
+        "deadline_s": deadline_s,
+        "notice_to_request_s": round(t_request - t_notice, 3)
+        if t_request else None,
+        "notice_to_running_s": round(t_running - t_notice, 3)
+        if t_running else None,
+        "replacement_running_before_deadline":
+            t_running is not None
+            and (t_running - t_notice) < deadline_s,
+    }
+
+
+def _spotfleet_multislice() -> dict:
+    """Slice-granular drain scenario: a 2-slice SlicePlacementGroup, one
+    slice preempted via ``drain_slice`` — the other slice's committed
+    bundles must never move, the train group reshapes its dp mesh across
+    the survivors, and the graceful path loses 0 steps."""
+    import shutil
+    import tempfile
+    import threading
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import (CheckpointConfig, FailureConfig,
+                               JaxTrainer, MeshConfig, RunConfig,
+                               ScalingConfig)
+    from ray_tpu.util.tpu import slice_placement_group
+
+    steps, work_s, deadline_s = 14, 0.8, 6.0
+    store = tempfile.mkdtemp(prefix="bench_spotfleet_slice_")
+    os.environ["RAY_TPU_NODE_RECONNECT_GRACE_S"] = "0"
+    cluster = Cluster(head_num_cpus=0)
+    try:
+        nodes = [cluster.add_node(num_cpus=2, num_tpus=4,
+                                  resources={"TPU-v4-head": 1.0})
+                 for _ in range(4)]
+        spg = slice_placement_group("v4-8", num_slices=2)
+        assert spg.ready(timeout=60), "slice reservation never committed"
+        slice_nodes = [spg.slice_nodes(0), spg.slice_nodes(1)]
+        survivor_before = list(slice_nodes[1])
+        env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+               "XLA_FLAGS": "",
+               "RAY_TPU_CKPT_TEST_WRITE_DELAY_S": "0.15"}
+        trainer = JaxTrainer(
+            _spotfleet_train_fn,
+            train_loop_config={"steps": steps, "work_s": work_s},
+            scaling_config=ScalingConfig(
+                resources_per_worker={"CPU": 2},
+                min_workers=1, max_workers=4,
+                elastic_check_interval_s=3600,
+                mesh_config=MeshConfig(dp=-1),
+                formation_timeout_s=60.0,
+                env_per_worker=env),
+            run_config=RunConfig(
+                name="bench_spotfleet_slice", storage_path=store,
+                failure_config=FailureConfig(
+                    max_failures=2, restart_backoff_initial_s=0.2),
+                checkpoint_config=CheckpointConfig(
+                    async_save=True, max_inflight=2)))
+        from ray_tpu.train.controller import TrainController
+        controller = TrainController(trainer._train_fn, trainer._config,
+                                     trainer._scaling,
+                                     trainer._run_config)
+        box: dict = {}
+
+        def run():
+            try:
+                box["result"] = controller.run()
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                box["raised"] = e
+
+        t = threading.Thread(target=run, name="spotfleet-slice-fit",
+                             daemon=True)
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and t.is_alive():
+            if any(r["metrics"].get("step", 0) >= 2
+                   for r in controller._reports):
+                break
+            time.sleep(0.1)
+        # Preempt slice 0 only: per-slice drain, then the cloud's kill
+        # at the advertised deadline.
+        drained = spg.drain_slice(0, deadline_s=deadline_s,
+                                  reason="spot-preemption")
+        time.sleep(deadline_s)
+        by_hex = {h.node_id: h for h in nodes if h.node_id}
+        for hexid in drained:
+            h = by_hex.get(hexid)
+            if h is not None and h.alive:
+                cluster.remove_node(h, wait_dead=True)
+        t.join(timeout=180)
+        if t.is_alive():
+            raise TimeoutError(
+                "multislice scenario still running after 180s")
+        if "raised" in box:
+            raise box["raised"]
+        res = box["result"]
+        survivor_after = spg.slice_nodes(1)
+        lost = _preempt_lost_steps(res.all_reports)
+        return {
+            "drained_nodes": len(drained),
+            "error": repr(res.error) if res.error else None,
+            "completed": res.error is None
+            and res.metrics.get("step") == steps,
+            "world_size_history": res.world_size_history,
+            "mesh": res.mesh,
+            "lost_steps": lost,
+            "num_drains": res.num_drains,
+            "num_failures": res.num_failures,
+            "survivor_bundles_before": survivor_before,
+            "survivor_bundles_after": survivor_after,
+            "survivor_committed_untouched":
+                bool(survivor_after)
+                and survivor_after == survivor_before,
+        }
+    finally:
+        cluster.shutdown()
+        shutil.rmtree(store, ignore_errors=True)
+
+
+def bench_spotfleet(fast: bool = False,
+                    out_path: Optional[str] = None) -> dict:
+    """Spot-fleet elasticity bench -> BENCH_spotfleet.json.
+
+    Three scenarios: (1) **continuous churn** — the same seeded
+    stochastic spot-market schedule (Poisson preempts with jittered
+    deadlines + no-notice kills) replayed against the goodput-driven
+    policy (pre-buy on notice, buy on goodput sag, upsize at checkpoint
+    boundaries) and the preemption-naive reconciler; (2) **pre-buy
+    timing** — replacement REQUESTED at notice time and running before
+    the victim's deadline (declarative InstanceManager layer,
+    deterministic); (3) **multi-slice** — one slice of a 2-slice
+    SlicePlacementGroup preempted via per-slice drain: the survivor
+    slice's bundles never move, the mesh reshapes dp across survivors,
+    0 lost steps.
+
+    SLA: the graceful policy holds fleet-scaled goodput above the floor
+    under churn AND beats naive on both goodput and lost-step ratio;
+    the pre-buy replacement runs before the deadline; the multi-slice
+    preempt keeps the survivor committed with 0 lost steps.
+    """
+    budget_wall_s = 240.0 if fast else 600.0
+    if fast:
+        knobs = dict(seed=8, steps=40, work_s=0.9, rate=0.16,
+                     horizon_s=14.0, deadline_range=(6.0, 9.0),
+                     no_notice_frac=0.25, boot_delay_s=1.5, fleet=3,
+                     write_delay=0.08)
+        goodput_floor, lost_budget = 0.18, 0.20
+    else:
+        knobs = dict(seed=8, steps=72, work_s=1.0, rate=0.14,
+                     horizon_s=26.0, deadline_range=(6.0, 10.0),
+                     no_notice_frac=0.25, boot_delay_s=1.5, fleet=3,
+                     write_delay=0.08)
+        goodput_floor, lost_budget = 0.28, 0.15
+    t0 = time.monotonic()
+    doc: dict = {"spec": "spotfleet", "fast": fast,
+                 "knobs": {**knobs,
+                           "deadline_range": list(knobs["deadline_range"])},
+                 "wall_clock_budget_s": budget_wall_s, "churn": {}}
+    for mode in ("graceful", "naive"):
+        doc["churn"][mode] = _run_spotfleet_mode(mode, **knobs)
+        m = doc["churn"][mode]
+        print(f"# {mode}: scaled goodput {m['scaled_goodput']:.3f} "
+              f"lost {m['lost_steps']} steps "
+              f"mean world {m['mean_reported_world']} "
+              f"completed={m['completed']} wall {m['wall_s']}s",
+              file=sys.stderr)
+    doc["prebuy"] = _spotfleet_prebuy_timing()
+    print(f"# prebuy: notice->request "
+          f"{doc['prebuy']['notice_to_request_s']}s, notice->running "
+          f"{doc['prebuy']['notice_to_running_s']}s "
+          f"(deadline {doc['prebuy']['deadline_s']}s)", file=sys.stderr)
+    doc["multislice"] = _spotfleet_multislice()
+    ms = doc["multislice"]
+    print(f"# multislice: survivor untouched="
+          f"{ms['survivor_committed_untouched']} lost {ms['lost_steps']} "
+          f"steps mesh {ms['mesh']}", file=sys.stderr)
+    g, n = doc["churn"]["graceful"], doc["churn"]["naive"]
+    live_prebuy = g["prebuy_windows"]
+    doc["wall_s"] = round(time.monotonic() - t0, 2)
+    doc["sla"] = {
+        "goodput_floor": goodput_floor,
+        "graceful_scaled_goodput": g["scaled_goodput"],
+        "floor_held": g["scaled_goodput"] >= goodput_floor,
+        "beats_naive_goodput":
+            g["scaled_goodput"] > n["scaled_goodput"],
+        "lost_step_budget": lost_budget,
+        "graceful_lost_step_ratio": g["lost_step_ratio"],
+        "lost_under_budget": g["lost_step_ratio"] <= lost_budget,
+        "beats_naive_lost_steps":
+            g["lost_step_ratio"] <= n["lost_step_ratio"]
+            + 1.0 / max(1, knobs["steps"]),
+        "prebuy_before_deadline":
+            doc["prebuy"]["replacement_running_before_deadline"],
+        "live_prebuy_join_before_deadline":
+            any(w["joined_before_deadline"] for w in live_prebuy)
+            if live_prebuy else None,
+        "multislice_survivor_committed":
+            ms["survivor_committed_untouched"],
+        "multislice_zero_lost_steps": ms["lost_steps"] == 0,
+        "within_wall_budget": doc["wall_s"] <= budget_wall_s,
+    }
+    doc["sla"]["pass"] = bool(
+        doc["sla"]["floor_held"]
+        and doc["sla"]["beats_naive_goodput"]
+        and doc["sla"]["lost_under_budget"]
+        and doc["sla"]["beats_naive_lost_steps"]
+        and doc["sla"]["prebuy_before_deadline"]
+        and doc["sla"]["multislice_survivor_committed"]
+        and doc["sla"]["multislice_zero_lost_steps"]
+        and g["completed"] and n["completed"])
+    path = out_path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_spotfleet.json")
+    # Elasticity SLAs must never silently erode: a full run gates
+    # against the checked-in baseline before overwriting it.
+    baseline = None
+    if not fast and out_path is None and os.path.exists(path):
+        baseline = _copy_baseline_aside(path)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"# spotfleet SLA {'PASS' if doc['sla']['pass'] else 'FAIL'} "
+          f"(scaled goodput {g['scaled_goodput']:.3f} vs floor "
+          f"{goodput_floor}; naive {n['scaled_goodput']:.3f}) -> {path}",
+          file=sys.stderr)
+    if baseline is not None:
+        try:
+            run_compare(baseline, path, 0.25)
+        except SystemExit:
+            # A regressed run must not replace the ratchet baseline:
+            # keep the eroded doc aside for debugging, restore the
+            # baseline, and fail.
+            import shutil
+            rejected = path[:-len(".json")] + ".rejected.json"
+            os.replace(path, rejected)
+            shutil.copyfile(baseline, path)
+            print(f"# regressed run -> {rejected}; baseline restored",
+                  file=sys.stderr)
+            raise
+    if not doc["sla"]["pass"]:
+        raise SystemExit(1)
+    return doc
+
+
+def _copy_baseline_aside(path: str) -> str:
+    """Copy ``path`` to a temp file and return the copy's path (the
+    --compare baseline must survive the overwrite)."""
+    import shutil
+    import tempfile
+
+    fd, dst = tempfile.mkstemp(suffix=".json", prefix="bench_baseline_")
+    os.close(fd)
+    shutil.copyfile(path, dst)
+    return dst
 
 
 def bench_serve_load(fast: bool = False) -> None:
@@ -1210,7 +1745,7 @@ def main() -> None:
     ap.add_argument("--spec", default="auto",
                     choices=["auto", "7b", "diagnostics", "lint",
                              "checkpoint", "sanitize", "serve_load",
-                             "preempt", "profile"],
+                             "preempt", "profile", "spotfleet"],
                     help="auto: timed bench on local chip(s); "
                          "7b: AOT shape-verify of the Llama-2-7B "
                          "north-star on a virtual 8-device mesh; "
@@ -1227,10 +1762,15 @@ def main() -> None:
                          "schedule — graceful drain vs ungraceful kill "
                          "vs fail-and-restart baseline; "
                          "profile: always-on step-attribution overhead "
-                         "(train.step_phase accounting, <2% budget)")
+                         "(train.step_phase accounting, <2% budget); "
+                         "spotfleet: continuous seeded spot-market churn "
+                         "— goodput-driven policy (pre-buy + upsize) vs "
+                         "preemption-naive, plus pre-buy timing and a "
+                         "2-slice per-slice-drain scenario")
     ap.add_argument("--fast", action="store_true",
-                    help="serve_load/preempt: short smoke-scale run "
-                         "with a tier-1-friendly wall-clock budget")
+                    help="serve_load/preempt/spotfleet: short "
+                         "smoke-scale run with a tier-1-friendly "
+                         "wall-clock budget")
     ap.add_argument("--mesh", default=None, metavar="SPEC",
                     help="Run the timed bench on an SPMD mesh, e.g. "
                          "dp2xfsdp4 / fsdp8 / auto.  On the CPU "
@@ -1259,6 +1799,9 @@ def main() -> None:
         return
     if args.spec == "preempt":
         bench_preempt(fast=args.fast)
+        return
+    if args.spec == "spotfleet":
+        bench_spotfleet(fast=args.fast)
         return
     if args.spec == "7b":
         shape_verify_7b()

@@ -634,7 +634,13 @@ class ClusterScheduler:
             return out
 
     def per_node_available(self) -> Dict[NodeID, Dict[str, float]]:
-        """Free resources per node (gang placement feasibility checks)."""
+        """Free resources per node (gang placement feasibility checks).
+        Draining nodes are excluded — the drain fence and the
+        autoscaler's gang launcher must agree: a doomed node's free
+        capacity must never let a pending gang look placeable (the
+        commit path would refuse it and the gang would wedge), nor
+        suppress the whole-slice replacement buy."""
         with self._lock:
             return {nid: ns.available.to_dict()
-                    for nid, ns in self._nodes.items()}
+                    for nid, ns in self._nodes.items()
+                    if nid not in self._draining}

@@ -11,11 +11,16 @@ node_provider.py:237); TPUPodProvider is the GKE/QueuedResources-shaped
 seam for real TPU fleets.
 """
 
-from .autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
+from .autoscaler import (AUTOSCALER_KV_KEY, Autoscaler, AutoscalerConfig,
+                         NodeTypeConfig)
+from .policy import (GoodputAutoscalePolicy, GoodputPolicyConfig,
+                     ScaleDecision)
 from .providers import (LocalSubprocessProvider, NodeProvider,
                         TPUPodProvider)
 
 __all__ = [
-    "Autoscaler", "AutoscalerConfig", "NodeTypeConfig", "NodeProvider",
+    "Autoscaler", "AutoscalerConfig", "AUTOSCALER_KV_KEY",
+    "NodeTypeConfig", "NodeProvider", "GoodputAutoscalePolicy",
+    "GoodputPolicyConfig", "ScaleDecision",
     "LocalSubprocessProvider", "TPUPodProvider",
 ]

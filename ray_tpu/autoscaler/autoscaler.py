@@ -6,16 +6,33 @@ against the instance manager's view, launch/terminate.  Simplifications
 kept honest: first-fit-decreasing bin-packing over configured node types,
 idle-timeout downscaling (a node with no running work past the timeout),
 min/max clamps per type.
+
+Preemption-aware on top (the closed elasticity loop): an attached
+``GoodputAutoscalePolicy`` pre-buys a replacement the moment a drain
+notice lands on a node that work occupies — before the deadline, not
+after the death — and buys capacity when the live goodput ratio sags
+below its floor; a draining node holding committed slice-gang bundles
+triggers a whole-slice replacement gang (all-or-nothing, agreeing with
+the scheduler's drain fence); and idle downscale routes through the
+drain protocol instead of vaporizing RAM-checkpoint replicas with a
+bare terminate.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
 
+from ..util import telemetry
 from .providers import NodeProvider
+
+#: KV key the reconcile loop publishes its live status under (read by
+#: ``ray-tpu status`` / cluster_status next to the goodput line; same
+#: last-writer ``diagnostics/`` convention as the mesh/watchdog records).
+AUTOSCALER_KV_KEY = "diagnostics/autoscaler/status"
 
 
 @dataclass
@@ -31,6 +48,17 @@ class AutoscalerConfig:
     node_types: Dict[str, NodeTypeConfig]
     idle_timeout_s: float = 30.0
     update_interval_s: float = 1.0
+    #: Idle downscale drains the victim first (PR 7 protocol: fence ->
+    #: evacuate RAM replicas / pinned blobs) and terminates only after
+    #: this deadline settles — never a bare provider.terminate_node.
+    idle_drain_deadline_s: float = 5.0
+    #: Goodput-driven scaling + pre-buy-on-notice policy (None: the
+    #: preemption-naive reconciler, demand-reactive only).
+    policy: Optional["GoodputAutoscalePolicy"] = None
+    #: Pending pre-buys older than this stop counting against
+    #: max_pending_prebuys (join-confirmation backstop for providers
+    #: without node_os_pid; generously above any sane boot time).
+    prebuy_pending_ttl_s: float = 180.0
 
 
 class Autoscaler:
@@ -48,6 +76,16 @@ class Autoscaler:
         self._expected_alive: Dict[str, int] = {}
         # node_id (runtime) -> first-seen-idle timestamp
         self._idle_since: Dict = {}
+        # Pre-buys in flight: provider_id -> {"victim", "reason", "ts"}.
+        self._prebuys: Dict[str, Dict] = {}
+        self.prebuy_total = 0
+        # Idle-downscale drains awaiting their fence: node_id hex ->
+        # {"pid", "ntype", "deadline"} (terminate fires after deadline).
+        self._idle_drains: Dict[str, Dict] = {}
+        # (pg_id, node_id) pairs whose draining slice-gang bundle already
+        # bought its whole-slice replacement (fire once per drain).
+        self._slice_prebought: Set[Tuple] = set()
+        self._status_pub_mono = 0.0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name="autoscaler", daemon=True)
@@ -86,7 +124,28 @@ class Autoscaler:
         return sum(1 for n in self.runtime.controller.alive_nodes()
                    if not n.is_head)
 
-    def _launch(self, name: str, ntc: NodeTypeConfig) -> None:
+    def _busy_nodes(self) -> set:
+        """Runtime node ids holding running tasks, actors, or committed
+        placement-group bundles (reserved slices are busy, not idle)."""
+        rt = self.runtime
+        busy = set()
+        with rt._running_lock:
+            for t in rt._running.values():
+                busy.add(t.node_id)
+        with rt._actors_lock:
+            for ast in rt._actors.values():
+                if ast.node_id is not None:
+                    busy.add(ast.node_id)
+        from .._private.controller import PG_REMOVED
+        for pg in rt.controller.placement_groups.values():
+            if pg.state == PG_REMOVED:
+                continue
+            for b in pg.bundles:
+                if b.node_id is not None:
+                    busy.add(b.node_id)
+        return busy
+
+    def _launch(self, name: str, ntc: NodeTypeConfig) -> str:
         pid = self.provider.create_node(name, ntc.resources)
         # Join expectation: the worker count this launch should bring the
         # cluster to.  Base = max(current count, any still-unmet RECENT
@@ -104,6 +163,217 @@ class Autoscaler:
                    + list(self._expected_alive.values()))
         self._expected_alive[pid] = base + 1
         self._launched[pid] = (name, now)
+        return pid
+
+    # -- goodput policy / pre-buy -------------------------------------------
+
+    def _joined_os_pids(self) -> set:
+        joined = set()
+        for n in self.runtime.controller.alive_nodes():
+            try:
+                joined.add(int(n.labels.get("os_pid", 0)))
+            except (TypeError, ValueError):
+                pass
+        joined.discard(0)
+        return joined
+
+    def _prune_prebuys(self) -> int:
+        """Drop pre-buys that joined (no longer pending) or died before
+        joining (spawn failure); returns the still-pending count and
+        refreshes the gauge the status line reads."""
+        live = set(self.provider.non_terminated_nodes())
+        get_pid = getattr(self.provider, "node_os_pid", None)
+        joined = self._joined_os_pids()
+        now = time.monotonic()
+        for pid, rec in list(self._prebuys.items()):
+            if pid not in live:
+                self._prebuys.pop(pid, None)
+                continue
+            # TTL backstop: a provider without node_os_pid (real cloud
+            # providers) can never confirm the join, and a wedged entry
+            # would saturate the pending bound and disable pre-buying
+            # forever.  Past the TTL the node either joined long ago or
+            # never will — both stop counting against the bound.
+            if now - rec["ts"] >= self.config.prebuy_pending_ttl_s:
+                self._prebuys.pop(pid, None)
+                continue
+            os_pid = get_pid(pid) if get_pid else None
+            if os_pid is not None and os_pid in joined:
+                self._prebuys.pop(pid, None)
+        telemetry.set_gauge("ray_tpu_autoscaler_pending_prebuys",
+                            float(len(self._prebuys)))
+        return len(self._prebuys)
+
+    def _policy_scale(self, counts: Dict[str, int]) -> None:
+        """One policy tick: feed the live goodput summary + the
+        preemption-notice stream (draining nodes that work occupies)
+        into the GoodputAutoscalePolicy and execute its buy decisions.
+        Mutates ``counts`` with the launches so the demand math below
+        sees them."""
+        policy = self.config.policy
+        if policy is None:
+            return
+        policy.observe_goodput(telemetry.goodput_summary())
+        busy = self._busy_nodes()
+        get_pid = getattr(self.provider, "node_os_pid", None)
+        type_by_os: Dict[int, str] = {}
+        if get_pid is not None:
+            for pid, (ntype, _ts) in list(self._launched.items()):
+                os_pid = get_pid(pid)
+                if os_pid:
+                    type_by_os[os_pid] = ntype
+        # Nodes holding committed slice-gang bundles are the
+        # whole-slice launcher's problem (_slice_gang_prebuy buys the
+        # full gang all-or-nothing) — a per-victim pre-buy here would
+        # buy the same replacement twice, or at max_workers eat the
+        # headroom the gang check needs.
+        from .._private.controller import PG_CREATED
+        gang_owned = set()
+        for pg in self.runtime.controller.placement_groups.values():
+            if pg.state == PG_CREATED and pg.strategy == "STRICT_SPREAD":
+                for b in pg.bundles:
+                    if b.node_id is not None:
+                        gang_owned.add(b.node_id)
+        notices: List = []
+        draining_by_type: Dict[str, int] = {}
+        # Victims whose type can't be resolved (pid-less cloud
+        # providers) still free a slot when they die — counted as a
+        # type-blind discount so pre-buy keeps working at max_workers
+        # on exactly the providers it was built for.
+        draining_untyped = 0
+        for n in self.runtime.controller.draining_nodes():
+            if n.is_head or n.node_id not in busy \
+                    or n.node_id in gang_owned:
+                continue
+            try:
+                os_pid = int(n.labels.get("os_pid", 0))
+            except (TypeError, ValueError):
+                os_pid = 0
+            ntype = type_by_os.get(os_pid)
+            notices.append((n.node_id.hex(), ntype))
+            if ntype is not None:
+                draining_by_type[ntype] = \
+                    draining_by_type.get(ntype, 0) + 1
+            else:
+                draining_untyped += 1
+        pending = self._prune_prebuys()
+        for d in policy.decide(notices, pending):
+            ntype = d.node_type or next(iter(self.config.node_types))
+            ntc = self.config.node_types.get(ntype)
+            if ntc is None:
+                # Unknown type (config rename/typo): un-commit so a
+                # later notice can retry, same as the headroom drop.
+                if d.victim:
+                    policy.forget_victim(d.victim)
+                if d.reason == "goodput":
+                    policy.forget_goodput_buy()
+                continue
+            # Headroom judged minus the doomed (draining) nodes: a
+            # pre-buy replaces one of them, it does not grow the
+            # steady-state fleet past max_workers.
+            effective = counts.get(ntype, 0) - \
+                draining_by_type.get(ntype, 0) - draining_untyped
+            if effective >= ntc.max_workers:
+                # Un-commit the dropped decision so a later tick with
+                # headroom can retry (re-notice / next sag window).
+                if d.victim:
+                    policy.forget_victim(d.victim)
+                if d.reason == "goodput":
+                    policy.forget_goodput_buy()
+                continue
+            pid = self._launch(ntype, ntc)
+            self._prebuys[pid] = {"victim": d.victim,
+                                  "reason": d.reason,
+                                  "ts": time.monotonic()}
+            # Counters book EXECUTED buys only — decide() may emit
+            # decisions the headroom check above drops.
+            if d.reason == "prebuy":
+                self.prebuy_total += d.count
+                telemetry.inc("ray_tpu_autoscaler_prebuy_total",
+                              d.count)
+            else:
+                telemetry.inc(
+                    "ray_tpu_autoscaler_goodput_scale_events_total",
+                    d.count, tags={"direction": "up"})
+            counts[ntype] = counts.get(ntype, 0) + 1
+        telemetry.set_gauge("ray_tpu_autoscaler_pending_prebuys",
+                            float(len(self._prebuys)))
+
+    def _slice_gang_prebuy(self, counts: Dict[str, int]) -> Dict[str, int]:
+        """A draining node holding committed slice-gang bundles
+        (STRICT_SPREAD — the SlicePlacementGroup shape) dooms those
+        bundles at its deadline: pre-buy the replacement node group as
+        ONE all-or-nothing gang so the scheduler's post-death re-plan
+        (reschedule_lost_bundles, which only re-plans the lost bundles)
+        finds capacity waiting.  The drain fence and this launcher
+        agree: draining nodes are not schedulable capacity, so the
+        feasibility check below never counts them.  Fires once per
+        (pg, node) drain; other slices' committed bundles are never
+        touched."""
+        policy = self.config.policy
+        if policy is None or not policy.config.prebuy:
+            return {}
+        from .._private.controller import PG_CREATED
+        draining = {n.node_id for n in
+                    self.runtime.controller.draining_nodes()}
+        if not draining:
+            self._slice_prebought.clear()
+            return {}
+        to_launch: Dict[str, int] = {}
+        for pg in list(self.runtime.controller.placement_groups.values()):
+            if pg.state != PG_CREATED or pg.strategy != "STRICT_SPREAD":
+                continue
+            doomed = [b for b in pg.bundles if b.node_id in draining]
+            if not doomed or all((pg.pg_id, b.node_id) in
+                                 self._slice_prebought for b in doomed):
+                continue
+            shapes = [b.resources.to_dict() for b in doomed]
+            # All-or-nothing: one node type must fit every doomed
+            # bundle with headroom for the full replacement gang
+            # (victims are doomed, so they free their slots).
+            gang_type = None
+            for name, ntc in self.config.node_types.items():
+                if all(all(ntc.resources.get(k, 0.0) >= v
+                           for k, v in s.items()) for s in shapes):
+                    # Victims free their slots when they die and the
+                    # gang replaces them 1:1, so steady-state count
+                    # stays at `have`.
+                    have = counts.get(name, 0) + to_launch.get(name, 0)
+                    if have <= ntc.max_workers:
+                        gang_type = name
+                        break
+            if gang_type is None:
+                continue  # nothing partial: the whole gang or no buy
+            for b in doomed:
+                self._slice_prebought.add((pg.pg_id, b.node_id))
+            to_launch[gang_type] = \
+                to_launch.get(gang_type, 0) + len(shapes)
+            self.prebuy_total += len(shapes)
+            telemetry.inc("ray_tpu_autoscaler_prebuy_total", len(shapes))
+        return to_launch
+
+    def _publish_status(self, counts: Dict[str, int]) -> None:
+        """Drop the live reconcile view into the head KV (rate-limited,
+        best-effort) for `ray-tpu status` / cluster_status: pending
+        pre-buys belong next to the goodput they protect."""
+        now = time.monotonic()
+        if now - self._status_pub_mono < 1.0:
+            return
+        self._status_pub_mono = now
+        policy = self.config.policy
+        doc = {
+            "pending_prebuys": len(self._prebuys),
+            "prebuy_total": self.prebuy_total,
+            "idle_draining": len(self._idle_drains),
+            "nodes_by_type": dict(counts),
+            "policy": policy.status() if policy is not None else None,
+            "time": time.time(),
+        }
+        try:
+            self.runtime.ctl_kv_put(AUTOSCALER_KV_KEY,
+                                    json.dumps(doc).encode())
+        except Exception as e:  # noqa: BLE001 — status is best-effort
+            telemetry.note_swallowed("autoscaler.publish_status", e)
 
     def _gang_launches(self, counts: Dict[str, int]) -> Dict[str, int]:
         """Atomic multi-host gangs (pending slice/STRICT_SPREAD placement
@@ -118,12 +388,7 @@ class Autoscaler:
         # judging gang feasibility, or every tick would launch another
         # full gang.  Nodes that never join stop blocking after a
         # timeout (spawn failure), and foreign/manual nodes are ignored.
-        joined_os_pids = set()
-        for n in self.runtime.controller.alive_nodes():
-            try:
-                joined_os_pids.add(int(n.labels.get("os_pid", 0)))
-            except (TypeError, ValueError):
-                pass
+        joined_os_pids = self._joined_os_pids()
         get_pid = getattr(self.provider, "node_os_pid", None)
         live = set(self.provider.non_terminated_nodes())
         now = time.monotonic()
@@ -208,7 +473,18 @@ class Autoscaler:
 
     def _reconcile(self) -> None:
         counts = self._count_by_type()
-        # Gangs first: a pending slice reservation launches its whole
+        # Preemption-aware layer first: pre-buy replacements for noticed
+        # victims (and goodput-sag capacity) before the demand math —
+        # the whole point is to spend the drain deadline booting.
+        self._policy_scale(counts)
+        for name, n in self._slice_gang_prebuy(counts).items():
+            counts[name] = counts.get(name, 0) + n
+            for _ in range(n):
+                pid = self._launch(name, self.config.node_types[name])
+                self._prebuys[pid] = {"victim": None,
+                                      "reason": "slice_gang",
+                                      "ts": time.monotonic()}
+        # Gangs next: a pending slice reservation launches its whole
         # node group atomically, before flat demand claims headroom.
         gang_launch = self._gang_launches(counts)
         for name, n in gang_launch.items():
@@ -265,70 +541,113 @@ class Autoscaler:
             for _ in range(n):
                 self._launch(name, self.config.node_types[name])
 
-        # -- downscale: terminate nodes idle past the timeout, respecting
-        # per-type minimums (reference: idle node termination in v1/v2).
+        # -- downscale: drain-then-terminate nodes idle past the timeout,
+        # respecting per-type minimums (reference: idle node termination
+        # in v1/v2, routed through the PR 7 drain protocol).
         if not demand:
             self._downscale_idle(counts)
+        self._publish_status(counts)
 
     def _downscale_idle(self, counts: Dict[str, int]) -> None:
+        """Two-phase idle downscale.  Phase 1 marks an idle victim
+        DRAINING (``ctl_drain_node`` with a short deadline) instead of
+        terminating it outright: the fence makes it unschedulable while
+        RAM-checkpoint replicas and pinned blobs evacuate through the
+        drain protocol's listeners.  Phase 2 terminates only after the
+        fence settles (deadline passed) — a bare provider.terminate_node
+        on an idle node vaporized whatever it still hosted."""
         rt = self.runtime
         now = time.monotonic()
-        busy_nodes = set()
-        with rt._running_lock:
-            for t in rt._running.values():
-                busy_nodes.add(t.node_id)
-        with rt._actors_lock:
-            for ast in rt._actors.values():
-                if ast.node_id is not None:
-                    busy_nodes.add(ast.node_id)
-        # Nodes holding committed placement-group bundles are reserved
-        # capacity (a TPU slice), not idle: they only become terminable
-        # when the PG is removed — at which point the whole slice's nodes
-        # go idle together and drain as a unit.
-        from .._private.controller import PG_REMOVED
-        for pg in rt.controller.placement_groups.values():
-            if pg.state == PG_REMOVED:
-                continue
-            for b in pg.bundles:
-                if b.node_id is not None:
-                    busy_nodes.add(b.node_id)
+        busy_nodes = self._busy_nodes()
 
-        # Match provider nodes to runtime nodes by recency of launch: the
-        # provider only knows pids; the runtime only knows node ids.  Idle
-        # detection operates on runtime node ids; termination picks the
-        # youngest idle provider node of a type over its minimum.
+        # Phase 2: victims whose drain deadline settled terminate now.
+        freed: Dict[str, int] = {}
+        alive_hex = {n.node_id.hex(): n
+                     for n in rt.controller.alive_nodes()}
+        for hexid, rec in list(self._idle_drains.items()):
+            if hexid not in alive_hex:
+                # Died on its own mid-drain: provider bookkeeping only
+                # (already absent from this tick's provider counts).
+                self.provider.terminate_node(rec["pid"])
+                self._launched.pop(rec["pid"], None)
+                self._idle_drains.pop(hexid, None)
+            elif now >= rec["deadline"]:
+                self.provider.terminate_node(rec["pid"])
+                self._launched.pop(rec["pid"], None)
+                self._idle_drains.pop(hexid, None)
+                # ``counts`` was snapshotted while this victim was
+                # still provider-alive, and the pop above hides it from
+                # the draining decrement below — without this, the tick
+                # a drain settles could drain ANOTHER node past
+                # min_workers.
+                freed[rec["ntype"]] = freed.get(rec["ntype"], 0) + 1
+
+        # Phase 1: idle detection on runtime node ids; the drain targets
+        # the youngest idle provider node of a type over its minimum.
+        # (The provider only knows pids; the runtime only knows node
+        # ids — matched by the OS pid each node reported at
+        # registration.)
         alive = [n for n in rt.controller.alive_nodes() if not n.is_head]
+        drain_pids = {rec["pid"] for rec in self._idle_drains.values()}
         idle_os_pids = set()
+        os_to_hex: Dict[int, str] = {}
         for n in alive:
-            if n.node_id in busy_nodes:
-                self._idle_since.pop(n.node_id, None)
+            hexid = n.node_id.hex()
+            if n.node_id in busy_nodes or hexid in self._idle_drains:
+                if n.node_id in busy_nodes:
+                    self._idle_since.pop(n.node_id, None)
                 continue
             first = self._idle_since.setdefault(n.node_id, now)
             if now - first >= self.config.idle_timeout_s:
                 try:
-                    idle_os_pids.add(int(n.labels.get("os_pid", 0)))
+                    os_pid = int(n.labels.get("os_pid", 0))
                 except (TypeError, ValueError):
-                    pass
-        idle_os_pids.discard(0)
+                    continue
+                if os_pid:
+                    idle_os_pids.add(os_pid)
+                    os_to_hex[os_pid] = hexid
         if not idle_os_pids:
             return
-        # Terminate exactly the IDLE provider nodes (matched by the OS pid
-        # each node reported at registration), respecting type minimums.
         get_pid = getattr(self.provider, "node_os_pid", None)
         remaining = dict(counts)
+        # Nodes already draining toward termination — and ones Phase 2
+        # terminated this very tick — count as gone for the per-type
+        # minimum.
+        for rec in self._idle_drains.values():
+            remaining[rec["ntype"]] = remaining.get(rec["ntype"], 0) - 1
+        for ntype, n in freed.items():
+            remaining[ntype] = remaining.get(ntype, 0) - n
         for pid, (ntype, _ts) in list(self._launched.items()):
-            if remaining.get(ntype, 0) <=                     self.config.node_types[ntype].min_workers:
+            if pid in drain_pids:
+                continue
+            if remaining.get(ntype, 0) <= \
+                    self.config.node_types[ntype].min_workers:
                 continue
             os_pid = get_pid(pid) if get_pid else None
             if os_pid is not None and os_pid in idle_os_pids:
-                self.provider.terminate_node(pid)
-                self._launched.pop(pid, None)
+                hexid = os_to_hex[os_pid]
+                if not rt.ctl_drain_node(
+                        hexid, self.config.idle_drain_deadline_s,
+                        "idle-downscale"):
+                    continue  # node vanished between scan and drain
+                self._idle_drains[hexid] = {
+                    "pid": pid, "ntype": ntype,
+                    "deadline": now + self.config.idle_drain_deadline_s}
                 remaining[ntype] = remaining.get(ntype, 0) - 1
+                if self.config.policy is not None:
+                    telemetry.inc(
+                        "ray_tpu_autoscaler_goodput_scale_events_total",
+                        tags={"direction": "down"})
 
     # -- introspection ------------------------------------------------------
 
     def status(self) -> Dict:
+        policy = self.config.policy
         return {
             "nodes_by_type": self._count_by_type(),
             "pending_demand": len(self.runtime.scheduler.pending_demand()),
+            "pending_prebuys": len(self._prebuys),
+            "prebuy_total": self.prebuy_total,
+            "idle_draining": len(self._idle_drains),
+            "policy": policy.status() if policy is not None else None,
         }

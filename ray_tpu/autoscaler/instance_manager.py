@@ -223,12 +223,28 @@ class InstanceManager:
                  joined_pids: Optional[Callable[[], Dict[int, str]]] = None,
                  request_timeout_s: float = 300.0,
                  drain_hook: Optional[
-                     Callable[[str, float, str], None]] = None):
+                     Callable[[str, float, str], None]] = None,
+                 prebuy: bool = True,
+                 max_pending_prebuys: int = 2):
         self.provider = provider
         self.store = store or InstanceStore()
         # () -> {os_pid: ray_node_id} of nodes registered with the head.
         self._joined_pids = joined_pids or (lambda: {})
         self.request_timeout_s = request_timeout_s
+        # Pre-buy-on-notice: an instance under a live preemption notice
+        # is counted as already dead by the reconcile diff, so its
+        # replacement is REQUESTED at notice time (before the deadline),
+        # not after the cloud completes the reclaim.  Bounded: at most
+        # ``max_pending_prebuys`` notices are discounted at once, so a
+        # notice storm buys replacements in waves instead of all at
+        # once.
+        self.prebuy = prebuy
+        self.max_pending_prebuys = max_pending_prebuys
+        # cloud_ids with a live notice for a RUNNING/JOINED instance
+        # (refreshed every _poll_preemption_notices pass), and victims
+        # whose pre-buy was already counted (telemetry fires once).
+        self._active_notices: set = set()
+        self._prebuy_counted: set = set()
         # cloud_ids whose terminate call succeeded at least once — FAILED
         # entries are terminal and never pruned, so without this every
         # pass would re-send the full history of dead ids.
@@ -263,7 +279,10 @@ class InstanceManager:
         # and the cluster under-provisions until request_timeout_s.
         self.retry_pending_requests()
         counts: Dict[str, int] = {}
+        discounted = self._prebuy_discounts()
         for inst in self.store.alive():
+            if inst.instance_id in discounted:
+                continue  # doomed by a live notice: replacement buys NOW
             counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
         for ntype, want in desired.items():
             have = counts.get(ntype, 0)
@@ -288,15 +307,23 @@ class InstanceManager:
         except Exception:
             return  # the signal plane is best-effort; retried next pass
         if not notices:
+            self._active_notices = set()
             return
         by_cloud = {i.cloud_id: i for i in self.store.all() if i.cloud_id}
+        # Live notice set for the pre-buy discount: only notices naming
+        # an instance the cloud could still reclaim.
+        self._active_notices = {
+            n.cloud_id for n in notices
+            if (by_cloud.get(n.cloud_id) is not None
+                and by_cloud[n.cloud_id].status in (RUNNING, JOINED))}
         # A terminated instance's dedup entries must not shadow a future
         # reissued notice for a recycled/cancelled-and-reposted id.
-        for cid in list(self._drain_notified):
+        for cid in list(self._drain_notified | self._prebuy_counted):
             inst = by_cloud.get(cid)
             if inst is None or inst.status in _TERMINAL:
                 self._drain_notified.discard(cid)
                 self._notice_exported.discard(cid)
+                self._prebuy_counted.discard(cid)
         for notice in notices:
             inst = by_cloud.get(notice.cloud_id)
             if inst is None or inst.status not in (RUNNING, JOINED):
@@ -324,6 +351,31 @@ class InstanceManager:
                     from ..util import telemetry
                     telemetry.note_swallowed(
                         "instance_manager.drain_hook", e)
+
+    def _prebuy_discounts(self) -> set:
+        """Instance ids the reconcile diff counts as already dead: a
+        live preemption notice dooms them, so discounting them makes
+        ``want > have`` and the replacement is REQUESTED at notice time
+        — the deadline window is spent provisioning instead of wasted.
+        Bounded to ``max_pending_prebuys`` at once (a storm buys in
+        waves as earlier replacements join and victims die), and
+        naturally convergent: the discounted victim plus its REQUESTED
+        replacement cancel out on the next pass."""
+        if not self.prebuy or not self._active_notices:
+            return set()
+        doomed = sorted(
+            (i for i in self.store.alive()
+             if i.cloud_id in self._active_notices
+             and i.status in (RUNNING, JOINED)),
+            key=lambda i: i.cloud_id)
+        out = set()
+        for inst in doomed[:max(0, self.max_pending_prebuys)]:
+            out.add(inst.instance_id)
+            if inst.cloud_id not in self._prebuy_counted:
+                self._prebuy_counted.add(inst.cloud_id)
+                from ..util import telemetry
+                telemetry.inc("ray_tpu_autoscaler_prebuy_total")
+        return out
 
     def _sync_cloud_state(self) -> set:
         """Sync table statuses from one provider.describe() snapshot;
@@ -465,12 +517,14 @@ class InstanceManager:
                 pass
 
     def _terminate_surplus(self, node_type: str, count: int) -> None:
-        # Drain youngest-first, never a JOINED node before an unjoined
-        # one (joined nodes hold work).
+        # Noticed (doomed-anyway) instances first, then youngest-first,
+        # never a JOINED node before an unjoined one (joined nodes hold
+        # work).
         order = {REQUESTED: 0, PROVISIONING: 1, RUNNING: 2, JOINED: 3}
         cands = sorted(
             (i for i in self.store.alive() if i.node_type == node_type),
-            key=lambda i: (order.get(i.status, 9), -i.updated_at))
+            key=lambda i: (i.cloud_id not in self._active_notices,
+                           order.get(i.status, 9), -i.updated_at))
         doomed = cands[:count]
         cloud_ids = [i.cloud_id for i in doomed if i.cloud_id]
         for inst in doomed:

@@ -1,0 +1,197 @@
+"""Goodput-driven autoscaling policy: close the elasticity loop.
+
+PR 7 built the drain protocol (notice -> fence -> urgent checkpoint ->
+planned downsize) and the mesh runtime made resize a reshape — but
+nothing *reacted* to the live ``ray_tpu_train_goodput_ratio`` gauge, and
+a preemption notice only ever drained: the replacement was bought after
+the death, so every preemption left the job limping at n-1 until demand
+pressure (if any) re-bought.  This module is the reaction:
+
+* **Pre-buy on notice** — a preemption notice for a node that training
+  occupies buys the replacement IMMEDIATELY, so with any boot time
+  shorter than the drain deadline the replacement joins before (or right
+  after) the victim dies and the post-drain reform upsizes back.
+* **Buy on goodput sag** — when the *windowed* goodput ratio (recent
+  productive/total, not the run-lifetime cumulative ratio, which an old
+  healthy run would keep propped up) stays below the configured floor
+  for ``sustain_s``, buy capacity.
+* **Spend bounds** — ``max_pending_prebuys`` + ``cooldown_s`` keep a
+  notice storm or a long sag from over-provisioning: buys stop while
+  earlier buys are still booting, and goodput-driven buys are spaced by
+  the cooldown.
+
+The policy is pure decision logic over observations the caller feeds it
+(testable without a cluster); ``Autoscaler`` wires it to the live
+runtime (draining-node table + in-process GoodputTracker) and
+``InstanceManager`` implements the same pre-buy contract declaratively
+at the cloud-provider layer.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+@dataclass
+class GoodputPolicyConfig:
+    #: Goodput SLA floor: sustained windowed goodput below this buys.
+    goodput_floor: float = 0.5
+    #: The sag must persist this long before a buy (one bad checkpoint
+    #: stall must not buy a TPU slice).
+    sustain_s: float = 5.0
+    #: Minimum spacing between goodput-driven buys.
+    cooldown_s: float = 15.0
+    #: Pre-bought (or goodput-bought) nodes still booting, above which
+    #: further buys are deferred — the notice-storm bound.
+    max_pending_prebuys: int = 2
+    #: Buy replacements at preemption-notice time (before the death).
+    prebuy: bool = True
+    #: Node type to buy when the victim's type is unknown (a drained
+    #: node the autoscaler did not launch); default: caller's choice.
+    default_node_type: Optional[str] = None
+    #: Goodput observations older than this fall out of the sag window.
+    window_s: float = 30.0
+
+
+@dataclass
+class ScaleDecision:
+    node_type: Optional[str]  # None = caller picks (default/first type)
+    count: int
+    reason: str               # "prebuy" | "goodput"
+    #: Node id / cloud id the decision replaces (prebuy only; dedup key).
+    victim: Optional[str] = None
+
+
+@dataclass
+class _GoodputSample:
+    t: float
+    productive_s: float
+    total_s: float
+
+
+class GoodputAutoscalePolicy:
+    """Turns (goodput stream, preemption notices, pending-buy count) into
+    buy decisions.  Stateless about the cluster — the caller owns launch
+    execution and join tracking and reports ``pending`` back each tick.
+    """
+
+    def __init__(self, config: Optional[GoodputPolicyConfig] = None):
+        self.config = config or GoodputPolicyConfig()
+        self._samples: Deque[_GoodputSample] = deque()
+        self._sag_since: Optional[float] = None
+        self._last_goodput_buy: float = -1e18
+        #: Victims already pre-bought (a notice repeats every tick until
+        #: the node dies; the buy must fire once per victim).
+        self._prebought: set = set()
+        #: Latest windowed goodput (status/introspection).
+        self.last_windowed_goodput: Optional[float] = None
+
+    # -- observations ------------------------------------------------------
+
+    def observe_goodput(self, summary: Optional[Dict],
+                        now: Optional[float] = None) -> None:
+        """Feed one GoodputTracker summary ({productive_s, total_s});
+        None (no training run observed) clears the sag state."""
+        now = time.monotonic() if now is None else now
+        if not summary or not summary.get("total_s"):
+            self._sag_since = None
+            self.last_windowed_goodput = None
+            return
+        self._samples.append(_GoodputSample(
+            now, float(summary.get("productive_s", 0.0)),
+            float(summary.get("total_s", 0.0))))
+        cutoff = now - self.config.window_s
+        while len(self._samples) > 2 and self._samples[1].t <= cutoff:
+            self._samples.popleft()
+
+    def windowed_goodput(self) -> Optional[float]:
+        """Recent goodput: delta-productive over delta-total across the
+        observation window.  None until two samples of the SAME run
+        exist (a restarted tracker resets its cumulative counters, which
+        would otherwise yield negative deltas — treated as a fresh
+        window)."""
+        if len(self._samples) < 2:
+            return None
+        first, last = self._samples[0], self._samples[-1]
+        d_total = last.total_s - first.total_s
+        d_prod = last.productive_s - first.productive_s
+        if d_total <= 0 or d_prod < 0:
+            # Tracker restarted mid-window: drop the stale prefix.
+            self._samples = deque([last])
+            return None
+        return max(0.0, min(1.0, d_prod / d_total))
+
+    def forget_victim(self, victim: str) -> None:
+        """A pre-bought victim's drain was cancelled (or its replacement
+        died before joining): allow a future notice to buy again."""
+        self._prebought.discard(victim)
+
+    # -- decisions ---------------------------------------------------------
+
+    def decide(self, notices: List[Tuple[str, Optional[str]]],
+               pending: int, now: Optional[float] = None
+               ) -> List[ScaleDecision]:
+        """One tick: ``notices`` is the live preemption-notice stream as
+        (victim_id, node_type|None) for nodes occupied by work; ``pending``
+        counts earlier buys still booting.  Returns buy decisions (the
+        caller launches and accounts them)."""
+        now = time.monotonic() if now is None else now
+        cfg = self.config
+        out: List[ScaleDecision] = []
+
+        # Pre-buy: one replacement per newly noticed victim, bounded.
+        if cfg.prebuy:
+            live = {v for v, _t in notices}
+            # Victims whose notice vanished (drain cancelled, or the
+            # node already died) stop occupying dedup state — a dead
+            # victim never re-notices, and a cancelled drain SHOULD be
+            # allowed to buy again if re-noticed later.
+            self._prebought &= live
+            for victim, ntype in notices:
+                if victim in self._prebought:
+                    continue
+                if pending + len(out) >= cfg.max_pending_prebuys:
+                    break  # storm bound; retried once a buy joins
+                self._prebought.add(victim)
+                out.append(ScaleDecision(
+                    ntype or cfg.default_node_type, 1, "prebuy",
+                    victim=victim))
+
+        # Goodput sag: sustained windowed ratio under the floor buys one
+        # node per cooldown period.
+        g = self.windowed_goodput()
+        self.last_windowed_goodput = g
+        if g is not None and g < cfg.goodput_floor:
+            if self._sag_since is None:
+                self._sag_since = now
+            sustained = now - self._sag_since >= cfg.sustain_s
+            cooled = now - self._last_goodput_buy >= cfg.cooldown_s
+            if sustained and cooled and \
+                    pending + len(out) < cfg.max_pending_prebuys:
+                self._last_goodput_buy = now
+                out.append(ScaleDecision(
+                    cfg.default_node_type, 1, "goodput"))
+        else:
+            self._sag_since = None
+
+        return out
+
+    def forget_goodput_buy(self) -> None:
+        """A goodput-sag decision was dropped unexecuted (no headroom):
+        un-stamp the cooldown so the next tick with headroom can buy —
+        a blocked decision must not burn the budget."""
+        self._last_goodput_buy = -1e18
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self) -> Dict:
+        return {
+            "goodput_floor": self.config.goodput_floor,
+            "windowed_goodput": self.last_windowed_goodput,
+            "sagging_since_s": (time.monotonic() - self._sag_since)
+            if self._sag_since is not None else None,
+            "prebought_victims": len(self._prebought),
+        }

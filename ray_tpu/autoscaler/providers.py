@@ -9,6 +9,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import threading
 from abc import ABC, abstractmethod
 from typing import Any, Dict, List, Optional
 
@@ -32,13 +33,33 @@ class NodeProvider(ABC):
 
 class LocalSubprocessProvider(NodeProvider):
     """Boots NodeServer processes on this host (the reference's
-    FakeMultiNodeProvider pattern — real join path, fake machines)."""
+    FakeMultiNodeProvider pattern — real join path, fake machines).
 
-    def __init__(self, head_address, token: bytes):
+    ``boot_delay_s`` models the spot-market truth that capacity takes
+    time to arrive: ``create_node`` returns a provider id immediately
+    (the request is accepted) but the actual process spawns only after
+    the delay — the window where a pre-buy-at-notice-time beats a
+    buy-after-death by exactly the drain deadline.
+    """
+
+    def __init__(self, head_address, token: bytes,
+                 boot_delay_s: float = 0.0):
         self._head = head_address
         self._token = token
-        self._procs: Dict[str, subprocess.Popen] = {}
+        self.boot_delay_s = boot_delay_s
+        self._lock = threading.Lock()
+        # pid -> Popen once spawned; None while the boot delay runs.
+        self._procs: Dict[str, Optional[subprocess.Popen]] = {}
+        self._timers: Dict[str, threading.Timer] = {}
         self._next = 0
+
+    def _spawn(self, pid: str, cmd: List[str]) -> None:
+        with self._lock:
+            if pid not in self._procs:
+                return  # terminated while still queued
+            self._timers.pop(pid, None)
+            self._procs[pid] = subprocess.Popen(cmd,
+                                                start_new_session=True)
 
     def create_node(self, node_type: str,
                     resources: Dict[str, float]) -> str:
@@ -53,15 +74,28 @@ class LocalSubprocessProvider(NodeProvider):
                "--num-cpus", str(num_cpus), "--num-tpus", str(num_tpus)]
         if res:
             cmd += ["--resources", json.dumps(res)]
-        proc = subprocess.Popen(cmd, start_new_session=True)
-        self._next += 1
-        pid = f"{node_type}-{self._next}"
-        self._procs[pid] = proc
+        with self._lock:
+            self._next += 1
+            pid = f"{node_type}-{self._next}"
+            self._procs[pid] = None
+        if self.boot_delay_s > 0:
+            t = threading.Timer(self.boot_delay_s, self._spawn,
+                                args=(pid, cmd))
+            t.daemon = True
+            with self._lock:
+                self._timers[pid] = t
+            t.start()
+        else:
+            self._spawn(pid, cmd)
         return pid
 
     def terminate_node(self, provider_id: str) -> None:
         import signal
-        proc = self._procs.pop(provider_id, None)
+        with self._lock:
+            timer = self._timers.pop(provider_id, None)
+            proc = self._procs.pop(provider_id, None)
+        if timer is not None:
+            timer.cancel()
         if proc is not None and proc.poll() is None:
             try:
                 os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
@@ -69,15 +103,28 @@ class LocalSubprocessProvider(NodeProvider):
                 proc.kill()
             proc.wait(timeout=10)
 
+    def lose_instance(self, provider_id: str) -> None:
+        """The cloud takes the host away with NO runtime signal (the
+        un-noticed spot reclaim): same SIGKILL as terminate, kept as a
+        distinct verb so chaos schedules read like the cloud acts."""
+        self.terminate_node(provider_id)
+
     def non_terminated_nodes(self) -> List[str]:
-        return [pid for pid, p in self._procs.items() if p.poll() is None]
+        with self._lock:
+            # A node still inside its boot delay is live capacity-in-
+            # flight (the request was accepted), not a dead node.
+            return [pid for pid, p in self._procs.items()
+                    if p is None or p.poll() is None]
 
     def node_os_pid(self, provider_id: str) -> Optional[int]:
-        proc = self._procs.get(provider_id)
+        with self._lock:
+            proc = self._procs.get(provider_id)
         return proc.pid if proc is not None else None
 
     def shutdown(self) -> None:
-        for pid in list(self._procs):
+        with self._lock:
+            pids = list(self._procs)
+        for pid in pids:
             self.terminate_node(pid)
 
 

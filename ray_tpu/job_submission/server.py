@@ -110,6 +110,19 @@ class JobServer:
             from ray_tpu.train.mesh.runtime import read_mesh_status
             return read_mesh_status()
 
+        def _autoscaler_status():
+            import json as _json
+
+            from ray_tpu._private.api import _control
+            from ray_tpu.autoscaler import AUTOSCALER_KV_KEY
+            raw = _control("kv_get", AUTOSCALER_KV_KEY)
+            if not raw:
+                return None
+            try:
+                return _json.loads(raw)
+            except Exception:  # noqa: BLE001
+                return None
+
         async def cluster_status(request):
             from ray_tpu._private.api import _control
             import ray_tpu
@@ -127,6 +140,9 @@ class JobServer:
                 # Live SPMD mesh shape of the last-formed train group
                 # (train/mesh runtime; None before any mesh-parallel run).
                 "mesh": await call(_mesh_status),
+                # Autoscaler reconcile view (pending pre-buys next to
+                # the goodput they protect; None without an autoscaler).
+                "autoscaler": await call(_autoscaler_status),
             }
             return web.json_response(payload)
 

@@ -194,6 +194,21 @@ def status(address):
                    f"total {g['total_s']:.1f}s)")
     else:
         click.echo("train goodput: n/a (no training run observed)")
+    # Pending pre-buys belong next to the goodput they protect: a
+    # non-zero count means replacements are already booting for noticed
+    # preemptions / a goodput sag.
+    a = s.get("autoscaler")
+    if a:
+        pol = a.get("policy") or {}
+        wg = pol.get("windowed_goodput")
+        click.echo(
+            f"autoscaler: pending pre-buys {a.get('pending_prebuys', 0)} "
+            f"(bought {a.get('prebuy_total', 0)} total, "
+            f"idle-draining {a.get('idle_draining', 0)}"
+            + (f", windowed goodput {wg:.3f}" if wg is not None else "")
+            + ")")
+    else:
+        click.echo("autoscaler: n/a (no autoscaler attached)")
     m = s.get("mesh")
     if m:
         click.echo(f"train mesh: {m.get('descriptor')} "

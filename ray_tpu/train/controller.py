@@ -313,7 +313,9 @@ class TrainController:
             group.workers.append(
                 worker_cls.options(**opts).remote(rank, n, self.run_id))
         # Liveness check before dist init.
-        ray_tpu.get([w.ping.remote() for w in group.workers], timeout=120)
+        form_t = getattr(self.scaling, "formation_timeout_s", 300.0)
+        ray_tpu.get([w.ping.remote() for w in group.workers],
+                    timeout=min(120.0, form_t))
         if n > 1 or self.scaling.force_distributed:
             if self.scaling.num_slices > 1 and not self.scaling.use_tpu \
                     and n % self.scaling.num_slices == 0:
@@ -330,12 +332,13 @@ class TrainController:
                     w.setup_dist.remote(addrs[rank // wps],
                                         num_processes=wps,
                                         process_id=rank % wps)
-                    for rank, w in enumerate(group.workers)], timeout=300)
+                    for rank, w in enumerate(group.workers)],
+                    timeout=form_t)
             else:
                 addr = f"127.0.0.1:{_free_port()}"
                 ray_tpu.get(
                     [w.setup_dist.remote(addr) for w in group.workers],
-                    timeout=300)
+                    timeout=form_t)
         return group
 
     def _teardown_group(self, group: WorkerGroupState) -> None:
@@ -656,9 +659,20 @@ class TrainController:
             # Elastic upsize check (reference: elastic.py monitor
             # decision): new capacity -> teardown + re-form the world
             # at the larger size, resuming from the latest checkpoint.
+            # Gated to a CHECKPOINT BOUNDARY: the reform restores from
+            # the latest committed checkpoint, so re-forming before one
+            # committed this incarnation would replay the whole
+            # incarnation — the upsize would cost more than it buys.
+            # (The interval keeps re-checking; the upsize fires at the
+            # first boundary after capacity joined.)  A run that has
+            # never checkpointed at all replays from the start whenever
+            # the reform fires, so gating it buys nothing — it keeps
+            # the pre-gate behavior and upsizes immediately.
             if pending and error is None and \
                     time.monotonic() - last_elastic_check >= \
-                    self.scaling.elastic_check_interval_s:
+                    self.scaling.elastic_check_interval_s and \
+                    (self._last_ckpt_mono >= t_step
+                     or self._last_ckpt_mono == 0.0):
                 last_elastic_check = time.monotonic()
                 d = self.policy.monitor_decision(len(group.workers))
                 if d is not None:
@@ -676,6 +690,9 @@ class TrainController:
                             break
                     if error is None:
                         resize_to = d.num_workers
+                        if d.num_workers > world:
+                            from ..util import telemetry
+                            telemetry.inc("ray_tpu_train_upsize_total")
                     pending = []
         # Drain reports while still in the "step" phase so their
         # ckpt_seconds reattribution has step time to pull from.

@@ -93,8 +93,13 @@ class ElasticScalingPolicy:
         while True:
             fit = self._fit_count()
             if prefer_target is not None and fit >= prefer_target > 0:
-                return ScalingDecision(prefer_target,
-                                       f"resized to {prefer_target}")
+                # Capacity beyond the preferred size is taken NOW (fit
+                # is already snapped and max-clamped): when a pre-bought
+                # replacement joined during the drain, the post-drain
+                # reform upsizes back in one formation instead of
+                # limping at n-1 and paying a second teardown once the
+                # monitor notices.
+                return ScalingDecision(fit, f"resized to {fit}")
             if fit >= self.min and (
                     prefer_deadline is None
                     or time.monotonic() > prefer_deadline):
@@ -106,10 +111,13 @@ class ElasticScalingPolicy:
             time.sleep(0.5)
 
     def monitor_decision(self, current: int) -> Optional[ScalingDecision]:
-        """Upsize when new capacity appears (downsizing happens naturally
-        through the failure path when workers/nodes die).  The upsize
-        target snaps down to a mesh-tileable size — growth the mesh
-        cannot use is not worth a teardown + restore."""
+        """Upsize when new capacity appears — the reaction to an elastic
+        add_node or a pre-bought replacement joining (downsizing happens
+        naturally through the drain/failure paths when nodes die).  The
+        target is the nearest mesh-tileable world >= current that the
+        joined capacity fits: growth the mesh cannot use is not worth a
+        teardown + restore, and the controller only acts on the decision
+        at a checkpoint boundary so the reform replays ~0 steps."""
         headroom = self._fit_count()
         target = self._snap(min(current + headroom, self.max))
         if target > current:

@@ -97,6 +97,14 @@ class ScalingConfig:
     min_workers: Optional[int] = None
     max_workers: Optional[int] = None
     elastic_check_interval_s: float = 5.0
+    # Gang-formation deadline: how long setup_dist (the jax.distributed
+    # rendezvous) may block before the formation counts as failed and
+    # the failure budget decides on a retry.  The default matches jax's
+    # own coordination-service patience; spot-fleet runs set it low —
+    # a churn kill landing mid-rendezvous otherwise stalls the whole
+    # run for the full window (the dead rank never arrives, the
+    # survivors block inside initialize).
+    formation_timeout_s: float = 300.0
 
     @property
     def elastic(self) -> bool:

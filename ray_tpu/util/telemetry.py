@@ -219,6 +219,13 @@ CATALOG: Dict[str, Dict[str, Any]] = {
                        "bytes after train.shard() / a mesh restore "
                        "(~ total/N when parameters are truly sharded; "
                        "~ total means the model is replicated)."},
+    "ray_tpu_train_upsize_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Elastic upsizes: the worker group tore down at a "
+                       "checkpoint boundary and re-formed LARGER because "
+                       "joined capacity fit a bigger mesh-tileable world "
+                       "(the add_node/pre-buy-arrival reaction; "
+                       "downsizes ride the drain/failure paths)."},
     "ray_tpu_train_mesh_reshapes_total": {
         "type": "counter", "tag_keys": (),
         "description": "Mesh reshape events: a worker group re-formed "
@@ -273,6 +280,33 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "type": "gauge", "tag_keys": (),
         "description": "Nodes currently draining (unschedulable for new "
                        "leases, waiting for work to evacuate)."},
+    # -- autoscaler (goodput-driven scaling + pre-buy) ---------------------
+    "ray_tpu_autoscaler_prebuy_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Replacement capacity bought at preemption-NOTICE "
+                       "time (before the victim's deadline, not after its "
+                       "death) so the post-drain reform can upsize back "
+                       "instead of limping at n-1."},
+    "ray_tpu_autoscaler_goodput_scale_events_total": {
+        "type": "counter", "tag_keys": ("direction",),
+        "description": "Scaling actions taken by the goodput-driven "
+                       "policy (direction=up: capacity bought after the "
+                       "goodput ratio sagged below the configured floor "
+                       "for the sustain window; direction=down: surplus "
+                       "drained back once goodput recovered and nodes "
+                       "sat idle)."},
+    "ray_tpu_autoscaler_pending_prebuys": {
+        "type": "gauge", "tag_keys": (),
+        "description": "Pre-bought replacement nodes launched but not "
+                       "yet joined (the `ray-tpu status` pre-buy line; "
+                       "pinned at max_pending_prebuys = a notice storm "
+                       "is being rate-limited)."},
+    # -- slice (multi-slice reservation lifecycle) -------------------------
+    "ray_tpu_slice_drains_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Per-slice drains: one slice of a multi-slice "
+                       "SlicePlacementGroup fenced + evacuated while the "
+                       "other slices' committed bundles stay untouched."},
     # -- profiler (cluster-wide performance profiling subsystem) -----------
     "ray_tpu_profiler_compile_total": {
         "type": "counter", "tag_keys": ("fn",),

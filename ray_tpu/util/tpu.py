@@ -78,6 +78,33 @@ class SlicePlacementGroup:
             slice_id, self.num_slices,
             f"{coordinator_host}:{self._coordinator_port}")
 
+    def slice_nodes(self, slice_index: int) -> List[str]:
+        """Node ids (hex) currently holding slice ``slice_index``'s
+        committed bundles (empty for a still-pending slice)."""
+        from ray_tpu._private.ids import NodeID
+        pg = self.placement_groups[slice_index]
+        locs = pg.bundle_locations() or []
+        return sorted({NodeID(b).hex() for b in locs if b})
+
+    def drain_slice(self, slice_index: int, deadline_s: float = 30.0,
+                    reason: str = "preemption") -> List[str]:
+        """Slice-granular drain: fence + evacuate exactly ONE slice of a
+        multi-slice reservation.  Every node holding this slice-PG's
+        bundles gets a drain notice (unschedulable for new leases, kill
+        deadline advertised); the OTHER slices' committed bundles are
+        never touched — preempting one slice of a multi-slice job must
+        not tear down the rest.  The train controller's drain poll sees
+        the covered ranks and reshapes the mesh's dp axis across the
+        surviving slices; the autoscaler's gang launcher pre-buys the
+        whole-slice replacement.  Returns the drained node ids."""
+        from ray_tpu._private.api import _control
+        from ray_tpu.util import telemetry
+        drained = [hexid for hexid in self.slice_nodes(slice_index)
+                   if _control("drain_node", hexid, deadline_s, reason)]
+        if drained:
+            telemetry.inc("ray_tpu_slice_drains_total")
+        return drained
+
     def remove(self) -> None:
         for pg in self.placement_groups:
             ray_tpu.remove_placement_group(pg)

@@ -85,7 +85,8 @@ _QUICK_IN_SLOW = {
     "test_recovery": ("test_put_refs_freed_on_drop",
                       "test_reconstruct_lost_object_on_get"),
     "test_oom": ("TestPolicy",),
-    "test_autoscaler": ("test_demand_driven_scale_up",),
+    "test_autoscaler": ("test_demand_driven_scale_up",
+                        "test_idle_downscale_drains_before_terminate"),
     "test_head_ft": ("test_wal_snapshot_roundtrip",
                      "test_torn_tail_is_ignored"),
     "test_runtime_env": ("test_working_dir_ships_files", "test_endpoints"),

@@ -138,3 +138,74 @@ class TestAutoscaler:
         finally:
             asc.stop()
             provider.shutdown()
+
+    def test_idle_downscale_drains_before_terminate(self, head):
+        """Idle downscale must route through the PR 7 drain protocol:
+        the victim appears DRAINING (fenced, reason=idle-downscale)
+        while still provider-alive, and the provider terminate fires
+        only after the fence settles — never the bare terminate that
+        vaporized RAM-checkpoint replicas."""
+        provider, asc = _make(head, {
+            "cpu2": NodeTypeConfig(resources={"CPU": 2}, max_workers=2)},
+            idle_timeout_s=1.0)
+        asc.config.idle_drain_deadline_s = 2.5
+        try:
+            @ray_tpu.remote(num_cpus=2)
+            def hold(t):
+                time.sleep(t)
+                return 1
+
+            assert ray_tpu.get(hold.remote(1.0), timeout=90) == 1
+            saw_draining_while_alive = False
+            drain_reason = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                alive_pids = provider.non_terminated_nodes()
+                if not alive_pids:
+                    break
+                for n in head.ctl_nodes():
+                    if n["is_head"] or not n["alive"]:
+                        continue
+                    if n["draining"]:
+                        saw_draining_while_alive = True
+                        drain_reason = n["drain_reason"]
+                time.sleep(0.05)
+            assert saw_draining_while_alive, \
+                "node terminated without ever draining"
+            assert drain_reason == "idle-downscale"
+            assert _wait(
+                lambda: len(provider.non_terminated_nodes()) == 0,
+                timeout=30)
+        finally:
+            asc.stop()
+            provider.shutdown()
+
+    def test_partial_gang_loss_relaunches_missing_bundles_only(self, head):
+        """A pending slice gang that loses a node mid-boot re-launches
+        ONLY the missing bundles — never a second full gang (the
+        join-expectation accounting must survive a mid-boot death)."""
+        provider, asc = _make(head, {
+            "slice-host": NodeTypeConfig(
+                resources={"CPU": 2, "slice_host": 1}, max_workers=4)})
+        # Widen the mid-boot window so the kill lands before the join.
+        provider.boot_delay_s = 1.5
+        try:
+            pg = ray_tpu.placement_group(
+                [{"CPU": 2, "slice_host": 1},
+                 {"CPU": 2, "slice_host": 1}],
+                strategy="STRICT_SPREAD")
+            # Wait for the 2-node gang launch, then lose one mid-boot.
+            assert _wait(lambda: provider._next >= 2, timeout=30)
+            victim = provider.non_terminated_nodes()[0]
+            provider.lose_instance(victim)
+            assert pg.ready(timeout=120)
+            # Exactly ONE relaunch: 2 (gang) + 1 (replacement), and no
+            # per-tick relaunch storm afterwards.
+            assert provider._next == 3, provider._next
+            time.sleep(2.0)
+            assert provider._next == 3, provider._next
+            assert len(provider.non_terminated_nodes()) == 2
+            ray_tpu.remove_placement_group(pg)
+        finally:
+            asc.stop()
+            provider.shutdown()

@@ -227,3 +227,148 @@ class TestPersistence:
             f.write('{"instance_id": "zz", "node_t')  # torn write
         mgr2 = InstanceManager(prov, store=InstanceStore(path))
         assert len(mgr2.store.all()) == 1
+
+
+class TestPrebuyOnNotice:
+    """Pre-buy at preemption-NOTICE time: the replacement is REQUESTED
+    while the victim still runs, so the drain deadline is spent
+    provisioning instead of wasted (the closed elasticity loop)."""
+
+    def _converge(self, mgr, desired, want_status=RUNNING, want=None):
+        for _ in range(50):
+            mgr.reconcile(desired)
+            live = [i for i in mgr.store.alive()
+                    if i.status == want_status]
+            if len(live) == (want if want is not None
+                             else sum(desired.values())):
+                return live
+        raise AssertionError(
+            f"never converged to {desired} at {want_status}: "
+            f"{counts(mgr)}")
+
+    def test_notice_prebuys_replacement_before_death(self):
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov, drain_hook=lambda *a: None)
+        self._converge(mgr, {"worker": 2})
+        victim = mgr.store.alive()[0]
+        n_requests = len(prov.request_log)
+        prov.preempt_notice(victim.cloud_id, deadline_s=30.0)
+        mgr.reconcile({"worker": 2})
+        # Replacement requested IMMEDIATELY — victim still running.
+        assert len(prov.request_log) == n_requests + 1
+        statuses = {i.cloud_id: i.status for i in mgr.store.all()}
+        assert statuses[victim.cloud_id] == RUNNING
+        # Steady while the notice stands: no second replacement.
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 2})
+        assert len(prov.request_log) == n_requests + 1
+        # The victim dies; the fleet is already whole — no NEW request.
+        prov.lose_instance(victim.cloud_id)
+        self._converge(mgr, {"worker": 2})
+        assert len(prov.request_log) == n_requests + 1
+
+    def test_prebuy_disabled_buys_only_after_death(self):
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov, drain_hook=lambda *a: None,
+                              prebuy=False)
+        self._converge(mgr, {"worker": 2})
+        victim = mgr.store.alive()[0]
+        n_requests = len(prov.request_log)
+        prov.preempt_notice(victim.cloud_id, deadline_s=30.0)
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 2})
+        assert len(prov.request_log) == n_requests  # naive: waits
+        prov.lose_instance(victim.cloud_id)
+        mgr.reconcile({"worker": 2})
+        mgr.reconcile({"worker": 2})
+        assert len(prov.request_log) == n_requests + 1  # after death
+
+    def test_notice_storm_bounded_by_max_pending_prebuys(self):
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov, drain_hook=lambda *a: None,
+                              max_pending_prebuys=2)
+        self._converge(mgr, {"worker": 5})
+        victims = mgr.store.alive()[:4]
+        for v in victims:
+            prov.preempt_notice(v.cloud_id, deadline_s=30.0)
+        mgr.reconcile({"worker": 5})
+        # At most 2 victims discounted at once -> at most 2 replacement
+        # hosts requested in the first wave.
+        extra = sum(n for _rid, _nt, n in prov.request_log) - 5
+        assert extra == 2
+        # As the storm's victims die, later waves replace the rest.
+        for v in victims:
+            prov.lose_instance(v.cloud_id)
+        self._converge(mgr, {"worker": 5})
+
+    def test_cancelled_notice_self_corrects_surplus(self):
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov, drain_hook=lambda *a: None)
+        self._converge(mgr, {"worker": 2})
+        victim = mgr.store.alive()[0]
+        prov.preempt_notice(victim.cloud_id, deadline_s=30.0)
+        mgr.reconcile({"worker": 2})  # pre-buys
+        # The cloud cancels the reclaim: notice disappears, the victim
+        # lives — the reconciler terminates the surplus replacement and
+        # converges back to 2.
+        with prov._lock:
+            prov._notices.clear()
+        for _ in range(50):
+            mgr.reconcile({"worker": 2})
+            running = [i for i in mgr.store.alive()
+                       if i.status == RUNNING]
+            if len(running) == 2:
+                break
+        assert len([i for i in mgr.store.alive()
+                    if i.status == RUNNING]) == 2
+        # The survivor is the original victim (doomed-first surplus
+        # ordering must not have killed it while it was noticed).
+        assert any(i.cloud_id == victim.cloud_id
+                   for i in mgr.store.alive())
+
+
+class TestLoseInstanceChaos:
+    def test_chaos_runner_lose_instance_hits_provider(self):
+        """The chaos harness's provider-level loss (no runtime signal)
+        lands on FakeCloudProvider.lose_instance: the host vanishes from
+        describe() entirely — the un-noticed spot reclaim."""
+        import time
+
+        from ray_tpu.devtools.chaos import ChaosRunner, ChaosSchedule
+
+        prov = FakeCloudProvider()
+        mgr = InstanceManager(prov, drain_hook=lambda *a: None)
+        for _ in range(10):
+            mgr.reconcile({"worker": 2})
+        cid = mgr.store.alive()[0].cloud_id
+        sched = ChaosSchedule().lose_instance(0.0, cid)
+        runner = ChaosRunner(None, sched, provider=prov)
+        runner.start()
+        assert runner.join(timeout=30)
+        runner.stop()
+        assert runner.log and runner.log[0]["ok"]
+        assert runner.log[0]["cloud_id"] == cid
+        assert cid not in {ci.cloud_id for ci in prov.describe()}
+        # The manager counts it preempted and replaces it.
+        for _ in range(50):
+            mgr.reconcile({"worker": 2})
+            if len([i for i in mgr.store.alive()
+                    if i.status == RUNNING]) == 2:
+                break
+        assert len([i for i in mgr.store.alive()
+                    if i.status == RUNNING]) == 2
+
+    def test_schedule_mixes_noticed_and_unnoticed(self):
+        """spot_fleet schedules carry both preempts (notice + kill) and
+        bare kills (no notice), seed-deterministic."""
+        from ray_tpu.devtools.chaos import ChaosSchedule
+
+        a = ChaosSchedule.spot_fleet(seed=3, rate=0.5, horizon_s=60.0,
+                                     no_notice_frac=0.3)
+        b = ChaosSchedule.spot_fleet(seed=3, rate=0.5, horizon_s=60.0,
+                                     no_notice_frac=0.3)
+        assert [(e.at_s, e.action, e.deadline_s) for e in a.events] == \
+            [(e.at_s, e.action, e.deadline_s) for e in b.events]
+        kinds = {e.action for e in a.events}
+        assert "preempt" in kinds and "kill" in kinds
+        assert all(e.at_s < 60.0 for e in a.events)

@@ -22,7 +22,7 @@ from ray_tpu.util import telemetry
 
 _NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
 SUBSYSTEMS = ("serve", "llm", "train", "ckpt", "data", "node", "profiler",
-              "internal")
+              "internal", "autoscaler", "slice")
 
 
 class TestCatalog:
@@ -163,6 +163,32 @@ class TestCatalog:
         telemetry.set_gauge("ray_tpu_train_param_shard_bytes", 0.0)
         telemetry.inc("ray_tpu_train_mesh_reshapes_total", 0.0)
 
+    def test_spotfleet_series_registered(self):
+        """The goodput-driven autoscaling / spot-fleet elasticity series
+        (pre-buy, goodput scale events, upsize, slice drains, pending
+        pre-buy gauge) are declared in the catalog — RT204 lints every
+        call site against it."""
+        specs = {
+            "ray_tpu_autoscaler_prebuy_total": ("counter", ()),
+            "ray_tpu_autoscaler_goodput_scale_events_total":
+                ("counter", ("direction",)),
+            "ray_tpu_autoscaler_pending_prebuys": ("gauge", ()),
+            "ray_tpu_train_upsize_total": ("counter", ()),
+            "ray_tpu_slice_drains_total": ("counter", ()),
+        }
+        for name, (typ, tags) in specs.items():
+            assert name in telemetry.CATALOG, name
+            assert telemetry.CATALOG[name]["type"] == typ, name
+            assert tuple(telemetry.CATALOG[name]["tag_keys"]) == tags
+            assert telemetry.CATALOG[name]["description"].strip(), name
+        # The exception-safe helpers record them without raising.
+        telemetry.inc("ray_tpu_autoscaler_prebuy_total", 0.0)
+        telemetry.inc("ray_tpu_autoscaler_goodput_scale_events_total",
+                      0.0, tags={"direction": "up"})
+        telemetry.set_gauge("ray_tpu_autoscaler_pending_prebuys", 0.0)
+        telemetry.inc("ray_tpu_train_upsize_total", 0.0)
+        telemetry.inc("ray_tpu_slice_drains_total", 0.0)
+
     def test_profiler_series_registered(self):
         """The profiler subsystem's series (PR 10: step-phase
         attribution, HBM gauges, compile accounting, capture counter)
@@ -287,6 +313,18 @@ class TestSmokeAllSubsystems:
         node_hex = _control("nodes")[0]["node_id"]
         assert _control("drain_node", node_hex, 30.0, "smoke") is True
         assert _control("undrain_node", node_hex) is True
+
+        # -- autoscaler + slice: a pre-buy decision through the real
+        # policy path (counters book only EXECUTED buys, so the
+        # subsystem series land via the pending gauge) + the
+        # slice-drain counter the SlicePlacementGroup drain path bumps.
+        from ray_tpu.autoscaler import (GoodputAutoscalePolicy,
+                                        GoodputPolicyConfig)
+        pol = GoodputAutoscalePolicy(GoodputPolicyConfig(
+            default_node_type="smoke"))
+        assert len(pol.decide([("node-x", None)], pending=0)) == 1
+        telemetry.set_gauge("ray_tpu_autoscaler_pending_prebuys", 0.0)
+        telemetry.inc("ray_tpu_slice_drains_total")
 
         # -- internal: one accounted swallowed error ----------------------
         telemetry.note_swallowed("test.smoke", RuntimeError("boom"))
