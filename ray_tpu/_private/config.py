@@ -193,6 +193,10 @@ D("visible_accelerator_env", str, "TPU_VISIBLE_CHIPS",
 D("task_events_max_num_task_in_gcs", int, 10000,
   "Bounded task-event history size (reference: ray_config_def.h "
   "task_events_max_num_task_in_gcs).")
+D("sched_decision_ring_size", int, 4096,
+  "Bounded scheduler decision-ring capacity (ray_tpu.schedview): how many "
+  "placement decisions `ray-tpu task why` / sched_decisions.json can look "
+  "back on.  Tracing itself is toggled by RAY_TPU_SCHED_TRACE.")
 D("stack_dump_timeout_s", float, 5.0,
   "How long a cluster-wide stack capture (`ray-tpu stack`, "
   "state.list_stacks) waits for worker replies; non-responders are "

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional
 #: events.jsonl lines / task events captured into a bundle.
 EVENT_TAIL_LINES = 200
 TASK_EVENT_TAIL = 500
+SCHED_DECISION_TAIL = 500
 
 
 def capture_process_stacks(worker_id: str,
@@ -164,6 +165,19 @@ def write_debug_bundle(rt, reason: str,
         g = goodput_summary()
         return json.dumps(g, indent=1) if g is not None else None
     section("goodput.json", _goodput)
+
+    def _sched():
+        # Scheduler decision ring + queue depths: a hang bundle should
+        # say WHY the pending tasks are pending, not just that they are.
+        sched = getattr(rt, "scheduler", None)
+        if sched is None or not hasattr(sched, "ring"):
+            return None
+        return json.dumps({
+            "stats": sched.ring.stats(),
+            "queues": sched.queue_depths(),
+            "decisions": sched.ring.snapshot(limit=SCHED_DECISION_TAIL),
+        }, indent=1, default=str)
+    section("sched_decisions.json", _sched)
 
     def _locks():
         # Lock-order detector findings (RAY_TPU_DEBUG_LOCKS=1): written

@@ -20,12 +20,31 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-# Task states, mirroring the reference's TaskStatus enum (common.proto).
+from ..schedview.decisions import enabled as _sched_trace_enabled
+
+# Task states, mirroring the reference's TaskStatus enum (common.proto),
+# plus the two scheduler-internal stages the schedview lifecycle
+# attribution adds (deps resolved -> ready queue; placement booked).
 PENDING_ARGS = "PENDING_ARGS_AVAIL"
+READY = "READY"
+PLACED = "PLACED"
 SUBMITTED_TO_NODE = "SUBMITTED_TO_WORKER"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
+
+# Stage-wait label per ARRIVING state: the wait is monotonic-minus-
+# monotonic against the previous recorded transition of the same task
+# (never wall-clock arithmetic — the RT203 class), published as
+# ray_tpu_sched_stage_wait_seconds{stage=...}.
+_STAGE_LABEL = {
+    READY: "deps",               # submit -> deps resolved / ready
+    PLACED: "queue",             # ready -> placement booked
+    SUBMITTED_TO_NODE: "dispatch",  # placed -> shipped to a node
+    RUNNING: "startup",          # dispatched -> executing
+    FINISHED: "run",             # running -> done
+    FAILED: "run",
+}
 
 
 @dataclass
@@ -40,6 +59,11 @@ class TaskEvent:
     error_message: Optional[str] = None
     # state -> unix seconds of first entry into that state
     state_times: Dict[str, float] = field(default_factory=dict)
+    # stage label -> seconds waited entering that stage (monotonic
+    # deltas folded from the per-record mono stamps; see _STAGE_LABEL)
+    stage_waits: Dict[str, float] = field(default_factory=dict)
+    # Monotonic stamp of the last folded transition (not serialized).
+    last_mono: Optional[float] = field(default=None, repr=False)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -48,6 +72,7 @@ class TaskEvent:
             "node_id": self.node_id, "worker_id": self.worker_id,
             "error_message": self.error_message,
             "state_times": dict(self.state_times),
+            "stage_waits": dict(self.stage_waits),
         }
 
 
@@ -86,21 +111,36 @@ class TaskEventBuffer:
                task_type: Optional[str] = None, actor_id: Optional[str] = None,
                node_id: Optional[str] = None, worker_id: Optional[str] = None,
                error_message: Optional[str] = None) -> None:
-        # deque.append is thread-safe; no lock on the hot path.
-        self._pending.append((task_id, state, time.time(), name, task_type,
-                              actor_id, node_id, worker_id, error_message))
+        # deque.append is thread-safe; no lock on the hot path.  ONE
+        # clock read: records carry the monotonic stamp (stage waits
+        # are mono-minus-mono, so an NTP step between two transitions
+        # can never mint a negative/garbage latency) and the fold maps
+        # mono->wall through a per-batch offset for state_times.
+        self._pending.append((task_id, state, time.monotonic(),
+                              name, task_type, actor_id, node_id, worker_id,
+                              error_message))
         if len(self._pending) >= self._fold_at:
             self._fold()
 
     def _fold(self) -> None:
+        waits: list = []
+        # Stage waits are only derived while tracing is on: with the
+        # scheduler's READY/PLACED stamps disabled, the delta into
+        # SUBMITTED would silently absorb queue+deps wait and point an
+        # operator at dispatch when the bottleneck was placement.
+        trace = _sched_trace_enabled()
+        # Mono->wall basis shift for this batch's display stamps, not
+        # an interval.
+        wall_offset = time.time() - time.monotonic()  # ray-tpu: noqa[RT203]
         with self._lock:
             while True:
                 try:
-                    (task_id, state, now, name, task_type, actor_id,
+                    (task_id, state, mono, name, task_type, actor_id,
                      node_id, worker_id, error_message) = \
                         self._pending.popleft()
                 except IndexError:
                     break
+                now = mono + wall_offset
                 ev = self._events.get(task_id)
                 if ev is None:
                     ev = TaskEvent(task_id=task_id, name=name or "")
@@ -122,6 +162,27 @@ class TaskEventBuffer:
                     ev.error_message = error_message
                 ev.state = state
                 ev.state_times.setdefault(state, now)
+                if trace:
+                    stage = _STAGE_LABEL.get(state)
+                    if stage is not None and ev.last_mono is not None:
+                        dt = max(0.0, mono - ev.last_mono)
+                        ev.stage_waits[stage] = \
+                            ev.stage_waits.get(stage, 0.0) + dt
+                        waits.append((stage, dt))
+                ev.last_mono = mono
+        # Histogram publication happens OUTSIDE the buffer lock (the
+        # metrics registry has its own) and BATCHED per stage — one
+        # tag-key/lock cycle per fold, not five per task.  Gated by the
+        # same switch as the decision ring so the control_plane bench's
+        # off/on overhead reps toggle the whole addition.
+        if waits:
+            from ray_tpu.util import telemetry
+            by_stage: Dict[str, list] = {}
+            for stage, dt in waits:
+                by_stage.setdefault(stage, []).append(dt)
+            for stage, vals in by_stage.items():
+                telemetry.observe_many("ray_tpu_sched_stage_wait_seconds",
+                                       vals, tags={"stage": stage})
 
     def add_span(self, span: ProfileSpan) -> None:
         with self._lock:
@@ -130,26 +191,89 @@ class TaskEventBuffer:
                 self._spans = self._spans[-self._max:]
 
     def snapshot(self, filters: Optional[Dict[str, Any]] = None,
-                 limit: int = 10000) -> List[Dict[str, Any]]:
+                 limit: int = 10000, stage: Optional[str] = None,
+                 min_stage_wait_s: Optional[float] = None
+                 ) -> List[Dict[str, Any]]:
+        """Filtered task records, newest-``limit`` in insertion order.
+
+        Filters are pushed below the dict materialization and the scan
+        walks newest-first with an early exit, so a point lookup
+        (``state.get_task``) touches O(limit) records even when the ring
+        holds the 10k-node bench's full task table.  ``stage`` +
+        ``min_stage_wait_s`` select tasks by lifecycle-stage latency
+        (e.g. every task that waited >1s in ``queue``)."""
         if limit <= 0:
             return []
         self._fold()
+        out: List[Dict[str, Any]] = []
         with self._lock:
-            events = [e.to_dict() for e in self._events.values()]
-        if filters:
-            for k, v in filters.items():
-                events = [e for e in events if e.get(k) == v]
-        return events[-limit:]
+            for ev in reversed(self._events.values()):
+                if filters:
+                    rec = ev.to_dict()
+                    if any(rec.get(k) != v for k, v in filters.items()):
+                        continue
+                else:
+                    rec = None
+                if stage is not None:
+                    wait = ev.stage_waits.get(stage)
+                    if wait is None or (min_stage_wait_s is not None
+                                        and wait < min_stage_wait_s):
+                        continue
+                out.append(rec if rec is not None else ev.to_dict())
+                if len(out) >= limit:
+                    break
+        out.reverse()
+        return out
 
-    def summary(self) -> Dict[str, Dict[str, int]]:
-        """name -> state -> count (reference: util/state summarize_tasks)."""
+    def summary(self, states: Optional[List[str]] = None,
+                limit: Optional[int] = None) -> Dict[str, Dict[str, int]]:
+        """name -> state -> count (reference: util/state summarize_tasks).
+
+        ``states`` restricts to tasks currently in one of those states;
+        ``limit`` caps the scan to the newest N records — both applied
+        server-side so summaries stay cheap at bench scale."""
         self._fold()
         out: Dict[str, Dict[str, int]] = {}
+        scanned = 0
         with self._lock:
-            for ev in self._events.values():
+            for ev in reversed(self._events.values()):
+                if limit is not None and scanned >= limit:
+                    break
+                scanned += 1
+                if states is not None and ev.state not in states:
+                    continue
                 per = out.setdefault(ev.name or "<unnamed>", {})
                 per[ev.state] = per.get(ev.state, 0) + 1
         return out
+
+    def find_ids(self, prefix: str, limit: int = 8) -> List[str]:
+        """Task ids starting with ``prefix``, newest first (operators
+        paste truncated ids into `ray-tpu task why`)."""
+        self._fold()
+        out: List[str] = []
+        with self._lock:
+            for tid in reversed(self._events):
+                if tid.startswith(prefix):
+                    out.append(tid)
+                    if len(out) >= limit:
+                        break
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        """Buffer health: ring saturation under load must be VISIBLE
+        (a silently clipped history reads as 'no pending tasks').
+
+        ``fold_backlog`` is sampled BEFORE the fold this read performs:
+        it reports how many raw transitions had accumulated since the
+        last fold (fold pressure), while ``num_events``/``num_dropped``
+        are accurate post-fold."""
+        backlog = len(self._pending)
+        self._fold()
+        with self._lock:
+            return {"num_events": len(self._events),
+                    "capacity": self._max,
+                    "num_dropped": self.num_dropped,
+                    "fold_backlog": backlog}
 
     def chrome_trace(self) -> List[Dict[str, Any]]:
         """Chrome trace-event JSON (``ph: X`` complete events), one row per

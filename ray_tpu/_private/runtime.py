@@ -29,7 +29,7 @@ from . import sanitizer
 from . import serialization
 from . import wire as _wire
 from .config import Config
-from .events import (FAILED, FINISHED, PENDING_ARGS, RUNNING,
+from .events import (FAILED, FINISHED, PENDING_ARGS, PLACED, READY, RUNNING,
                      SUBMITTED_TO_NODE, ProfileSpan, TaskEventBuffer)
 from .controller import (ALIVE, DEAD, PENDING_CREATION, PG_PENDING,
                          PG_REMOVED, RESTARTING, ActorInfo, Controller,
@@ -381,6 +381,10 @@ class Runtime:
 
         self.events = TaskEventBuffer(
             Config.get("task_events_max_num_task_in_gcs"))
+        # Control-plane telescope: the scheduler folds READY/PLACED
+        # lifecycle stamps into the TaskEvent ring (stage-wait
+        # histograms derive from the per-transition monotonic stamps).
+        self.scheduler.on_stage = self.events.record
         # worker_id hex -> latest user-metrics snapshot pushed from that
         # process (see ray_tpu.util.metrics).
         self.metrics_snapshots: Dict[str, list] = {}
@@ -2385,11 +2389,91 @@ class Runtime:
         with ast.lock:
             return ("alive", ast.direct_addr, None)
 
-    def ctl_list_tasks(self, filters=None, limit=10000):
-        return self.events.snapshot(filters, limit)
+    def ctl_list_tasks(self, filters=None, limit=10000, stage=None,
+                       min_stage_wait_s=None):
+        """Task-event records with server-side pushdown: equality
+        ``filters``, ``limit`` (newest-first early exit), and lifecycle
+        stage-latency selection (``stage`` + ``min_stage_wait_s``) — a
+        point lookup must stay cheap when the ring holds the 10k-node
+        bench's task table."""
+        return self.events.snapshot(filters, limit, stage,
+                                    min_stage_wait_s)
 
-    def ctl_summarize_tasks(self):
-        return self.events.summary()
+    def ctl_summarize_tasks(self, states=None, limit=None):
+        return self.events.summary(states, limit)
+
+    # -- control-plane telescope (ray_tpu.schedview; reference analog:
+    #    `ray status -v` demand debug strings, here first-class) -------- #
+
+    def ctl_sched_stats(self):
+        """Live scheduler view for `ray-tpu sched` / GET /api/sched:
+        queue depths, decision totals + trailing rates, task-event
+        buffer health (ring saturation), node counts."""
+        self.scheduler._maybe_publish_metrics(force=True)
+        ring = self.scheduler.ring
+        return {
+            "queues": self.scheduler.queue_depths(),
+            "decisions": ring.stats(),
+            "rates": {"decisions_per_s_5s": round(ring.rate(5.0), 2),
+                      "decisions_per_s_60s": round(ring.rate(60.0), 2)},
+            "events": self.events.stats(),
+            "nodes": {"total": len(self.controller.nodes),
+                      "draining": len(self.controller.draining_nodes())},
+        }
+
+    def ctl_sched_decisions(self, task_id=None, limit=200):
+        """Recent scheduler decision records (bounded ring snapshot);
+        ``task_id`` filters, prefix ok."""
+        return self.scheduler.ring.snapshot(task_id, limit)
+
+    def ctl_explain_task(self, task_id_hex: str):
+        """Answer `ray-tpu task why <id>`: why is this task still
+        pending (unresolved deps / closest-fit gap / drain fence /
+        missing PG bundle), or why did it land where it did (the
+        recorded placement decision).  Accepts id prefixes."""
+        matches = {t.hex() for t in self.scheduler.pending_task_ids()
+                   if t.hex().startswith(task_id_hex)}
+        matches.update(self.events.find_ids(task_id_hex))
+        if not matches:
+            return {"task_id": task_id_hex, "status": "unknown",
+                    "reasons": [],
+                    "detail": "no task with this id (or prefix) in the "
+                              "scheduler queues or the task-event ring"}
+        if len(matches) > 1 and task_id_hex not in matches:
+            return {"task_id": task_id_hex, "status": "ambiguous",
+                    "reasons": [], "matches": sorted(matches)[:8]}
+        tid_hex = task_id_hex if task_id_hex in matches \
+            else next(iter(matches))
+        out: Dict[str, Any] = {"task_id": tid_hex}
+        ev = (self.events.snapshot({"task_id": tid_hex}, 1)
+              or [None])[0]
+        if ev is not None:
+            out["state"] = ev["state"]
+            out["name"] = ev["name"]
+            out["stage_waits"] = ev["stage_waits"]
+            out["node_id"] = ev["node_id"]
+            if ev["error_message"]:
+                out["error_message"] = ev["error_message"]
+        decision = self.scheduler.ring.latest_for(tid_hex)
+        if decision is not None:
+            out["last_decision"] = decision
+        pending = None
+        try:
+            pending = self.scheduler.explain_task(TaskID.from_hex(tid_hex))
+        except ValueError:
+            pending = None
+        if pending is not None:
+            out.update(pending)
+            return out
+        # Not held by the scheduler: it placed (or never queued).
+        state = out.get("state")
+        out["status"] = {
+            PENDING_ARGS: "submitted", READY: "ready", PLACED: "placed",
+            SUBMITTED_TO_NODE: "dispatched", RUNNING: "running",
+            FINISHED: "finished", FAILED: "failed",
+        }.get(state, "unknown")
+        out.setdefault("reasons", [])
+        return out
 
     def ctl_list_objects(self, limit=10000):
         out = []

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
@@ -31,6 +32,14 @@ from .ids import NodeID, ObjectID, PlacementGroupID, TaskID
 from .protocol import TaskSpec
 from .resources import ResourceSet
 from ..util import telemetry
+# Direct submodule import (not ``from .. import schedview``): the package
+# attribute may not exist yet while ray_tpu/__init__ is mid-import.
+from ..schedview import decisions as _dec
+
+# Lifecycle stage names the scheduler reports through ``on_stage``
+# (folded into the TaskEvent ring; see _private/events.py).
+STAGE_READY = "READY"
+STAGE_PLACED = "PLACED"
 
 PACK = "PACK"
 SPREAD = "SPREAD"
@@ -57,6 +66,7 @@ class _PendingTask:
     unresolved: Set[ObjectID]
     dispatch: Callable[[TaskSpec, NodeID], None]
     key: Any = None  # scheduling-class key (computed once at submit)
+    attempts: int = 0  # failed placement rounds before this one
 
 
 @dataclass
@@ -70,6 +80,21 @@ class _NodeState:
 
 class Infeasible(Exception):
     """No alive node could ever satisfy the request."""
+
+
+def _resource_gap(need: ResourceSet, avail: ResourceSet) -> Dict[str, float]:
+    """Positive per-resource shortfalls of ``avail`` vs ``need`` (empty
+    dict = fits)."""
+    out: Dict[str, float] = {}
+    for k, v in need.to_dict().items():
+        short = v - avail.get(k)
+        if short > 0:
+            out[k] = round(short, 6)
+    return out
+
+
+def _gap_size(gap: Dict[str, float]) -> float:
+    return sum(gap.values())
 
 
 class ClusterScheduler:
@@ -101,6 +126,24 @@ class ClusterScheduler:
         # (pipelined submission, reference: max_tasks_in_flight_per_worker
         # in the C++ submitter) — such tasks hold NO resource booking.
         self.try_pipeline: Optional[Callable] = None
+        # -- control-plane telescope (ray_tpu.schedview) --------------------
+        # Every placement decision lands in this bounded ring; explain()
+        # reads queued tasks through _task_index.  Set by the Runtime:
+        # on_stage(task_id_hex, stage) folds READY/PLACED lifecycle
+        # stamps into the driver's TaskEvent ring.
+        self.ring = _dec.DecisionRing(Config.get("sched_decision_ring_size"))
+        self.on_stage: Optional[Callable[[str, str], None]] = None
+        self._task_index: Dict[TaskID, _PendingTask] = {}
+        self._pg_created_mono: Dict[PlacementGroupID, float] = {}
+        # Metrics publisher state (rate-limited; hot paths only bump
+        # plain ints/lists, the loop flushes into telemetry off-lock).
+        # _publish_lock serializes the loop's periodic flush against a
+        # ctl_sched_stats(force=True): the counts read-delta-write must
+        # not double-inc the decisions counter.
+        self._attempt_samples: List[int] = []
+        self._published_counts: Dict[str, int] = {}
+        self._publish_next_mono = 0.0
+        self._publish_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, name="scheduler",
                                         daemon=True)
         self._thread.start()
@@ -165,6 +208,8 @@ class ClusterScheduler:
         # already fired, stranding the task in _waiting forever.
         inline_node: Optional[NodeID] = None
         pipeline_ok = False
+        trace = _dec.enabled()
+        info: Optional[Dict[str, Any]] = {} if trace else None
         with self._wake:
             unresolved = {d for d in deps if not self._object_ready(d)}
             if not unresolved and not self._ready_count \
@@ -174,16 +219,39 @@ class ClusterScheduler:
                 # no scheduler-loop wakeup, no GIL handoff per task
                 # (reference: normal_task_submitter.cc:142 pipelines
                 # lease grants the same way).
-                inline_node = self._try_place(spec)
+                inline_node = self._try_place(spec, info)
                 if inline_node is None and self.try_pipeline is not None \
                         and self._pipelineable(spec):
                     pipeline_ok = True  # attempt outside the lock
             if inline_node is None and not pipeline_ok:
                 self._queue_task_locked(spec, dispatch, unresolved)
         if inline_node is not None:
+            if trace:
+                # Class payload is the RAW fields, not _sched_key: the
+                # sorted-tuple build costs ~1.5us and this is the per-
+                # submit fast path; _class_str normalizes at read time.
+                self.ring.push(_dec.K_INLINE, spec.task_id.hex(), spec.name,
+                               (spec.resources, spec.placement_group,
+                                spec.bundle_index,
+                                spec.scheduling_strategy),
+                               info.get("candidates", 1),
+                               info.get("rejected"), inline_node.hex(), 1)
+                # No READY/PLACED stamps on the inline fast path: an
+                # empty-queue placement has zero queue wait by
+                # definition, and the extra record would tax every
+                # submit to attribute a constant 0.  Queued tasks (the
+                # loop path) carry the full stage breakdown.
             self._dispatch_safely(spec, dispatch, inline_node)
         elif pipeline_ok:
-            if not self.try_pipeline(spec):
+            if self.try_pipeline(spec):
+                if trace:
+                    self.ring.push(_dec.K_PIPELINE, spec.task_id.hex(),
+                                   spec.name,
+                                   (spec.resources, spec.placement_group,
+                                    spec.bundle_index,
+                                    spec.scheduling_strategy), 0,
+                                   None, None, 1)
+            else:
                 with self._wake:
                     self._queue_task_locked(spec, dispatch, set())
 
@@ -201,6 +269,11 @@ class ClusterScheduler:
                     self._ready_count -= 1
                     if not bucket:
                         self._ready.pop(key, None)
+                    self._task_index.pop(t.spec.task_id, None)
+                    if _dec.enabled():
+                        self.ring.push(_dec.K_PIPELINE, t.spec.task_id.hex(),
+                                       t.spec.name, t.key, 0, None, None,
+                                       t.attempts + 1)
                     return t
             return None
 
@@ -219,6 +292,7 @@ class ClusterScheduler:
                            unresolved: Set[ObjectID]) -> None:
         task = _PendingTask(spec, unresolved, dispatch,
                             self._sched_key(spec))
+        self._task_index[spec.task_id] = task
         if unresolved:
             for d in unresolved:
                 self._waiting[d].append(task)
@@ -268,6 +342,15 @@ class ClusterScheduler:
                 self._ready_count -= 1
                 if not bucket:
                     self._ready.pop(key, None)
+                self._task_index.pop(task.spec.task_id, None)
+                if _dec.enabled():
+                    # Ring record only — like the inline path, lease
+                    # reuse is a fast path (placed the instant a
+                    # sibling finished) and skips the PLACED lifecycle
+                    # stamp; the loop path keeps full stage stamps.
+                    self.ring.push(_dec.K_EXCHANGE, task.spec.task_id.hex(),
+                                   task.spec.name, key, 1, None,
+                                   node_id.hex(), task.attempts + 1)
                 return task
         self.release(node_id, spec.resources)
         return None
@@ -291,6 +374,7 @@ class ClusterScheduler:
         self._ready_count += 1
 
     def notify_object_ready(self, object_id: ObjectID) -> None:
+        trace = self.on_stage is not None and _dec.enabled()
         with self._wake:
             tasks = self._waiting.pop(object_id, [])
             moved = False
@@ -299,6 +383,13 @@ class ClusterScheduler:
                 if not t.unresolved:
                     self._push_ready_locked(t)
                     moved = True
+                    if trace:
+                        # READY marks DEPS RESOLVED — only tasks that
+                        # actually waited on objects get the stamp; a
+                        # dep-free task's queue wait is PLACED-submit
+                        # and an extra zero-length stage would tax
+                        # every queued submit to record it.
+                        self.on_stage(t.spec.task_id.hex(), STAGE_READY)
             if moved:
                 self._wake.notify_all()
 
@@ -337,6 +428,7 @@ class ClusterScheduler:
         return (res, spec.placement_group, spec.bundle_index, strat)
 
     def _loop(self) -> None:
+        info: Dict[str, Any] = {}  # reused per placement attempt
         while True:
             # Phase 1 (locked): pick placements and deduct resources.
             # Phase 2 (unlocked): run the dispatches — arg resolution,
@@ -353,29 +445,83 @@ class ClusterScheduler:
                 if not self._running:
                     return
                 self._retry_pending_pgs_locked()
+                trace = _dec.enabled()
                 for key in list(self._ready):
                     bucket = self._ready.get(key)
                     while bucket:
                         task = bucket[0]
-                        node_id = self._try_place(task.spec)
+                        info.clear()
+                        node_id = self._try_place(task.spec, info)
                         if node_id is None:
+                            task.attempts += 1
+                            if trace:
+                                self.ring.push(
+                                    _dec.K_REJECT, task.spec.task_id.hex(),
+                                    task.spec.name, key,
+                                    info.get("candidates", 0),
+                                    dict(info.get("rejected") or {}),
+                                    None, task.attempts)
+                            if info.get("infeasible"):
+                                # Park the whole class: no node's TOTAL
+                                # resources could ever satisfy it, so
+                                # rescanning it every wake is pure
+                                # overhead.  add_node revives parked
+                                # tasks (new capacity may fit them).
+                                if trace:
+                                    self.ring.push(
+                                        _dec.K_INFEASIBLE,
+                                        task.spec.task_id.hex(),
+                                        task.spec.name, key, 0,
+                                        {_dec.R_INFEASIBLE:
+                                         max(1, len(self._nodes))},
+                                        None, task.attempts)
+                                self._infeasible.extend(bucket)
+                                self._ready_count -= len(bucket)
+                                bucket.clear()
                             break  # whole class blocked this round
+                        task.attempts += 1
                         bucket.popleft()
                         self._ready_count -= 1
-                        to_dispatch.append((task, node_id))
+                        self._task_index.pop(task.spec.task_id, None)
+                        to_dispatch.append((task, node_id,
+                                            info.get("candidates", 1)))
                     if not bucket:
                         self._ready.pop(key, None)
                 if self._ready_count and not to_dispatch:
                     # Nothing placeable right now; sleep until resources
                     # free (release/notify wake us).
                     self._wake.wait(timeout=0.05)
-            for task, node_id in to_dispatch:
+            # Decision records for the placed batch land OUTSIDE the
+            # condvar (every submit/release/notify serializes behind it)
+            # and BEFORE the dispatches, so a synchronously-completing
+            # dispatch can never file its SUBMITTED/RUNNING transitions
+            # ahead of our PLACED stamp.
+            if trace and to_dispatch:
+                for task, node_id, cands in to_dispatch:
+                    tid_hex = task.spec.task_id.hex()
+                    self.ring.push(_dec.K_LOOP, tid_hex, task.spec.name,
+                                   task.key, cands, None, node_id.hex(),
+                                   task.attempts)
+                    if self.on_stage is not None:
+                        self.on_stage(tid_hex, STAGE_PLACED)
+                with self._lock:
+                    if len(self._attempt_samples) < 512:
+                        self._attempt_samples.extend(
+                            t.attempts for t, _n, _c in to_dispatch)
+            for task, node_id, _cands in to_dispatch:
                 self._dispatch_safely(task.spec, task.dispatch, node_id)
+            self._maybe_publish_metrics()
 
     def stop(self) -> None:
         with self._wake:
             self._running = False
             self._wake.notify_all()
+        # Join (bounded) so standalone schedulers — the control_plane
+        # bench harness, unit tests — never leak their loop thread into
+        # the sanitizer's shutdown diff.  Dispatches run ON the loop
+        # thread, so a stop() from a dispatch callback must not join.
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=2.0)
 
     # -- placement ----------------------------------------------------------
 
@@ -390,7 +536,17 @@ class ClusterScheduler:
                 return key
         return None
 
-    def _try_place(self, spec: TaskSpec) -> Optional[NodeID]:
+    def _try_place(self, spec: TaskSpec,
+                   info: Optional[Dict[str, Any]] = None
+                   ) -> Optional[NodeID]:
+        """Pick + book a node for ``spec`` (None = blocked this round).
+
+        ``info``, when given, receives the decision record the schedview
+        ring keeps: candidate count, per-reason rejection tallies, the
+        policy that picked, and an ``infeasible`` flag when no node's
+        TOTAL resources could ever satisfy the request.  Success paths
+        fill only ``candidates``/``policy`` (O(1) extra); the tally pass
+        runs only on failure, which is off the placement hot path."""
         need = spec.resources
         if spec.placement_group is not None:
             for ns in self._nodes.values():
@@ -398,7 +554,13 @@ class ClusterScheduler:
                                        spec.bundle_index, need)
                 if key is not None and need.fits(ns.bundle_available[key]):
                     ns.bundle_available[key] = ns.bundle_available[key] - need
+                    if info is not None:
+                        info["candidates"] = 1
+                        info["policy"] = "pg_bundle"
                     return ns.info.node_id
+            if info is not None:
+                info["candidates"] = 0
+                info["rejected"] = {_dec.R_BUNDLE: max(1, len(self._nodes))}
             return None
 
         strategy = spec.scheduling_strategy
@@ -407,24 +569,61 @@ class ClusterScheduler:
             if ns is not None and need.fits(ns.available) and \
                     strategy.node_id not in self._draining:
                 ns.available = ns.available - need
+                if info is not None:
+                    info["candidates"] = 1
+                    info["policy"] = "affinity"
                 return ns.info.node_id
             if not strategy.soft:
+                if info is not None:
+                    if ns is None:
+                        why = _dec.R_AFFINITY
+                    elif strategy.node_id in self._draining:
+                        why = _dec.R_DRAINING
+                    else:
+                        why = _dec.R_INSUFFICIENT
+                    info["candidates"] = 0
+                    info["rejected"] = {_dec.R_AFFINITY: 1} \
+                        if why == _dec.R_AFFINITY else {why: 1,
+                                                        _dec.R_AFFINITY: 1}
                 return None  # stays queued until that node frees up
 
         candidates = [ns for ns in self._nodes.values()
                       if ns.info.node_id not in self._draining
                       and need.fits(ns.available)]
+        if info is not None:
+            info["candidates"] = len(candidates)
         if not candidates:
-            if not any(need.fits(ns.info.total_resources)
-                       for ns in self._nodes.values()):
-                pass  # infeasible now; capacity may still appear later
+            if info is not None:
+                rejected: Dict[str, int] = {}
+                draining_n = insufficient = 0
+                for ns in self._nodes.values():
+                    if ns.info.node_id in self._draining:
+                        draining_n += 1
+                    else:
+                        insufficient += 1
+                if not self._nodes:
+                    rejected[_dec.R_NO_NODES] = 1
+                if draining_n:
+                    rejected[_dec.R_DRAINING] = draining_n
+                if insufficient:
+                    rejected[_dec.R_INSUFFICIENT] = insufficient
+                if not any(need.fits(ns.info.total_resources)
+                           for ns in self._nodes.values()):
+                    # No alive node could EVER satisfy this shape.
+                    info["infeasible"] = True
+                    rejected[_dec.R_INFEASIBLE] = max(1, len(self._nodes))
+                info["rejected"] = rejected
             return None
 
         if strategy == "SPREAD":
             self._spread_rr += 1
             ns = candidates[self._spread_rr % len(candidates)]
+            if info is not None:
+                info["policy"] = "spread"
         else:
             ns = self._hybrid_pick(candidates)
+            if info is not None:
+                info["policy"] = "hybrid"
         ns.available = ns.available - need
         return ns.info.node_id
 
@@ -454,8 +653,16 @@ class ClusterScheduler:
         does not fit yet stays PENDING and is retried whenever capacity
         frees up (reference: GcsPlacementGroupManager pending queue)."""
         with self._wake:
+            self._pg_created_mono.setdefault(pg.pg_id, time.monotonic())
             if self._try_commit_pg(pg):
                 return True
+            if _dec.enabled():
+                self.ring.push(
+                    _dec.K_PG_REJECT, pg.pg_id.hex(),
+                    pg.name or "placement_group", pg.strategy, 0,
+                    {_dec.R_BUNDLE:
+                     sum(1 for b in pg.bundles if b.node_id is None)},
+                    None, 1)
             self._pending_pgs.append(pg)
             return False
 
@@ -465,6 +672,7 @@ class ClusterScheduler:
         pending = [b for b in pg.bundles if b.node_id is None]
         if not pending:
             self._controller.set_pg_state(pg.pg_id, PG_CREATED)
+            self._note_pg_committed(pg, [])
             return True
         # Draining nodes never receive NEW bundles (existing bundles on a
         # draining node stay committed; evacuation is the owner's call).
@@ -481,8 +689,26 @@ class ClusterScheduler:
             ns.bundle_available[(pg.pg_id, bundle.index)] = bundle.resources.copy()
             bundle.node_id = node_id
         self._controller.set_pg_state(pg.pg_id, PG_CREATED)
+        self._note_pg_committed(pg, assignment)
         self._wake.notify_all()
         return True
+
+    def _note_pg_committed(self, pg: PlacementGroupInfo,
+                           assignment: List[NodeID]) -> None:
+        """Book the two-phase-commit latency + decision record for a PG
+        that just reached CREATED (PG creates are rare — direct
+        telemetry is fine here, unlike the per-task path)."""
+        created = self._pg_created_mono.pop(pg.pg_id, None)
+        if created is not None:
+            telemetry.observe("ray_tpu_sched_pg_commit_seconds",
+                              max(0.0, time.monotonic() - created))
+        if _dec.enabled():
+            nodes = {b.node_id.hex()[:12] for b in pg.bundles
+                     if b.node_id is not None}
+            self.ring.push(_dec.K_PG_COMMIT, pg.pg_id.hex(),
+                           pg.name or "placement_group", pg.strategy,
+                           len(nodes), None,
+                           ",".join(sorted(nodes)) or None, 1)
 
     def reschedule_lost_bundles(self, pg: PlacementGroupInfo,
                                 dead_node: NodeID) -> None:
@@ -500,6 +726,10 @@ class ClusterScheduler:
             if not lost:
                 return
             self._controller.set_pg_state(pg.pg_id, PG_PENDING)
+            # Re-stamp: the commit-latency histogram books the re-plan
+            # window (node death -> bundles recommitted) as its own
+            # two-phase commit.
+            self._pg_created_mono.setdefault(pg.pg_id, time.monotonic())
             if not self._try_commit_pg(pg) and pg not in self._pending_pgs:
                 self._pending_pgs.append(pg)
 
@@ -580,13 +810,183 @@ class ClusterScheduler:
                     # Return the whole bundle; in-use slices return via release().
                     ns.available = ns.available + remaining
                 b.node_id = None
+            self._pg_created_mono.pop(pg.pg_id, None)
             self._controller.set_pg_state(pg.pg_id, PG_REMOVED)
             self._wake.notify_all()
 
     def num_pending(self) -> int:
         with self._lock:
-            return self._ready_count + sum(
+            return self._ready_count + len(self._infeasible) + sum(
                 len(v) for v in self._waiting.values())
+
+    # -- control-plane telescope (schedview) --------------------------------
+
+    def pending_task_ids(self) -> List[TaskID]:
+        """Every task the scheduler currently holds (waiting on deps,
+        ready, or parked infeasible)."""
+        with self._lock:
+            return list(self._task_index)
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Live queue depths by stage (the `ray-tpu sched` gauges)."""
+        with self._lock:
+            return {
+                "ready": self._ready_count,
+                "ready_classes": len(self._ready),
+                "waiting_deps": sum(len(v)
+                                    for v in self._waiting.values()),
+                "infeasible": len(self._infeasible),
+                "pending_pgs": len(self._pending_pgs),
+            }
+
+    def _maybe_publish_metrics(self, force: bool = False) -> None:
+        """Rate-limited flush of queue depths / decision counts into the
+        telemetry catalog (the hot paths only bump plain ints; this runs
+        on the scheduler loop OUTSIDE the condvar, ~1/s).  A concurrent
+        publisher (loop tick vs ctl_sched_stats poll) skips instead of
+        double-counting the counter deltas."""
+        if not self._publish_lock.acquire(blocking=False):
+            return
+        try:
+            now = time.monotonic()
+            if not force and now < self._publish_next_mono:
+                return
+            self._publish_next_mono = now + 1.0
+            with self._lock:
+                depths = {
+                    "ready": self._ready_count,
+                    "waiting_deps": sum(len(v)
+                                        for v in self._waiting.values()),
+                    "infeasible": len(self._infeasible),
+                    "pending_pgs": len(self._pending_pgs),
+                }
+                samples, self._attempt_samples = self._attempt_samples, []
+            for queue, depth in depths.items():
+                telemetry.set_gauge("ray_tpu_sched_queue_depth",
+                                    float(depth), tags={"queue": queue})
+            counts = dict(self.ring.counts)
+            for kind, total in counts.items():
+                delta = total - self._published_counts.get(kind, 0)
+                if delta > 0:
+                    telemetry.inc("ray_tpu_sched_decisions_total",
+                                  float(delta), tags={"kind": kind})
+            self._published_counts = counts
+            telemetry.observe_many("ray_tpu_sched_placement_attempts",
+                                   [float(a) for a in samples])
+        finally:
+            self._publish_lock.release()
+
+    def explain_task(self, task_id: TaskID) -> Optional[Dict[str, Any]]:
+        """Why is this task still pending?  None if the scheduler does
+        not hold it (it was placed, finished, or never queued — the
+        caller falls back to the decision ring / task events).
+
+        The analysis is a DRY placement run against live state: it never
+        books resources, and it names the closest-fit node plus the
+        exact resource gap when nothing fits."""
+        with self._lock:
+            t = self._task_index.get(task_id)
+            if t is None:
+                return None
+            if t.unresolved:
+                return {
+                    "status": "pending_deps",
+                    "reasons": [_dec.R_PENDING_DEPS],
+                    "unresolved_deps": sorted(d.hex()
+                                              for d in t.unresolved),
+                    "attempts": t.attempts,
+                }
+            out = self._analyze_locked(t.spec)
+            out["attempts"] = t.attempts
+            return out
+
+    def _analyze_locked(self, spec: TaskSpec) -> Dict[str, Any]:
+        """Non-mutating placement analysis for a ready-but-unplaced
+        task: reason codes, candidate count, closest-fit node + gap."""
+        need = spec.resources
+        info: Dict[str, Any] = {}
+        out: Dict[str, Any] = {"status": "queued"}
+        if spec.placement_group is not None:
+            committed = [
+                key for ns in self._nodes.values()
+                for key in ns.bundle_available
+                if key[0] == spec.placement_group
+            ]
+            out["reasons"] = [_dec.R_BUNDLE]
+            out["pg"] = {
+                "placement_group_id": spec.placement_group.hex(),
+                "bundle_index": spec.bundle_index,
+                "committed_bundles": sorted(k[1] for k in committed),
+            }
+            # A committed-but-full bundle is a capacity gap, not a
+            # missing commit: report the gap of the closest bundle.
+            best_gap = None
+            for ns in self._nodes.values():
+                for key, avail in ns.bundle_available.items():
+                    if key[0] != spec.placement_group:
+                        continue
+                    if spec.bundle_index >= 0 and \
+                            key[1] != spec.bundle_index:
+                        continue
+                    gap = _resource_gap(need, avail)
+                    if best_gap is None or \
+                            _gap_size(gap) < _gap_size(best_gap[1]):
+                        best_gap = (ns.info.node_id.hex(), gap)
+            if best_gap is not None:
+                out["closest_fit"] = {"node_id": best_gap[0],
+                                      "gap": best_gap[1]}
+            return out
+
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, NodeAffinitySchedulingStrategy) \
+                and not strategy.soft:
+            ns = self._nodes.get(strategy.node_id)
+            reasons = [_dec.R_AFFINITY]
+            if ns is not None:
+                if strategy.node_id in self._draining:
+                    reasons.append(_dec.R_DRAINING)
+                elif not need.fits(ns.available):
+                    reasons.append(_dec.R_INSUFFICIENT)
+                    out["closest_fit"] = {
+                        "node_id": strategy.node_id.hex(),
+                        "gap": _resource_gap(need, ns.available) or {}}
+            out["reasons"] = reasons
+            out["affinity_node"] = strategy.node_id.hex()
+            return out
+
+        # Normal strategy: reuse _try_place's failure tallies (dry: an
+        # analysis pass must never book, and candidates>0 here only
+        # means the scheduler loop has not reached the task yet).
+        saved = [(ns, ns.available) for ns in self._nodes.values()]
+        node = self._try_place(spec, info)
+        if node is not None:
+            # Roll the dry booking back.
+            for ns, avail in saved:
+                ns.available = avail
+            out["reasons"] = []
+            out["status"] = "placeable"
+            out["candidates"] = info.get("candidates", 1)
+            return out
+        rejected = info.get("rejected") or {}
+        out["rejected"] = rejected
+        out["candidates"] = info.get("candidates", 0)
+        out["reasons"] = sorted(rejected,
+                                key=lambda r: -rejected[r]) or \
+            [_dec.R_INSUFFICIENT]
+        if info.get("infeasible"):
+            out["status"] = "infeasible"
+        # Closest fit: the non-draining node with the smallest total
+        # resource gap (what the autoscaler would need to add).
+        best = None
+        for ns in self._nodes.values():
+            if ns.info.node_id in self._draining:
+                continue
+            gap = _resource_gap(need, ns.available)
+            if best is None or _gap_size(gap) < _gap_size(best[1]):
+                best = (ns.info.node_id.hex(), gap)
+        if best is not None:
+            out["closest_fit"] = {"node_id": best[0], "gap": best[1]}
+        return out
 
     def pending_demand(self, include_pg_bundles: bool = True
                        ) -> List[Dict[str, float]]:

@@ -7,6 +7,10 @@ Endpoints (reference: dashboard/modules/*):
     GET /api/actors             — actor table
     GET /api/tasks?limit=N      — task events
     GET /api/tasks/summary      — per-function state counts
+    GET /api/sched              — scheduler queue depths, decision rates,
+                                  event-buffer health (?decisions=N adds
+                                  decision-ring records)
+    GET /api/tasks/explain?task_id=ID — why pending / why that node
     GET /api/objects            — object directory
     GET /api/placement_groups   — PG table
     GET /api/jobs               — job table
@@ -40,6 +44,7 @@ async function refresh(){
   const actors = await (await fetch('/api/actors')).json();
   const summary = await (await fetch('/api/tasks/summary')).json();
   const telem = await (await fetch('/api/metrics/summary')).json();
+  const sched = await (await fetch('/api/sched')).json();
   let h = '<h2>cluster</h2><table>';
   for (const [k,v] of Object.entries(c.total_resources))
     h += `<tr><td>${k}</td><td>${c.available_resources[k]??0} / ${v}</td></tr>`;
@@ -50,6 +55,17 @@ async function refresh(){
   h += '</table><h2>tasks</h2><table><tr><th>name</th><th>states</th></tr>';
   for (const [name,states] of Object.entries(summary))
     h += `<tr><td>${name}</td><td>${JSON.stringify(states)}</td></tr>`;
+  h += '</table>';
+  // Scheduler telescope: queue depths, decision rates, and event-ring
+  // saturation (dropped/backlog must be visible, never silent).
+  const ss = sched.stats;
+  h += '<h2>scheduler</h2><table>'
+    + `<tr><td>decisions/s (5s)</td><td>${ss.rates.decisions_per_s_5s}</td></tr>`
+    + `<tr><td>decisions total</td><td>${ss.decisions.total} (ring dropped ${ss.decisions.num_dropped})</td></tr>`;
+  for (const [q,d] of Object.entries(ss.queues))
+    h += `<tr><td>queue ${q}</td><td>${d}</td></tr>`;
+  h += `<tr><td>task events</td><td>${ss.events.num_events}/${ss.events.capacity} `
+    + `(dropped ${ss.events.num_dropped}, fold backlog ${ss.events.fold_backlog})</td></tr>`;
   h += '</table>';
   // Built-in system telemetry: serving / training / llm / data metrics.
   h += '<h2>system telemetry</h2>';
@@ -127,6 +143,24 @@ class DashboardServer:
 
         async def tasks_summary(req):
             return self._json(rt.ctl_summarize_tasks())
+
+        async def sched(req):
+            # Control-plane telescope: queue depths, decision rates,
+            # event-buffer saturation; ?decisions=N adds ring records.
+            try:
+                n = int(req.query.get("decisions", 0))
+            except ValueError:
+                return web.Response(status=400, text="bad decisions")
+            out = {"stats": rt.ctl_sched_stats()}
+            if n > 0:
+                out["decisions"] = rt.ctl_sched_decisions(None, n)
+            return self._json(out)
+
+        async def task_explain(req):
+            task_id = req.query.get("task_id", "")
+            if not task_id:
+                return web.Response(status=400, text="task_id required")
+            return self._json(rt.ctl_explain_task(task_id))
 
         async def objects(req):
             return self._json(rt.ctl_list_objects())
@@ -214,6 +248,8 @@ class DashboardServer:
         app.router.add_get("/api/actors", actors)
         app.router.add_get("/api/tasks", tasks)
         app.router.add_get("/api/tasks/summary", tasks_summary)
+        app.router.add_get("/api/sched", sched)
+        app.router.add_get("/api/tasks/explain", task_explain)
         app.router.add_get("/api/objects", objects)
         app.router.add_get("/api/placement_groups", pgs)
         app.router.add_get("/api/jobs", jobs)

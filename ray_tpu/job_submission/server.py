@@ -206,6 +206,33 @@ class JobServer:
                     {"error": f"no alive node {node_id!r}"}, status=404)
             return web.json_response({"ok": True})
 
+        async def cluster_sched(request):
+            """Control-plane telescope (`ray-tpu sched`): queue depths,
+            decision totals/rates, event-buffer health; ?decisions=N
+            also returns the last N decision-ring records."""
+            from ray_tpu._private.api import _control
+            out = {"stats": await call(_control, "sched_stats")}
+            try:
+                n = int(request.query.get("decisions", "0"))
+            except ValueError:
+                return web.json_response(
+                    {"error": "bad decisions"}, status=400)
+            if n > 0:
+                out["decisions"] = await call(
+                    _control, "sched_decisions", None, n)
+            return web.json_response(out)
+
+        async def cluster_task_explain(request):
+            """`ray-tpu task why <id>`: why is this task pending / why
+            did it land where it did (id prefix ok)."""
+            from ray_tpu._private.api import _control
+            task_id = request.query.get("task_id", "")
+            if not task_id:
+                return web.json_response(
+                    {"error": "task_id required"}, status=400)
+            return web.json_response(
+                await call(_control, "explain_task", task_id))
+
         async def timeline(request):
             from ray_tpu._private.api import _control
             return web.json_response(await call(_control, "timeline"))
@@ -231,6 +258,9 @@ class JobServer:
             app.router.add_post("/api/cluster/profile", cluster_profile)
             app.router.add_post("/api/cluster/drain_node",
                                 cluster_drain_node)
+            app.router.add_get("/api/cluster/sched", cluster_sched)
+            app.router.add_get("/api/cluster/task_explain",
+                               cluster_task_explain)
             app.router.add_get("/metrics", metrics)
             app.router.add_get(
                 "/-/healthz", lambda r: web.json_response({"ok": True}))

@@ -228,6 +228,111 @@ def status(address):
         click.echo("watchdog: n/a (no watchdog verdict recorded)")
 
 
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--decisions", "-n", "num_decisions", type=int, default=0,
+              help="Also print the last N scheduler decision records.")
+def sched(address, num_decisions):
+    """Live control-plane view: scheduler queue depths, decision rates
+    and totals by kind, and task-event ring health (dropped events /
+    fold backlog) — the first thing to look at when submissions pile
+    up.  `ray-tpu task why <id>` digs into one task."""
+    from urllib.parse import urlencode
+    client = _client(address)
+    path = "/api/cluster/sched"
+    if num_decisions:
+        path += "?" + urlencode({"decisions": num_decisions})
+    out = client._request("GET", path)
+    s = out["stats"]
+    r, d = s["rates"], s["decisions"]
+    click.echo(f"decisions/s: {r['decisions_per_s_5s']:g} (5s)  "
+               f"{r['decisions_per_s_60s']:g} (60s)   "
+               f"total {d['total']}"
+               + (f"  RING DROPPED {d['num_dropped']}"
+                  if d["num_dropped"] else ""))
+    if d["counts"]:
+        kinds = ", ".join(f"{k}={v}" for k, v in sorted(d["counts"].items()))
+        click.echo(f"  by kind: {kinds}")
+    click.echo("queues:")
+    for q, depth in sorted(s["queues"].items()):
+        click.echo(f"  {q}: {depth}")
+    ev = s["events"]
+    click.echo(f"task events: {ev['num_events']}/{ev['capacity']} "
+               f"(dropped {ev['num_dropped']}, "
+               f"fold backlog {ev['fold_backlog']})")
+    n = s["nodes"]
+    click.echo(f"nodes: {n['total']} ({n['draining']} draining)")
+    for rec in out.get("decisions", []):
+        rej = "".join(f" {k}:{v}" for k, v in rec["rejected"].items())
+        # Full task id: ids share the job-id prefix, so a truncated id
+        # would be ambiguous when pasted into `ray-tpu task why`.
+        click.echo(f"  [{rec['kind']:>10}] {rec['task_id'] or '-'} "
+                   f"{rec['name'] or '':.24} attempt={rec['attempt']} "
+                   f"cands={rec['candidates']} "
+                   f"node={(rec['node_id'] or '-'):.12}{rej}")
+
+
+@cli.group()
+def task():
+    """Task-level introspection (control-plane telescope)."""
+
+
+@task.command("why")
+@click.option("--address", default=None)
+@click.argument("task_id")
+def task_why(address, task_id):
+    """Explain TASK_ID (hex, prefix ok): why it is still pending —
+    unresolved deps by ObjectID, the closest-fit node and its resource
+    gap, the drain fence or missing placement-group bundle rejecting it
+    — or, once placed, why it landed on its node."""
+    from urllib.parse import urlencode
+    client = _client(address)
+    out = client._request(
+        "GET", "/api/cluster/task_explain?" + urlencode(
+            {"task_id": task_id}))
+    status = out.get("status", "unknown")
+    if status == "ambiguous":
+        raise click.ClickException(
+            f"ambiguous task prefix {task_id!r}:\n  "
+            + "\n  ".join(out.get("matches", [])))
+    click.echo(f"task {out['task_id']} "
+               f"{out.get('name') or ''}".rstrip())
+    click.echo(f"status: {status}")
+    if status == "unknown":
+        click.echo(f"  {out.get('detail', 'not found')}")
+        raise SystemExit(1)
+    if out.get("reasons"):
+        click.echo("reasons: " + ", ".join(out["reasons"]))
+    for dep in out.get("unresolved_deps", []):
+        click.echo(f"  waiting on object {dep[:16]}")
+    cf = out.get("closest_fit")
+    if cf:
+        gap = ", ".join(f"{k} short {v:g}" for k, v in cf["gap"].items()) \
+            or "fits (queued behind the scheduler loop)"
+        click.echo(f"closest fit: node {cf['node_id'][:12]} — {gap}")
+    pg = out.get("pg")
+    if pg:
+        click.echo(f"placement group {pg['placement_group_id'][:12]} "
+                   f"bundle {pg['bundle_index']}: committed bundles "
+                   f"{pg['committed_bundles'] or 'none'}")
+    if out.get("node_id"):
+        click.echo(f"node: {out['node_id'][:12]}")
+    dec = out.get("last_decision")
+    if dec:
+        rej = "".join(f" {k}:{v}" for k, v in dec["rejected"].items())
+        click.echo(f"last decision: {dec['kind']} "
+                   f"attempt={dec['attempt']} cands={dec['candidates']} "
+                   f"class[{dec['sched_class']}]"
+                   f"{' node=' + dec['node_id'][:12] if dec['node_id'] else ''}"
+                   f"{rej}")
+    waits = out.get("stage_waits") or {}
+    if waits:
+        click.echo("stage waits: " + ", ".join(
+            f"{k}={v * 1e3:.1f}ms" for k, v in waits.items()))
+    if out.get("error_message"):
+        click.echo(f"error: {out['error_message']}")
+
+
 @cli.group()
 def job():
     """Job submission and management."""

@@ -151,8 +151,14 @@ class Histogram(Metric):
                 f"boundaries {existing._boundaries}; got "
                 f"{self._boundaries}")
 
-    def observe(self, value: float,
-                tags: Optional[Dict[str, str]] = None) -> None:
+    def observe_many(self, values: Sequence[float],
+                     tags: Optional[Dict[str, str]] = None) -> None:
+        """Record a batch of observations under ONE tag-key resolution
+        and lock acquisition — for amortized publishers (e.g. the task
+        event ring folding a thousand stage waits at once) where a
+        per-value observe() would put lock traffic on a hot path."""
+        if not values:
+            return
         key = _tags_key(self._merge_tags(tags))
         with self._lock:
             state = self._values.get(key)
@@ -160,16 +166,23 @@ class Histogram(Metric):
                 state = {"buckets": [0] * (len(self._boundaries) + 1),
                          "sum": 0.0, "count": 0}
                 self._values[key] = state
-            idx = len(self._boundaries)
-            for i, b in enumerate(self._boundaries):
-                if value <= b:
-                    idx = i
-                    break
-            state["buckets"][idx] += 1
-            state["sum"] += value
-            state["count"] += 1
+            buckets = state["buckets"]
+            last = len(self._boundaries)
+            for value in values:
+                idx = last
+                for i, b in enumerate(self._boundaries):
+                    if value <= b:
+                        idx = i
+                        break
+                buckets[idx] += 1
+                state["sum"] += value
+            state["count"] += len(values)
         global _dirty
         _dirty = True
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        self.observe_many((value,), tags=tags)
 
     def _samples(self):
         out = []

@@ -16,9 +16,14 @@ from ray_tpu._private.api import _control
 
 
 def list_tasks(filters: Optional[List] = None,
-               limit: int = 10000, **_: Any) -> List[Dict[str, Any]]:
+               limit: int = 10000, stage: Optional[str] = None,
+               min_stage_wait_s: Optional[float] = None,
+               **_: Any) -> List[Dict[str, Any]]:
     """Task event records. ``filters`` is a list of (key, "=", value)
-    triples like the reference's predicate filters."""
+    triples like the reference's predicate filters.  ``stage`` (deps|
+    queue|dispatch|startup|run) selects tasks by lifecycle stage, and
+    ``min_stage_wait_s`` keeps only those that waited at least that
+    long entering it — both pushed down server-side."""
     fd = None
     if filters:
         fd = {}
@@ -26,7 +31,7 @@ def list_tasks(filters: Optional[List] = None,
             if op not in ("=", "=="):
                 raise ValueError(f"only equality filters supported, got {op}")
             fd[key] = value
-    return _control("list_tasks", fd, limit)
+    return _control("list_tasks", fd, limit, stage, min_stage_wait_s)
 
 
 def list_actors(**_: Any) -> List[Dict[str, Any]]:
@@ -49,9 +54,38 @@ def list_jobs(**_: Any) -> List[Dict[str, Any]]:
     return _control("list_jobs")
 
 
-def summarize_tasks(**_: Any) -> Dict[str, Dict[str, int]]:
-    """name -> {state -> count} (reference: api.py summarize_tasks)."""
-    return _control("summarize_tasks")
+def summarize_tasks(states: Optional[List[str]] = None,
+                    limit: Optional[int] = None,
+                    **_: Any) -> Dict[str, Dict[str, int]]:
+    """name -> {state -> count} (reference: api.py summarize_tasks).
+    ``states`` restricts to tasks currently in those states and
+    ``limit`` caps the scan to the newest N records (server-side)."""
+    if states is None and limit is None:
+        return _control("summarize_tasks")
+    return _control("summarize_tasks", states, limit)
+
+
+def explain_task(task_id: str) -> Dict[str, Any]:
+    """Why is this task still pending — unresolved deps by ObjectID,
+    the closest-fit node and its resource gap, the drain fence or
+    missing PG bundle that rejected it — or, once placed, why it landed
+    on its node (the recorded scheduler decision).  ``task_id`` may be
+    a prefix (`ray-tpu task why` rides this)."""
+    return _control("explain_task", task_id)
+
+
+def sched_stats() -> Dict[str, Any]:
+    """Live control-plane stats: scheduler queue depths, decision
+    totals + trailing decision rates, task-event buffer health."""
+    return _control("sched_stats")
+
+
+def sched_decisions(task_id: Optional[str] = None,
+                    limit: int = 200) -> List[Dict[str, Any]]:
+    """Recent scheduler decision records from the bounded ring
+    (``sched_decisions.json`` in flight-recorder bundles is the same
+    snapshot)."""
+    return _control("sched_decisions", task_id, limit)
 
 
 def summarize_actors(**_: Any) -> Dict[str, Dict[str, int]]:

@@ -330,6 +330,45 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "description": "On-demand cluster profile captures served "
                        "(`ray-tpu profile` / POST /api/profile / "
                        "flight-recorder auto-attach)."},
+    # -- sched (control-plane telescope: scheduler decision tracing) -------
+    "ray_tpu_sched_decisions_total": {
+        "type": "counter", "tag_keys": ("kind",),
+        "description": "Scheduler decisions by kind (inline|loop|"
+                       "exchange|pipeline|reject|infeasible|pg_commit|"
+                       "pg_reject).  Flushed from the decision ring's "
+                       "plain-int tallies by the rate-limited publisher "
+                       "— never a counter op on the placement hot "
+                       "path."},
+    "ray_tpu_sched_stage_wait_seconds": {
+        "type": "histogram", "tag_keys": ("stage",),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Task lifecycle stage waits (stage=deps|queue|"
+                       "dispatch|startup|run), derived monotonic-minus-"
+                       "monotonic from the TaskEvent ring's per-"
+                       "transition stamps.  A fat 'queue' tail means "
+                       "placement is the bottleneck; a fat 'dispatch' "
+                       "tail means arg resolution / the worker pipe "
+                       "is."},
+    "ray_tpu_sched_placement_attempts": {
+        "type": "histogram", "tag_keys": (),
+        "boundaries": _SIZE_BUCKETS,
+        "description": "Placement rounds a task needed before it was "
+                       "booked onto a node (1 = placed on first look; "
+                       "the tail counts retry pressure from full/"
+                       "draining clusters)."},
+    "ray_tpu_sched_pg_commit_seconds": {
+        "type": "histogram", "tag_keys": (),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Placement-group two-phase commit latency: "
+                       "register -> every bundle committed (includes "
+                       "the PENDING retry window while capacity is "
+                       "awaited; node-death re-plans re-enter here)."},
+    "ray_tpu_sched_queue_depth": {
+        "type": "gauge", "tag_keys": ("queue",),
+        "description": "Scheduler queue depths (queue=ready|"
+                       "waiting_deps|infeasible|pending_pgs), refreshed "
+                       "~1/s by the scheduler loop's metrics "
+                       "publisher."},
     # -- internal ----------------------------------------------------------
     "ray_tpu_internal_swallowed_errors_total": {
         "type": "counter", "tag_keys": ("where",),
@@ -409,6 +448,16 @@ def observe(name: str, value: float,
             tags: Optional[Dict[str, str]] = None) -> None:
     try:
         histogram(name).observe(value, tags=tags)
+    except Exception:
+        pass
+
+
+def observe_many(name: str, values, tags: Optional[Dict[str, str]] = None
+                 ) -> None:
+    """Batch-observe under one lock (amortized publishers: stage-wait
+    folds, the scheduler's attempt-sample flush)."""
+    try:
+        histogram(name).observe_many(values, tags=tags)
     except Exception:
         pass
 
