@@ -208,6 +208,17 @@ D("debug_bundle_on_worker_death", bool, True,
 D("debug_bundle_min_interval_s", float, 60.0,
   "Minimum seconds between automatic worker-death debug bundles, so a "
   "crash loop cannot fill the disk with forensics.")
+D("metricsview_interval_s", float, 1.0,
+  "Metrics time-series store downsample interval: at most one stored "
+  "point per series per interval regardless of flush rate "
+  "(ray_tpu.metricsview; retention = interval * metricsview_max_points).")
+D("metricsview_max_points", int, 600,
+  "Ring capacity per (series, tag-set) in the metrics time-series store "
+  "(default 600 points x 1 s interval = 10 min of queryable history).")
+D("metricsview_max_series", int, 2048,
+  "Hard cap on distinct (series, tag-set) rings the head will track; "
+  "overflow increments ray_tpu_metricsview_dropped_total instead of "
+  "growing without bound.")
 D("debug_bundle_profile_s", float, 0.0,
   "Attach an on-demand cluster profile of this duration to every "
   "flight-recorder bundle (profile_trace.json); 0 disables.  The train "

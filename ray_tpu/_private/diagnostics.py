@@ -202,6 +202,25 @@ def write_debug_bundle(rt, reason: str,
         return json.dumps(out["trace"], default=str)
     section("profile_trace.json", _profile)
 
+    def _alerts():
+        # SLO alert states + recent transitions: a bundle written because
+        # something went wrong should say which objectives were burning.
+        view = getattr(rt, "metricsview", None)
+        if view is None:
+            return None
+        return json.dumps(view.alerts(recent=100), indent=1, default=str)
+    section("alerts.json", _alerts)
+
+    def _history():
+        # Recent time-series history (bounded per-series tail) so the
+        # bundle carries the minutes BEFORE the incident, not just the
+        # instant of it (metrics.prom is only the final cumulative state).
+        view = getattr(rt, "metricsview", None)
+        if view is None:
+            return None
+        return json.dumps(view.bundle_snapshot(), indent=1, default=str)
+    section("metrics_history.json", _history)
+
     def _leaks():
         # Leak-sanitizer registries (RAY_TPU_SANITIZE=1): the live
         # framework threads / pins / tracked handles / named actors with

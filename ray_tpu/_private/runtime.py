@@ -388,6 +388,11 @@ class Runtime:
         # worker_id hex -> latest user-metrics snapshot pushed from that
         # process (see ray_tpu.util.metrics).
         self.metrics_snapshots: Dict[str, list] = {}
+        # Metrics time-series backplane: bounded history + windowed
+        # queries + SLO burn-rate alerts, fed from the metrics_push
+        # verb (no reporting loop of its own; see ray_tpu.metricsview).
+        from ray_tpu.metricsview import MetricsView
+        self.metricsview = MetricsView(event_sink=self._export_event)
 
         # -- live diagnostics (reference: `ray stack` + the debug-state
         # dump; see diagnostics.py) ------------------------------------- #
@@ -2801,9 +2806,38 @@ class Runtime:
             ProfileSpan(name, category, start_s, end_s, pid, tid, extra))
         return True
 
-    def ctl_push_metrics(self, source_id: str, snapshot):
+    def ctl_metrics_push(self, source_id: str, snapshot):
+        """One batched per-process metrics flush (util/metrics.py flush
+        paths).  Stores the latest snapshot for the merged scrape AND
+        gives the time-series backplane its ingest tick — piggybacked
+        here so history needs no second reporting loop."""
         self.metrics_snapshots[source_id] = snapshot
+        self.metricsview.on_push()
         return True
+
+    # Back-compat verb name (pre-metricsview workers).
+    ctl_push_metrics = ctl_metrics_push
+
+    def ctl_metrics_query(self, name: str, window_s: float = 60.0,
+                          agg: str = "avg", tags=None):
+        return self.metricsview.query(name, window_s, agg, tags=tags)
+
+    def ctl_metrics_history(self, name: str, window_s: float = 300.0,
+                            tags=None, max_points: int = 240):
+        return self.metricsview.history(name, window_s, tags=tags,
+                                        max_points=max_points)
+
+    def ctl_metrics_series(self):
+        return self.metricsview.store.series_names()
+
+    def ctl_alerts(self, recent: int = 50):
+        return self.metricsview.alerts(recent=recent)
+
+    def ctl_slo_set(self, objectives):
+        return self.metricsview.set_objectives(objectives)
+
+    def ctl_slo_list(self):
+        return self.metricsview.slo.objectives()
 
     # -- tracing (reference: util/tracing/tracing_helper.py spans routed
     #    to a collector; here an in-memory bounded span table) ----------- #

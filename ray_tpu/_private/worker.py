@@ -951,11 +951,15 @@ class WorkerLoop:
                     traceback.print_exc()
         try:
             self._executor.shutdown()
-            # Final metrics push rides the outbox drain below (fire and
-            # forget: the recv loop that would deliver a reply is gone).
+            # Terminal metrics push rides the outbox drain below (fire
+            # and forget: the recv loop that would deliver a reply is
+            # gone).  Unconditional, NOT the dirty-flag-gated task-done
+            # flush: samples recorded after the last task's flush (during
+            # executor shutdown, teardown hooks, atexit-adjacent paths)
+            # have no later completion to retry on.
             try:
-                from ..util.metrics import flush_on_task_done
-                flush_on_task_done()
+                from ..util.metrics import flush_terminal
+                flush_terminal()
             except Exception:
                 pass
             rt.flush_and_close()

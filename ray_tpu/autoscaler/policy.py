@@ -30,9 +30,10 @@ at the cloud-provider layer.
 from __future__ import annotations
 
 import time
-from collections import deque
-from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.metricsview import SeriesStore
 
 
 @dataclass
@@ -65,22 +66,33 @@ class ScaleDecision:
     victim: Optional[str] = None
 
 
-@dataclass
-class _GoodputSample:
-    t: float
-    productive_s: float
-    total_s: float
+#: Private series names inside the policy's sag-window store (counters:
+#: the tracker's cumulative seconds; a restart shows as a value drop the
+#: reset-aware ``delta`` agg starts a fresh window from).
+_PRODUCTIVE = "autoscaler_goodput_productive_s"
+_TOTAL = "autoscaler_goodput_total_s"
 
 
 class GoodputAutoscalePolicy:
     """Turns (goodput stream, preemption notices, pending-buy count) into
     buy decisions.  Stateless about the cluster — the caller owns launch
     execution and join tracking and reports ``pending`` back each tick.
+
+    The sag window rides ``ray_tpu.metricsview``: goodput summaries land
+    as two counter series in a private bounded ``SeriesStore`` and
+    ``windowed_goodput()`` is a pair of reset-aware ``delta`` queries —
+    the same windowed-query substrate every other control loop reads.
     """
 
     def __init__(self, config: Optional[GoodputPolicyConfig] = None):
         self.config = config or GoodputPolicyConfig()
-        self._samples: Deque[_GoodputSample] = deque()
+        # Downsample at ~1 s (the autoscaler tick cadence); ring sized so
+        # retention comfortably covers the configured window.
+        self._window = SeriesStore(
+            interval_s=1.0,
+            max_points=max(16, int(self.config.window_s) * 4),
+            max_series=4)
+        self._last_observed: Optional[float] = None
         self._sag_since: Optional[float] = None
         self._last_goodput_buy: float = -1e18
         #: Victims already pre-bought (a notice repeats every tick until
@@ -100,27 +112,27 @@ class GoodputAutoscalePolicy:
             self._sag_since = None
             self.last_windowed_goodput = None
             return
-        self._samples.append(_GoodputSample(
-            now, float(summary.get("productive_s", 0.0)),
-            float(summary.get("total_s", 0.0))))
-        cutoff = now - self.config.window_s
-        while len(self._samples) > 2 and self._samples[1].t <= cutoff:
-            self._samples.popleft()
+        self._window.append(_PRODUCTIVE, {}, "counter",
+                            float(summary.get("productive_s", 0.0)), now)
+        self._window.append(_TOTAL, {}, "counter",
+                            float(summary.get("total_s", 0.0)), now)
+        self._last_observed = now
 
     def windowed_goodput(self) -> Optional[float]:
         """Recent goodput: delta-productive over delta-total across the
-        observation window.  None until two samples of the SAME run
-        exist (a restarted tracker resets its cumulative counters, which
-        would otherwise yield negative deltas — treated as a fresh
-        window)."""
-        if len(self._samples) < 2:
+        observation window (metricsview ``delta`` queries anchored at
+        the last observation).  None until two samples of the SAME run
+        exist — the reset-aware delta measures from the last tracker
+        restart, so a restart's stale prefix yields a zero-width window,
+        not a negative or phantom ratio."""
+        if self._last_observed is None:
             return None
-        first, last = self._samples[0], self._samples[-1]
-        d_total = last.total_s - first.total_s
-        d_prod = last.productive_s - first.productive_s
-        if d_total <= 0 or d_prod < 0:
-            # Tracker restarted mid-window: drop the stale prefix.
-            self._samples = deque([last])
+        now = self._last_observed
+        d_total = self._window.query(_TOTAL, self.config.window_s,
+                                     "delta", now=now)["value"]
+        d_prod = self._window.query(_PRODUCTIVE, self.config.window_s,
+                                    "delta", now=now)["value"]
+        if d_total is None or d_prod is None or d_total <= 0 or d_prod < 0:
             return None
         return max(0.0, min(1.0, d_prod / d_total))
 

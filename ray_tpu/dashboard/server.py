@@ -196,6 +196,44 @@ class DashboardServer:
             from ..util import telemetry
             return self._json(telemetry.summary())
 
+        async def metrics_history(req):
+            # Sparkline JSON from the head's time-series store
+            # (ray_tpu.metricsview): per matching series a list of
+            # [age_s, value] rows, newest age ~0.  ?name= is required;
+            # ?window=, ?points= and repeated ?tag=k=v refine it.
+            name = req.query.get("name", "")
+            if not name:
+                return web.Response(status=400, text="name required")
+            try:
+                window_s = float(req.query.get("window", 300))
+                max_points = int(req.query.get("points", 240))
+                from ..metricsview import parse_tag_args
+                tags = parse_tag_args(req.query.getall("tag", []))
+            except ValueError as e:
+                return web.Response(status=400, text=str(e))
+            return self._json(rt.ctl_metrics_history(
+                name, window_s, tags, max_points))
+
+        async def metrics_query(req):
+            name = req.query.get("name", "")
+            if not name:
+                return web.Response(status=400, text="name required")
+            try:
+                window_s = float(req.query.get("window", 60))
+                agg = req.query.get("agg", "avg")
+                from ..metricsview import parse_tag_args, validate_agg
+                tags = parse_tag_args(req.query.getall("tag", []))
+                if not validate_agg(agg):
+                    raise ValueError(f"unknown agg {agg!r}")
+            except ValueError as e:
+                return web.Response(status=400, text=str(e))
+            return self._json(rt.ctl_metrics_query(
+                name, window_s, agg, tags))
+
+        async def alerts(req):
+            return self._json(rt.ctl_alerts(
+                int(req.query.get("recent", 50))))
+
         async def stacks(req):
             # Cluster-wide stack capture (reference: `ray stack`).  The
             # collection blocks up to its timeout — exactly when a worker
@@ -255,6 +293,9 @@ class DashboardServer:
         app.router.add_get("/api/jobs", jobs)
         app.router.add_get("/api/timeline", timeline)
         app.router.add_get("/api/metrics/summary", metrics_summary)
+        app.router.add_get("/api/metrics/history", metrics_history)
+        app.router.add_get("/api/metrics/query", metrics_query)
+        app.router.add_get("/api/alerts", alerts)
         app.router.add_get("/api/stacks", stacks)
         app.router.add_post("/api/debug/dump", debug_dump)
         app.router.add_post("/api/profile", profile)

@@ -243,6 +243,78 @@ class JobServer:
             return web.Response(text=text,
                                 content_type="text/plain")
 
+        async def cluster_metrics_query(request):
+            """`ray-tpu metrics query`: windowed aggregate from the
+            head's time-series store (ray_tpu.metricsview)."""
+            from ray_tpu._private.api import _control
+            from ray_tpu.metricsview import parse_tag_args, validate_agg
+            name = request.query.get("name", "")
+            if not name:
+                return web.json_response(
+                    {"error": "name required"}, status=400)
+            agg = request.query.get("agg", "avg")
+            try:
+                window_s = float(request.query.get("window", "60"))
+                tags = parse_tag_args(request.query.getall("tag", []))
+                if not validate_agg(agg):
+                    raise ValueError(
+                        f"unknown agg {agg!r} (rate|delta|avg|min|max|"
+                        f"last|pNN)")
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            return web.json_response(await call(
+                _control, "metrics_query", name, window_s, agg, tags))
+
+        async def cluster_metrics_history(request):
+            """`ray-tpu metrics history`: recent [age_s, value] rows per
+            matching series (sparkline shape)."""
+            from ray_tpu._private.api import _control
+            from ray_tpu.metricsview import parse_tag_args
+            name = request.query.get("name", "")
+            if not name:
+                return web.json_response(
+                    {"error": "name required"}, status=400)
+            try:
+                window_s = float(request.query.get("window", "300"))
+                max_points = int(request.query.get("points", "240"))
+                tags = parse_tag_args(request.query.getall("tag", []))
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            return web.json_response(await call(
+                _control, "metrics_history", name, window_s, tags,
+                max_points))
+
+        async def cluster_metrics_series(request):
+            from ray_tpu._private.api import _control
+            return web.json_response(
+                await call(_control, "metrics_series"))
+
+        async def cluster_alerts(request):
+            """`ray-tpu alerts`: SLO objective states + recent
+            transitions from the burn-rate engine."""
+            from ray_tpu._private.api import _control
+            try:
+                recent = int(request.query.get("recent", "50"))
+            except ValueError:
+                return web.json_response(
+                    {"error": "bad recent"}, status=400)
+            return web.json_response(
+                await call(_control, "alerts", recent))
+
+        async def cluster_slo(request):
+            """POST: replace the SLO objective set (JSON list of
+            objective specs); GET: list the registered specs."""
+            from ray_tpu._private.api import _control
+            if request.method == "POST":
+                try:
+                    body = await request.json()
+                    n = await call(_control, "slo_set", list(body))
+                except Exception as e:  # noqa: BLE001 — client payload
+                    return web.json_response(
+                        {"error": repr(e)}, status=400)
+                return web.json_response({"objectives": n})
+            return web.json_response(await call(_control, "slo_list"))
+
         async def main():
             app = web.Application()
             app.router.add_post("/api/jobs/", submit)
@@ -261,6 +333,15 @@ class JobServer:
             app.router.add_get("/api/cluster/sched", cluster_sched)
             app.router.add_get("/api/cluster/task_explain",
                                cluster_task_explain)
+            app.router.add_get("/api/cluster/metrics/query",
+                               cluster_metrics_query)
+            app.router.add_get("/api/cluster/metrics/history",
+                               cluster_metrics_history)
+            app.router.add_get("/api/cluster/metrics/series",
+                               cluster_metrics_series)
+            app.router.add_get("/api/cluster/alerts", cluster_alerts)
+            app.router.add_get("/api/cluster/slo", cluster_slo)
+            app.router.add_post("/api/cluster/slo", cluster_slo)
             app.router.add_get("/metrics", metrics)
             app.router.add_get(
                 "/-/healthz", lambda r: web.json_response({"ok": True}))

@@ -1,0 +1,140 @@
+"""ray_tpu.metricsview: metrics history, windowed queries, SLO alerts.
+
+The head keeps ONE bounded time-series store (``SeriesStore``) fed by
+piggybacking on the worker metrics flush path — every batched
+``metrics_push`` control frame (and every query) gives the store a
+chance to fold the merged cluster snapshot into per-series rings, rate
+limited to its downsample interval.  No second reporting loop, no
+scraper process.  On top of the store:
+
+* ``query(name, window_s, agg, tags)`` — windowed aggregates
+  (``rate | delta | avg | min | max | last | pNN``), surfaced as
+  ``state.metrics_query()``, ``ray-tpu metrics query/history``,
+  dashboard ``GET /api/metrics/history`` and job-server
+  ``GET /api/cluster/metrics/query``.
+* ``SloEngine`` — declarative ``SloObjective`` targets with fast+slow
+  dual-window burn rates firing pending→firing→resolved transitions
+  into the export-event stream (see slo.py).
+
+Knobs (Config): ``metricsview_interval_s``, ``metricsview_max_points``,
+``metricsview_max_series``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .query import AGGS, parse_quantile, validate_agg  # noqa: F401
+from .slo import AlertState, SloEngine, SloObjective  # noqa: F401
+from .store import SeriesStore, points_from_aggregate  # noqa: F401
+
+__all__ = ["SeriesStore", "MetricsView", "SloEngine", "SloObjective",
+           "AlertState", "AGGS", "parse_quantile", "validate_agg",
+           "parse_tag_args"]
+
+
+class MetricsView:
+    """The head's store + SLO engine, wired to the flush path.
+
+    ``on_push()`` is called from the ``metrics_push`` control verb after
+    each worker flush lands; ``refresh()`` re-aggregates the cluster
+    snapshot into the store at most once per downsample interval (a
+    no-op costs one monotonic read), then runs one SLO evaluation pass —
+    alert cadence tracks ingest cadence by construction.
+    """
+
+    def __init__(self, event_sink: Optional[Callable] = None,
+                 interval_s: Optional[float] = None,
+                 max_points: Optional[int] = None,
+                 max_series: Optional[int] = None):
+        from ray_tpu._private.config import Config
+        self.store = SeriesStore(
+            interval_s=interval_s if interval_s is not None
+            else Config.get("metricsview_interval_s"),
+            max_points=max_points if max_points is not None
+            else Config.get("metricsview_max_points"),
+            max_series=max_series if max_series is not None
+            else Config.get("metricsview_max_series"),
+            account=True)
+        self.slo = SloEngine(self.store, event_sink=event_sink)
+        self._ingest_lock = threading.Lock()
+        self._last_ingest: Optional[float] = None
+
+    # -- ingest ------------------------------------------------------------
+
+    def on_push(self) -> None:
+        """Flush-path hook (one batched push per worker flush)."""
+        self.refresh()
+
+    def refresh(self, force: bool = False,
+                now: Optional[float] = None) -> bool:
+        """Fold the merged cluster snapshot into the store (throttled to
+        the downsample interval unless ``force``); returns whether an
+        ingest pass actually ran."""
+        now = time.monotonic() if now is None else now
+        with self._ingest_lock:
+            if not force and self._last_ingest is not None and \
+                    now - self._last_ingest < self.store.interval_s:
+                return False
+            self._last_ingest = now
+        from ray_tpu.util import metrics, telemetry
+        try:
+            by_name, acc = metrics._aggregate_snapshots()
+            self.store.ingest(points_from_aggregate(by_name, acc), now)
+            self.slo.evaluate(now)
+        except Exception as e:  # ingest must never break the flush path
+            telemetry.note_swallowed("metricsview.refresh", e)
+        return True
+
+    # -- reads (each forces freshness first) -------------------------------
+
+    def query(self, name: str, window_s: float = 60.0, agg: str = "avg",
+              tags: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        if not validate_agg(agg):
+            raise ValueError(
+                f"unknown agg {agg!r}: expected one of {AGGS} or pNN")
+        self.refresh()
+        return self.store.query(name, window_s, agg, tags=tags)
+
+    def history(self, name: str, window_s: float = 300.0,
+                tags: Optional[Dict[str, str]] = None,
+                max_points: int = 240) -> Dict[str, Any]:
+        self.refresh()
+        return self.store.history(name, window_s, tags=tags,
+                                  max_points=max_points)
+
+    def alerts(self, recent: int = 50) -> Dict[str, Any]:
+        self.refresh()
+        return self.slo.status(recent=recent)
+
+    def set_objectives(self, objectives: List) -> int:
+        n = self.slo.set_objectives(objectives)
+        self.refresh(force=True)
+        return n
+
+    # -- forensics ---------------------------------------------------------
+
+    def bundle_snapshot(self, window_s: float = 300.0,
+                        max_series: int = 64,
+                        max_points: int = 120) -> Dict[str, Any]:
+        """Recent history for flight-recorder bundles: every known series
+        (capped), newest points first trimmed to ``max_points`` each."""
+        names = self.store.series_names()[:max_series]
+        return {"stats": self.store.stats(),
+                "window_s": window_s,
+                "series": {n: self.store.history(
+                    n, window_s, max_points=max_points)["series"]
+                    for n in names}}
+
+
+def parse_tag_args(pairs) -> Optional[Dict[str, str]]:
+    """CLI helper: ``("k=v", ...)`` -> tags dict (None when empty)."""
+    tags: Dict[str, str] = {}
+    for raw in pairs or ():
+        if "=" not in raw:
+            raise ValueError(f"expected key=value, got {raw!r}")
+        k, _sep, v = raw.partition("=")
+        tags[k.strip()] = v.strip()
+    return tags or None

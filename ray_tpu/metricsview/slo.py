@@ -1,0 +1,323 @@
+"""Declarative SLO objectives + dual-window burn-rate alerting.
+
+Reference: the Google SRE workbook's multiwindow, multi-burn-rate
+alerts (and Prometheus alerting rules' ``for:`` clause).  An
+``SloObjective`` targets any catalog series through the windowed query
+engine — ``serve_request_latency p99 < 0.25``, ``train_goodput_ratio
+avg > 0.5`` — and is evaluated against TWO windows:
+
+* the **fast** window reacts (a real spike breaches it within seconds),
+* the **slow** window confirms (a one-scrape blip cannot sustain a
+  slow-window burn), so firing requires *both* to burn.
+
+Burn rate: for quantile objectives the window's bad-observation
+fraction (from histogram bucket deltas — the fraction of requests over
+the threshold) divided by the error budget ``1 - q``; burn >= 1 means
+the budget is being spent at least as fast as it accrues.  Scalar
+objectives degenerate to breach/no-breach (burn 1 or 0).
+
+State machine per objective::
+
+    ok -> pending    fast window burns (stamped; nothing fires yet)
+    pending -> firing  slow window confirms (after >= pending_for_s)
+    pending -> ok      fast window recovers first (blip)
+    firing -> resolved fast window recovers
+    resolved -> ok     after cooldown_s (re-burn inside the cooldown
+                       returns straight to firing: one flapping alert,
+                       not a train of them)
+
+Every transition lands in the export-event stream (EXPORT_ALERT), the
+``ray_tpu_alerts_transitions_total{state}`` counter, and the bounded
+transition ring that ``ray-tpu alerts`` / ``alerts.json`` render; the
+``ray_tpu_alerts_firing`` gauge tracks how many objectives are firing
+right now.
+
+The engine is pull-evaluated from the ingest path (same cadence as the
+store, no private timer thread) and from every alerts/query API call.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .query import parse_quantile, validate_agg
+
+FIRING_GAUGE = "ray_tpu_alerts_firing"
+TRANSITIONS_TOTAL = "ray_tpu_alerts_transitions_total"
+
+_STATES = ("ok", "pending", "firing", "resolved")
+
+
+@dataclass
+class SloObjective:
+    """One service-level objective on a catalog series."""
+
+    name: str                 # unique objective id, e.g. "serve-p99"
+    metric: str               # series name (catalog or user metric)
+    agg: str                  # "p99" | "avg" | "rate" | ...
+    op: str                   # healthy direction: value OP threshold
+    threshold: float
+    tags: Optional[Dict[str, str]] = None
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    pending_for_s: float = 0.0   # min dwell in pending before firing
+    cooldown_s: float = 60.0     # resolved -> ok hold-down
+    description: str = ""
+
+    def __post_init__(self):
+        if self.op not in ("<", "<=", ">", ">="):
+            raise ValueError(f"SloObjective {self.name!r}: op must be a "
+                             f"comparison, got {self.op!r}")
+        if not validate_agg(self.agg):
+            raise ValueError(f"SloObjective {self.name!r}: unknown agg "
+                             f"{self.agg!r}")
+        if self.slow_window_s < self.fast_window_s:
+            raise ValueError(f"SloObjective {self.name!r}: slow window "
+                             f"must be >= fast window")
+
+    def healthy(self, value: float) -> bool:
+        if self.op == "<":
+            return value < self.threshold
+        if self.op == "<=":
+            return value <= self.threshold
+        if self.op == ">":
+            return value > self.threshold
+        return value >= self.threshold
+
+    def spec(self) -> Dict[str, Any]:
+        return {"name": self.name, "metric": self.metric, "agg": self.agg,
+                "op": self.op, "threshold": self.threshold,
+                "tags": dict(self.tags or {}),
+                "fast_window_s": self.fast_window_s,
+                "slow_window_s": self.slow_window_s,
+                "pending_for_s": self.pending_for_s,
+                "cooldown_s": self.cooldown_s,
+                "description": self.description}
+
+    @classmethod
+    def from_spec(cls, spec: Dict[str, Any]) -> "SloObjective":
+        known = {f for f in cls.__dataclass_fields__}  # noqa: C416
+        return cls(**{k: v for k, v in spec.items() if k in known})
+
+
+@dataclass
+class AlertState:
+    """Live evaluation state for one objective."""
+
+    objective: SloObjective
+    state: str = "ok"
+    since: Optional[float] = None        # entered current state (mono)
+    pending_since: Optional[float] = None
+    resolved_at: Optional[float] = None
+    burn_fast: float = 0.0
+    burn_slow: float = 0.0
+    value_fast: Optional[float] = None
+    value_slow: Optional[float] = None
+    no_data: bool = True
+    transitions: int = 0
+
+    def snapshot(self, now: float) -> Dict[str, Any]:
+        return {"objective": self.objective.name,
+                "metric": self.objective.metric,
+                "agg": self.objective.agg, "op": self.objective.op,
+                "threshold": self.objective.threshold,
+                "state": self.state,
+                "since_s": round(now - self.since, 3)
+                if self.since is not None else None,
+                "burn_fast": round(self.burn_fast, 4),
+                "burn_slow": round(self.burn_slow, 4),
+                "value_fast": self.value_fast,
+                "value_slow": self.value_slow,
+                "no_data": self.no_data,
+                "transitions": self.transitions}
+
+
+class SloEngine:
+    """Evaluates objectives against a ``SeriesStore``; owns no thread."""
+
+    def __init__(self, store, event_sink: Optional[Callable] = None,
+                 max_transitions: int = 256):
+        self._store = store
+        self._event_sink = event_sink  # (source_type, event_dict) -> None
+        self._lock = threading.Lock()
+        self._states: Dict[str, AlertState] = {}
+        self._transitions: deque = deque(maxlen=max_transitions)
+
+    # -- objective management ---------------------------------------------
+
+    def set_objectives(self, objectives: List) -> int:
+        """Replace the objective set (specs or SloObjective instances);
+        evaluation state survives for objectives whose name persists."""
+        objs = [o if isinstance(o, SloObjective)
+                else SloObjective.from_spec(dict(o)) for o in objectives]
+        with self._lock:
+            old = self._states
+            self._states = {}
+            for o in objs:
+                prev = old.get(o.name)
+                if prev is not None:
+                    prev.objective = o
+                    self._states[o.name] = prev
+                else:
+                    self._states[o.name] = AlertState(o)
+            self._refresh_gauge_locked()
+        return len(objs)
+
+    def add_objective(self, objective) -> None:
+        o = objective if isinstance(objective, SloObjective) \
+            else SloObjective.from_spec(dict(objective))
+        with self._lock:
+            if o.name in self._states:
+                self._states[o.name].objective = o
+            else:
+                self._states[o.name] = AlertState(o)
+
+    def objectives(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [s.objective.spec() for s in self._states.values()]
+
+    # -- evaluation --------------------------------------------------------
+
+    def _burn(self, obj: SloObjective, window_s: float, now: float):
+        """(burn_rate, value, has_data) for one window."""
+        res = self._store.query(obj.metric, window_s, obj.agg,
+                                tags=obj.tags, now=now)
+        value = res.get("value")
+        if value is None:
+            return 0.0, None, False
+        q = parse_quantile(obj.agg)
+        if q is not None and obj.op in ("<", "<="):
+            budget = max(1e-9, 1.0 - q)
+            bad = self._bad_fraction(obj, window_s, now)
+            if bad is not None:
+                return bad / budget, value, True
+        return (0.0 if obj.healthy(value) else 1.0), value, True
+
+    def _bad_fraction(self, obj: SloObjective, window_s: float,
+                      now: float) -> Optional[float]:
+        """Fraction of window observations over the threshold, from the
+        cumulative-bucket delta (quantile objectives only)."""
+        total = self._store.query(obj.metric, window_s, "delta",
+                                  tags=obj.tags, now=now).get("value")
+        if not total or total <= 0:
+            return None
+        # Observations at or under the threshold: cumulative count at
+        # the threshold's bucket == a pNN-style CDF read.  Reuse the
+        # bucket machinery by querying the share of points whose value
+        # exceeds the threshold via per-bucket deltas.
+        good = 0.0
+        with self._store._lock:
+            from .query import _window, hist_window_delta
+            for s in self._store._matches(obj.metric, obj.tags):
+                if s.mtype != "histogram" or not s.bounds:
+                    continue
+                base, win = _window(s.points, now - window_s, now)
+                if not win:
+                    continue
+                dcounts, _ds, _dc = hist_window_delta(base, win)
+                cum = 0.0
+                for i, b in enumerate(s.bounds):
+                    if b <= obj.threshold:
+                        cum = dcounts[i] if i < len(dcounts) else cum
+                    else:
+                        break
+                good += cum
+        return max(0.0, min(1.0, (total - good) / total))
+
+    def evaluate(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One evaluation pass; returns the transitions it fired."""
+        import time as _time
+        now = _time.monotonic() if now is None else now
+        fired: List[Dict[str, Any]] = []
+        with self._lock:
+            for st in self._states.values():
+                obj = st.objective
+                st.burn_fast, st.value_fast, has_fast = \
+                    self._burn(obj, obj.fast_window_s, now)
+                st.burn_slow, st.value_slow, has_slow = \
+                    self._burn(obj, obj.slow_window_s, now)
+                st.no_data = not (has_fast or has_slow)
+                burning_fast = has_fast and st.burn_fast >= 1.0
+                burning_slow = has_slow and st.burn_slow >= 1.0
+                if st.state == "ok":
+                    if burning_fast:
+                        st.pending_since = now
+                        fired.append(self._transition_locked(
+                            st, "pending", now))
+                elif st.state == "pending":
+                    if not burning_fast:
+                        st.pending_since = None
+                        fired.append(self._transition_locked(st, "ok", now))
+                    elif burning_slow and now - (st.pending_since or now) \
+                            >= obj.pending_for_s:
+                        fired.append(self._transition_locked(
+                            st, "firing", now))
+                elif st.state == "firing":
+                    if not burning_fast:
+                        st.resolved_at = now
+                        fired.append(self._transition_locked(
+                            st, "resolved", now))
+                elif st.state == "resolved":
+                    if burning_fast:
+                        # Re-burn inside the cooldown: same incident.
+                        fired.append(self._transition_locked(
+                            st, "firing", now))
+                    elif now - (st.resolved_at or now) >= obj.cooldown_s:
+                        fired.append(self._transition_locked(st, "ok", now))
+            self._refresh_gauge_locked()
+        for t in fired:
+            self._emit(t)
+        return fired
+
+    def _transition_locked(self, st: AlertState, to: str,
+                           now: float) -> Dict[str, Any]:
+        event = {"objective": st.objective.name,
+                 "metric": st.objective.metric,
+                 "agg": st.objective.agg,
+                 "op": st.objective.op,
+                 "threshold": st.objective.threshold,
+                 "from": st.state, "to": to,
+                 "value_fast": st.value_fast,
+                 "value_slow": st.value_slow,
+                 "burn_fast": round(st.burn_fast, 4),
+                 "burn_slow": round(st.burn_slow, 4),
+                 "age_s": 0.0, "_t": now}
+        st.state = to
+        st.since = now
+        st.transitions += 1
+        self._transitions.append(event)
+        return event
+
+    def _refresh_gauge_locked(self) -> None:
+        from ray_tpu.util import telemetry
+        telemetry.set_gauge(FIRING_GAUGE, sum(
+            1 for s in self._states.values() if s.state == "firing"))
+
+    def _emit(self, event: Dict[str, Any]) -> None:
+        from ray_tpu.util import telemetry
+        telemetry.inc(TRANSITIONS_TOTAL, tags={"state": event["to"]})
+        if self._event_sink is not None:
+            try:
+                self._event_sink("EXPORT_ALERT",
+                                 {k: v for k, v in event.items()
+                                  if k != "_t"})
+            except Exception as e:
+                telemetry.note_swallowed("metricsview.alert_emit", e)
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self, now: Optional[float] = None,
+               recent: int = 50) -> Dict[str, Any]:
+        import time as _time
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            states = [s.snapshot(now) for s in self._states.values()]
+            trans = [{**{k: v for k, v in t.items() if k != "_t"},
+                      "age_s": round(now - t["_t"], 3)}
+                     for t in list(self._transitions)[-recent:]]
+        return {"objectives": states,
+                "firing": sum(1 for s in states if s["state"] == "firing"),
+                "transitions": trans}

@@ -1,0 +1,280 @@
+"""Head-side metrics time-series store: bounded per-series rings.
+
+Reference: the scrape-and-store backend PAPER.md's dashboard assumes
+(Prometheus TSDB head block + Ray's dashboard metrics time series) —
+here a native in-process store so windowed queries need no external
+scraper.  Design:
+
+* **One ring per (series, tag-set)** — ``deque(maxlen=max_points)`` of
+  fixed-interval downsampled points: a new sample landing in the same
+  ``interval_s`` bucket as the ring's tail *replaces* it, so a burst of
+  flushes costs one point and retention is ``interval_s * max_points``
+  seconds regardless of push rate.
+* **Counters stay raw monotonic** — the stored value is the merged
+  cluster counter at ingest time; ``rate``/``delta`` reconstruct
+  increases at query time (reset-aware, like PromQL ``increase``).
+* **Histograms stay cumulative bucket vectors** — each point carries
+  the full cumulative bucket counts + sum + count, so the delta between
+  any two points reconstructs the *window's* observation distribution
+  and therefore window percentiles (``p99`` over the last 60 s, not
+  over process lifetime).
+
+Timestamps are ``time.monotonic()`` domain (callers may feed a logical
+clock in tests); queries and history report ages relative to *now*, so
+an NTP step can never corrupt a window.
+
+``SeriesStore`` is deliberately standalone — no runtime dependency — so
+consumers that predate a cluster (``GoodputAutoscalePolicy``'s sag
+window) embed their own private instance, while the head's
+``MetricsView`` wraps one fed from the worker flush path.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .query import (ScalarPoint, HistPoint, aggregate_window,
+                    combine_results, history_points)
+
+#: Accounting series (head store only; see util/telemetry.py CATALOG).
+POINTS_TOTAL = "ray_tpu_metricsview_points_total"
+DROPPED_TOTAL = "ray_tpu_metricsview_dropped_total"
+
+
+def _tags_key(tags: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in tags.items()))
+
+
+class _Series:
+    __slots__ = ("name", "tags", "mtype", "bounds", "points")
+
+    def __init__(self, name: str, tags: Dict[str, str], mtype: str,
+                 bounds: Optional[List[float]], max_points: int):
+        self.name = name
+        self.tags = dict(tags)
+        self.mtype = mtype            # counter | gauge | histogram
+        self.bounds = bounds          # finite boundaries (histogram only)
+        self.points: deque = deque(maxlen=max_points)
+
+
+class SeriesStore:
+    """Bounded multi-series time-series store with windowed queries."""
+
+    def __init__(self, interval_s: float = 1.0, max_points: int = 600,
+                 max_series: int = 2048, account: bool = False):
+        self.interval_s = max(1e-9, float(interval_s))
+        self.max_points = int(max_points)
+        self.max_series = int(max_series)
+        self._account = account
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, Tuple], _Series] = {}
+        self.points_total = 0   # appended (post-downsample) points, ever
+        self.dropped_total = 0  # ring evictions + over-max_series drops
+
+    # -- writes ------------------------------------------------------------
+
+    def append(self, name: str, tags: Dict[str, str], mtype: str,
+               value: Any, now: float,
+               bounds: Optional[List[float]] = None) -> None:
+        """Record one sample.  ``value`` is a float for counter/gauge; for
+        histograms a dict ``{"counts": cumulative-with-+Inf, "sum", "count"}``
+        (``bounds`` gives the finite boundaries, stored once)."""
+        appended = dropped = 0
+        with self._lock:
+            key = (name, _tags_key(tags))
+            series = self._series.get(key)
+            if series is None:
+                if len(self._series) >= self.max_series:
+                    self.dropped_total += 1
+                    dropped = 1
+                    self._account_locked(0, dropped)
+                    return
+                series = _Series(name, tags, mtype, bounds, self.max_points)
+                self._series[key] = series
+            if mtype == "histogram":
+                point = HistPoint(now, tuple(value.get("counts") or ()),
+                                  float(value.get("sum", 0.0)),
+                                  int(value.get("count", 0)))
+                if series.bounds is None and bounds is not None:
+                    series.bounds = list(bounds)
+            else:
+                point = ScalarPoint(now, float(value))
+            ring = series.points
+            if ring and int(ring[-1].t // self.interval_s) == \
+                    int(now // self.interval_s):
+                ring[-1] = point  # same downsample bucket: keep latest
+            else:
+                if len(ring) == ring.maxlen:
+                    self.dropped_total += 1
+                    dropped = 1
+                ring.append(point)
+                self.points_total += 1
+                appended = 1
+            self._account_locked(appended, dropped)
+
+    def _account_locked(self, appended: int, dropped: int) -> None:
+        if not self._account or not (appended or dropped):
+            return
+        from ray_tpu.util import telemetry
+        if appended:
+            telemetry.inc(POINTS_TOTAL, appended)
+        if dropped:
+            telemetry.inc(DROPPED_TOTAL, dropped)
+
+    def ingest(self, points: List[Tuple], now: float) -> int:
+        """Batch-append ``(name, tags, mtype, value, bounds)`` rows (the
+        shape ``points_from_aggregate`` emits).  Returns rows ingested."""
+        for name, tags, mtype, value, bounds in points:
+            self.append(name, tags, mtype, value, now, bounds=bounds)
+        return len(points)
+
+    # -- reads -------------------------------------------------------------
+
+    def _matches(self, name: str, tags: Optional[Dict[str, str]]
+                 ) -> List[_Series]:
+        want = {(str(k), str(v)) for k, v in (tags or {}).items()}
+        out = []
+        for (sname, _tk), series in self._series.items():
+            if sname != name:
+                continue
+            if want and not want.issubset(set(series.tags.items())):
+                continue
+            out.append(series)
+        return out
+
+    def query(self, name: str, window_s: float = 60.0, agg: str = "avg",
+              tags: Optional[Dict[str, str]] = None,
+              now: Optional[float] = None) -> Dict[str, Any]:
+        """Windowed aggregate over matching series.  ``agg`` is one of
+        ``rate | delta | avg | min | max | last | pNN`` (``pNN`` needs a
+        histogram series).  Returns ``{"name", "agg", "window_s",
+        "value", "series", "points"}`` — ``value`` is None when no data
+        lands in the window (or the agg is unsupported for the type)."""
+        import time as _time
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            matched = self._matches(name, tags)
+            per_series = [aggregate_window(s.points, s.mtype, s.bounds,
+                                           now - float(window_s), now, agg)
+                          for s in matched]
+            mtypes = {s.mtype for s in matched}
+        value, npoints = combine_results(
+            per_series, agg, mtypes.pop() if len(mtypes) == 1 else "gauge")
+        return {"name": name, "agg": agg, "window_s": float(window_s),
+                "tags": dict(tags or {}), "value": value,
+                "series": len(matched), "points": npoints}
+
+    def history(self, name: str, window_s: float = 300.0,
+                tags: Optional[Dict[str, str]] = None,
+                now: Optional[float] = None,
+                max_points: int = 240) -> Dict[str, Any]:
+        """Raw recent points for sparklines: per matching series a list of
+        ``[age_s, value]`` pairs (newest age ~0; histograms render their
+        inter-point average so a latency spike is visible)."""
+        import time as _time
+        now = _time.monotonic() if now is None else now
+        out = []
+        with self._lock:
+            for s in self._matches(name, tags):
+                pts = history_points(s.points, s.mtype,
+                                     now - float(window_s), now, max_points)
+                out.append({"tags": dict(s.tags), "type": s.mtype,
+                            "points": pts})
+        return {"name": name, "window_s": float(window_s), "series": out}
+
+    def window_rows(self, window_s: float,
+                    now: Optional[float] = None) -> List[Tuple]:
+        """Windowed-export rows ``(name, tags, mtype, value, bounds)``:
+        counters carry their last-window increase, gauges their latest
+        value, histograms per-bucket window deltas ``{"per", "sum",
+        "count"}`` — the delta-temporality shape
+        ``export_otlp_json(window_s=...)`` emits."""
+        import time as _time
+        from .query import _scalar_delta, _window, hist_window_delta
+        now = _time.monotonic() if now is None else now
+        start = now - float(window_s)
+        rows: List[Tuple] = []
+        with self._lock:
+            for s in self._series.values():
+                base, win = _window(s.points, start, now)
+                if not win:
+                    continue
+                if s.mtype == "histogram":
+                    dcounts, dsum, dcount = hist_window_delta(base, win)
+                    per = [max(0.0, dcounts[i] -
+                               (dcounts[i - 1] if i else 0.0))
+                           for i in range(len(dcounts))]
+                    rows.append((s.name, dict(s.tags), "histogram",
+                                 {"per": per, "sum": dsum, "count": dcount},
+                                 list(s.bounds or ())))
+                elif s.mtype == "counter":
+                    seq = ([base] if base is not None else []) + list(win)
+                    delta, _span = _scalar_delta(seq, counter=True)
+                    rows.append((s.name, dict(s.tags), "counter",
+                                 delta if delta is not None else win[-1].v,
+                                 None))
+                else:
+                    rows.append((s.name, dict(s.tags), "gauge",
+                                 win[-1].v, None))
+        return rows
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted({name for name, _tk in self._series})
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            live = sum(len(s.points) for s in self._series.values())
+            return {"series": len(self._series), "live_points": live,
+                    "points_total": self.points_total,
+                    "dropped_total": self.dropped_total,
+                    "interval_s": self.interval_s,
+                    "max_points": self.max_points,
+                    "max_series": self.max_series}
+
+
+def points_from_aggregate(by_name: Dict[str, Dict[str, Any]],
+                          acc: Dict[str, Dict[Tuple, tuple]]
+                          ) -> List[Tuple]:
+    """Regroup ``metrics._aggregate_snapshots()`` output into store rows
+    ``(base_name, tags, mtype, value, bounds)``: counters/gauges one row
+    per tag set; histograms fold their ``_bucket``/``_sum``/``_count``
+    sample rows back into one cumulative bucket-vector value (the shape
+    window-percentile deltas need)."""
+    rows: List[Tuple] = []
+    hists: Dict[Tuple[str, Tuple], Dict[str, Any]] = {}
+    for base, meta in by_name.items():
+        mtype = meta.get("type")
+        if mtype in ("counter", "gauge"):
+            for _key, (tags, value) in (acc.get(base) or {}).items():
+                rows.append((base, tags, mtype, float(value), None))
+            continue
+        if mtype != "histogram":
+            continue
+        for suffix in ("_bucket", "_sum", "_count"):
+            for _key, (tags, value) in (acc.get(base + suffix) or {}).items():
+                le = tags.get("le")
+                tkey = (base, _tags_key({k: v for k, v in tags.items()
+                                         if k != "le"}))
+                p = hists.setdefault(tkey, {
+                    "tags": {k: v for k, v in tags.items() if k != "le"},
+                    "les": [], "sum": 0.0, "count": 0})
+                if suffix == "_sum":
+                    p["sum"] = float(value)
+                elif suffix == "_count":
+                    p["count"] = int(value)
+                elif le is not None:
+                    p["les"].append((le, float(value)))
+    for (base, _tk), p in hists.items():
+        finite = sorted(((float(le), c) for le, c in p["les"]
+                         if le != "+Inf"))
+        counts = [c for _b, c in finite]
+        counts.append(next((c for le, c in p["les"] if le == "+Inf"),
+                           float(p["count"])))
+        rows.append((base, p["tags"], "histogram",
+                     {"counts": counts, "sum": p["sum"],
+                      "count": p["count"]},
+                     [b for b, _c in finite]))
+    return rows

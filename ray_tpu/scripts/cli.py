@@ -334,6 +334,167 @@ def task_why(address, task_id):
 
 
 @cli.group()
+def metrics():
+    """Metrics history + windowed queries (ray_tpu.metricsview)."""
+
+
+@metrics.command("query")
+@click.option("--address", default=None)
+@click.option("--window", "window_s", type=float, default=60.0,
+              show_default=True, help="Window length in seconds.")
+@click.option("--agg", default="avg", show_default=True,
+              help="rate | delta | avg | min | max | last | pNN "
+                   "(pNN, e.g. p99, reconstructs the WINDOW's "
+                   "percentile from histogram bucket deltas).")
+@click.option("--tag", "tag_pairs", multiple=True, metavar="K=V",
+              help="Tag filter (repeatable); unmatched tag sets are "
+                   "aggregated.")
+@click.argument("name")
+def metrics_query(address, window_s, agg, tag_pairs, name):
+    """Windowed aggregate of series NAME from the head's time-series
+    store, e.g.
+
+        ray-tpu metrics query ray_tpu_serve_request_latency_seconds
+        --window 60 --agg p99
+    """
+    from urllib.parse import urlencode
+    params = [("name", name), ("window", window_s), ("agg", agg)]
+    params += [("tag", t) for t in tag_pairs]
+    out = _client(address)._request(
+        "GET", "/api/cluster/metrics/query?" + urlencode(params))
+    value = out.get("value")
+    shown = "no data" if value is None else f"{value:g}"
+    click.echo(f"{out['name']} {out['agg']} over {out['window_s']:g}s: "
+               f"{shown}")
+    click.echo(f"  series matched: {out['series']}  "
+               f"points in window: {out['points']}")
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+@metrics.command("history")
+@click.option("--address", default=None)
+@click.option("--window", "window_s", type=float, default=300.0,
+              show_default=True)
+@click.option("--points", "max_points", type=int, default=60,
+              show_default=True, help="Max points per series.")
+@click.option("--tag", "tag_pairs", multiple=True, metavar="K=V")
+@click.option("--raw", is_flag=True,
+              help="Print [age_s, value] rows instead of sparklines.")
+@click.argument("name")
+def metrics_history(address, window_s, max_points, tag_pairs, name, raw):
+    """Recent stored points of series NAME (per tag set) as a terminal
+    sparkline — histogram series render per-interval average latency."""
+    from urllib.parse import urlencode
+    params = [("name", name), ("window", window_s),
+              ("points", max_points)]
+    params += [("tag", t) for t in tag_pairs]
+    out = _client(address)._request(
+        "GET", "/api/cluster/metrics/history?" + urlencode(params))
+    if not out["series"]:
+        click.echo("no stored points")
+        return
+    for series in out["series"]:
+        tags = ",".join(f"{k}={v}" for k, v in
+                        sorted(series["tags"].items()))
+        label = f"{out['name']}{{{tags}}}" if tags else out["name"]
+        vals = [v for _age, v in series["points"] if v is not None]
+        if raw or not vals:
+            click.echo(f"{label} ({series['type']}):")
+            for age, v in series["points"]:
+                click.echo(f"  -{age:g}s  {'-' if v is None else v}")
+            continue
+        lo, hi = min(vals), max(vals)
+        span = (hi - lo) or 1.0
+        line = "".join(
+            " " if v is None else
+            _SPARK[min(len(_SPARK) - 1,
+                       int((v - lo) / span * (len(_SPARK) - 1)))]
+            for _age, v in series["points"])
+        oldest = series["points"][0][0]
+        click.echo(f"{label} ({series['type']}, last {oldest:g}s)  "
+                   f"min={lo:g} max={hi:g}")
+        click.echo(f"  {line}")
+
+
+@metrics.command("series")
+@click.option("--address", default=None)
+def metrics_series(address):
+    """Series names with stored history."""
+    for name in _client(address)._request(
+            "GET", "/api/cluster/metrics/series"):
+        click.echo(name)
+
+
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--recent", type=int, default=20, show_default=True,
+              help="Transition-history rows to print.")
+def alerts(address, recent):
+    """SLO burn-rate alert states (ray_tpu.metricsview.slo): one row
+    per objective (ok | pending | firing | resolved) with fast/slow
+    burn rates, then the recent transition log."""
+    from urllib.parse import urlencode
+    out = _client(address)._request(
+        "GET", "/api/cluster/alerts?" + urlencode({"recent": recent}))
+    objs = out.get("objectives", [])
+    if not objs:
+        click.echo("no SLO objectives registered "
+                   "(state.slo_set / `ray-tpu slo set`)")
+        return
+    click.echo(f"firing: {out['firing']}/{len(objs)}")
+    for o in objs:
+        mark = {"ok": " ", "pending": "~", "firing": "!",
+                "resolved": "^"}.get(o["state"], "?")
+        vf = "-" if o["value_fast"] is None else f"{o['value_fast']:g}"
+        click.echo(
+            f" {mark} [{o['state']:>8}] {o['objective']}: "
+            f"{o['metric']} {o['agg']} {o['op']} {o['threshold']:g} "
+            f"(now {vf}; burn fast {o['burn_fast']:g} / "
+            f"slow {o['burn_slow']:g})"
+            + (" [no data]" if o.get("no_data") else ""))
+    trans = out.get("transitions", [])
+    if trans:
+        click.echo("recent transitions:")
+        for t in trans:
+            click.echo(f"  -{t['age_s']:g}s  {t['objective']}: "
+                       f"{t['from']} -> {t['to']} "
+                       f"(fast burn {t['burn_fast']:g})")
+
+
+@cli.group()
+def slo():
+    """SLO objective management (see `ray-tpu alerts`)."""
+
+
+@slo.command("list")
+@click.option("--address", default=None)
+def slo_list(address):
+    for spec in _client(address)._request("GET", "/api/cluster/slo"):
+        tags = ",".join(f"{k}={v}" for k, v in
+                        sorted(spec.get("tags", {}).items()))
+        click.echo(f"{spec['name']}: {spec['metric']}"
+                   f"{'{' + tags + '}' if tags else ''} {spec['agg']} "
+                   f"{spec['op']} {spec['threshold']:g} "
+                   f"(fast {spec['fast_window_s']:g}s / "
+                   f"slow {spec['slow_window_s']:g}s, "
+                   f"cooldown {spec['cooldown_s']:g}s)")
+
+
+@slo.command("set")
+@click.option("--address", default=None)
+@click.argument("objectives_file", type=click.Path(exists=True))
+def slo_set(address, objectives_file):
+    """Replace the SLO objective set from a JSON file (a list of
+    objective specs; see ray_tpu.metricsview.SloObjective)."""
+    with open(objectives_file) as f:
+        specs = json.load(f)
+    out = _client(address)._request("POST", "/api/cluster/slo", specs)
+    click.echo(f"registered {out['objectives']} objective(s)")
+
+
+@cli.group()
 def job():
     """Job submission and management."""
 

@@ -24,6 +24,9 @@ DEFAULT_HISTOGRAM_BOUNDARIES = [
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
 _flusher_started = False
+# Bumped by _reset_for_tests so an already-running flusher thread exits
+# at its next wakeup instead of surviving the reset.
+_flusher_gen = 0
 # Set by every record, cleared by flush: lets the per-task flush hook
 # skip the push entirely when nothing changed since the last one.
 _dirty = False
@@ -213,7 +216,9 @@ def local_snapshots() -> List[Dict[str, Any]]:
 
 def flush() -> None:
     """Push this process's metrics to the driver (no-op on the driver: its
-    registry is read directly)."""
+    registry is read directly).  One batched ``metrics_push`` verb per
+    flush — the same frame feeds both the merged scrape and the head's
+    time-series store (ray_tpu.metricsview)."""
     global _dirty
     from ray_tpu._private import runtime as rt_mod
     rt = rt_mod.current_runtime()
@@ -223,38 +228,64 @@ def flush() -> None:
     source_id = source.hex() if source is not None else "unknown"
     _dirty = False
     try:
-        rt.control("push_metrics", source_id, local_snapshots())
+        rt.control("metrics_push", source_id, local_snapshots())
     except Exception:
         pass  # driver shutting down; metrics are best-effort
 
 
-def flush_on_task_done() -> None:
-    """Deterministic flush at worker task completion/teardown.
-
-    The periodic flusher wakes every 2 s, so metrics a task records in
-    its final moments would otherwise be lost if the worker (or driver
-    read) wins the race.  Called by the worker loop just BEFORE the
-    TaskDone frame is queued: the push is a fire-and-forget control frame
-    (request id 0 is never in the pending-reply table, so the head's
-    reply is dropped) sharing the FIFO outbox with TaskDone — by the time
-    the caller observes the task finished, its metrics are at the driver.
-    Skips the push when nothing was recorded since the last flush, so
-    metric-free tasks pay only a bool check."""
-    global _dirty
-    if not _dirty:
-        return
+def _push_fire_and_forget() -> bool:
+    """One fire-and-forget ``metrics_push`` frame (request id 0 is never
+    in the pending-reply table, so the head's reply is dropped).  Returns
+    whether the frame was handed to the outbox."""
     from ray_tpu._private import runtime as rt_mod
     rt = rt_mod.current_runtime()
     if rt is None or rt_mod.driver_runtime() is rt \
             or not hasattr(rt, "send") or not hasattr(rt, "worker_id"):
+        return False
+    from ray_tpu._private.protocol import RpcCall
+    rt.send(RpcCall(0, rt.worker_id, "metrics_push",
+                    (rt.worker_id.hex(), local_snapshots()), {}))
+    return True
+
+
+def flush_on_task_done() -> None:
+    """Deterministic flush at worker task completion.
+
+    The periodic flusher wakes every 2 s, so metrics a task records in
+    its final moments would otherwise be lost if the worker (or driver
+    read) wins the race.  Called by the worker loop just BEFORE the
+    TaskDone frame is queued: the fire-and-forget push shares the FIFO
+    outbox with TaskDone — by the time the caller observes the task
+    finished, its metrics are at the driver.  Skips the push when
+    nothing was recorded since the last flush, so metric-free tasks pay
+    only a bool check."""
+    global _dirty
+    if not _dirty:
         return
     _dirty = False
     try:
-        from ray_tpu._private.protocol import RpcCall
-        rt.send(RpcCall(0, rt.worker_id, "push_metrics",
-                        (rt.worker_id.hex(), local_snapshots()), {}))
+        if not _push_fire_and_forget():
+            return
     except Exception:
         _dirty = True  # next completion retries
+
+
+def flush_terminal() -> None:
+    """Unconditional final flush at worker shutdown.
+
+    The dirty-flag fast path is wrong here: a sample recorded after the
+    last task's flush cleared the flag's snapshot (teardown hooks,
+    executor-shutdown stragglers, atexit-adjacent user code) has no
+    'next completion' to retry on — the process is about to _exit.
+    Pushing unconditionally costs one frame per worker lifetime and
+    guarantees the store's last point matches the process's final
+    counter values."""
+    global _dirty
+    _dirty = False
+    try:
+        _push_fire_and_forget()
+    except Exception:
+        pass  # outbox already gone; nothing later could deliver either
 
 
 def _ensure_flusher() -> None:
@@ -265,9 +296,10 @@ def _ensure_flusher() -> None:
     if rt is None or rt_mod.driver_runtime() is rt or _flusher_started:
         return
     _flusher_started = True
+    gen = _flusher_gen
 
     def loop():
-        while True:
+        while gen == _flusher_gen:
             time.sleep(2.0)
             flush()
 
@@ -389,17 +421,18 @@ def stop_metrics_server() -> None:
 
 
 def _reset_for_tests() -> None:
-    global _flusher_started, _dirty
+    global _flusher_started, _flusher_gen, _dirty
     stop_metrics_server()  # don't leak a ThreadingHTTPServer per test
     with _registry_lock:
         _registry.clear()
     _flusher_started = False
+    _flusher_gen += 1  # retire any live flusher thread at next wakeup
     _dirty = False
     from . import telemetry
     telemetry._reset_for_tests()
 
 
-def export_otlp_json(path: str) -> str:
+def export_otlp_json(path: str, window_s: Optional[float] = None) -> str:
     """Write the cluster-merged metrics in the OTLP/JSON resourceMetrics
     shape (reference: the OpenTelemetry metrics exporter behind
     open_telemetry_metric_recorder.h — here the file-based OTLP/JSON
@@ -408,7 +441,14 @@ def export_otlp_json(path: str) -> str:
     histogram points.  Per-process snapshots are aggregated per
     (metric, tag-set) first — counters and histogram buckets sum,
     gauges take the latest writer — so one OTLP document never carries
-    duplicate same-name points (mirrors prometheus_text)."""
+    duplicate same-name points (mirrors prometheus_text).
+
+    With ``window_s`` the document is built from the head's time-series
+    store instead of the live snapshot: counters and histograms export
+    the *last-window increase* with delta aggregation temporality
+    (gauges still export their latest stored value) — the shape a
+    backend wants for "what happened in the last N seconds" imports.
+    Requires a driver runtime (the store lives on the head)."""
     import json
 
     now_ns = int(time.time() * 1e9)
@@ -416,6 +456,9 @@ def export_otlp_json(path: str) -> str:
     def attrs(tags: Dict[str, str]):
         return [{"key": k, "value": {"stringValue": str(v)}}
                 for k, v in sorted(tags.items())]
+
+    if window_s is not None:
+        return _export_otlp_window(path, float(window_s), now_ns, attrs)
 
     by_name, acc = _aggregate_snapshots()
     samples_by_metric: Dict[str, list] = {}
@@ -493,6 +536,65 @@ def export_otlp_json(path: str) -> str:
             "value": {"stringValue": "ray_tpu"}}]},
         "scopeMetrics": [{"scope": {"name": "ray_tpu.util.metrics"},
                           "metrics": otlp_metrics}],
+    }]}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _export_otlp_window(path: str, window_s: float, now_ns: int,
+                        attrs) -> str:
+    """Windowed OTLP export from the head's time-series store (delta
+    aggregation temporality; see export_otlp_json)."""
+    import json
+
+    from ray_tpu._private import runtime as rt_mod
+    rt = rt_mod.driver_runtime()
+    view = getattr(rt, "metricsview", None) if rt is not None else None
+    if view is None:
+        raise RuntimeError(
+            "export_otlp_json(window_s=...) needs a running driver "
+            "runtime: the metrics time-series store lives on the head")
+    view.refresh(force=True)
+
+    by_base: Dict[str, Dict[str, Any]] = {}
+    for name, tags, mtype, value, bounds in view.store.window_rows(window_s):
+        entry = by_base.setdefault(name, {"name": name, "type": mtype,
+                                          "rows": []})
+        entry["rows"].append((tags, value, bounds))
+    otlp_metrics = []
+    for entry in by_base.values():
+        base: Dict[str, Any] = {"name": entry["name"], "description": ""}
+        if entry["type"] == "histogram":
+            points = []
+            for tags, value, bounds in entry["rows"]:
+                points.append({
+                    "attributes": attrs(tags),
+                    "timeUnixNano": str(now_ns),
+                    "count": str(int(value["count"])), "sum": value["sum"],
+                    "explicitBounds": [float(b) for b in (bounds or ())],
+                    "bucketCounts": [str(int(c)) for c in value["per"]]})
+            base["histogram"] = {"dataPoints": points,
+                                 "aggregationTemporality": 1}
+        else:
+            points = [{"attributes": attrs(tags),
+                       "timeUnixNano": str(now_ns),
+                       "asDouble": float(value)}
+                      for tags, value, _b in entry["rows"]]
+            if entry["type"] == "counter":
+                base["sum"] = {"dataPoints": points, "isMonotonic": True,
+                               "aggregationTemporality": 1}
+            else:
+                base["gauge"] = {"dataPoints": points}
+        otlp_metrics.append(base)
+
+    doc = {"resourceMetrics": [{
+        "resource": {"attributes": [{
+            "key": "service.name",
+            "value": {"stringValue": "ray_tpu"}}]},
+        "scopeMetrics": [{"scope": {"name": "ray_tpu.util.metrics"},
+                          "metrics": otlp_metrics,
+                          "schemaUrl": ""}],
     }]}
     with open(path, "w") as f:
         json.dump(doc, f)

@@ -88,6 +88,49 @@ def sched_decisions(task_id: Optional[str] = None,
     return _control("sched_decisions", task_id, limit)
 
 
+def metrics_query(name: str, window_s: float = 60.0, agg: str = "avg",
+                  tags: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Windowed aggregate over the head's metrics time-series store
+    (ray_tpu.metricsview): ``agg`` is ``rate | delta | avg | min | max |
+    last | pNN`` (percentiles reconstruct from histogram bucket deltas,
+    so ``p99`` is the *window's* p99, not the lifetime one).  Returns
+    ``{"name", "agg", "window_s", "value", "series", "points"}``."""
+    return _control("metrics_query", name, window_s, agg, tags)
+
+
+def metrics_history(name: str, window_s: float = 300.0,
+                    tags: Optional[Dict[str, str]] = None,
+                    max_points: int = 240) -> Dict[str, Any]:
+    """Recent stored points per matching series as ``[age_s, value]``
+    sparkline rows (histograms render inter-point average latency)."""
+    return _control("metrics_history", name, window_s, tags, max_points)
+
+
+def metrics_series() -> List[str]:
+    """Series names with history in the head's time-series store."""
+    return _control("metrics_series")
+
+
+def alerts(recent: int = 50) -> Dict[str, Any]:
+    """SLO engine status: per-objective state (ok|pending|firing|
+    resolved) with fast/slow burn rates, plus the recent transition
+    ring (``ray-tpu alerts`` renders this)."""
+    return _control("alerts", recent)
+
+
+def slo_set(objectives: List[Dict[str, Any]]) -> int:
+    """Replace the SLO objective set.  Each objective is a spec dict:
+    ``{"name", "metric", "agg", "op", "threshold", "tags"?,
+    "fast_window_s"?, "slow_window_s"?, "pending_for_s"?,
+    "cooldown_s"?}`` (see ray_tpu.metricsview.SloObjective)."""
+    return _control("slo_set", objectives)
+
+
+def slo_list() -> List[Dict[str, Any]]:
+    """The registered SLO objective specs."""
+    return _control("slo_list")
+
+
 def summarize_actors(**_: Any) -> Dict[str, Dict[str, int]]:
     out: Dict[str, Dict[str, int]] = {}
     for a in list_actors():

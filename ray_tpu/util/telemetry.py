@@ -375,6 +375,28 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "description": "Control-plane exceptions intentionally swallowed "
                        "(best-effort paths), by call site.  A climbing "
                        "series names the subsystem eating errors."},
+    # -- metricsview (time-series backplane) -------------------------------
+    "ray_tpu_metricsview_points_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Points appended to the head's metrics "
+                       "time-series store (post-downsample: a burst of "
+                       "flushes inside one interval stores one "
+                       "point)."},
+    "ray_tpu_metricsview_dropped_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Store points lost to ring eviction plus series "
+                       "refused over the metricsview_max_series cap — "
+                       "a climbing rate means history is shorter than "
+                       "the configured retention."},
+    # -- alerts (SLO burn-rate engine) -------------------------------------
+    "ray_tpu_alerts_firing": {
+        "type": "gauge", "tag_keys": (),
+        "description": "SLO objectives currently in the firing state "
+                       "(fast AND slow burn-rate windows breached)."},
+    "ray_tpu_alerts_transitions_total": {
+        "type": "counter", "tag_keys": ("state",),
+        "description": "Alert state-machine transitions by destination "
+                       "state (state=pending|firing|resolved|ok)."},
     # -- data --------------------------------------------------------------
     "ray_tpu_data_block_seconds": {
         "type": "histogram", "tag_keys": ("operator",),

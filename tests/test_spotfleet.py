@@ -240,6 +240,13 @@ class TestAutoscalerStatusPublish:
 
 
 class TestSpotfleetSmoke:
+    # SLA axes that measure wall-clock goodput of the chaos scenarios.
+    # On a loaded single-core host these dip without any code
+    # regression (replacement boot + join competes with the training
+    # loop for the same CPU), so they get ONE retry.  Everything else
+    # in the SLA is deterministic and must hold on every attempt.
+    _LOAD_SENSITIVE = ("floor_held",)
+
     def test_fast_bench_end_to_end(self, tmp_path):
         """`bench.py --spec spotfleet --fast` wired into tier-1 as a
         smoke: the full three-scenario run (churn graceful-vs-naive,
@@ -255,19 +262,38 @@ class TestSpotfleetSmoke:
             "print('SLA_PASS', doc['sla']['pass'])\n")
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    PALLAS_AXON_POOL_IPS="", XLA_FLAGS="")
-        proc = subprocess.run(
-            [sys.executable, "-u", "-c", code], cwd=REPO_ROOT, env=env,
-            capture_output=True, text=True, timeout=420)
-        assert proc.returncode == 0, \
-            f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n" \
-            f"{proc.stderr[-4000:]}"
+        for attempt in (1, 2):
+            if os.path.exists(out):
+                os.remove(out)  # never judge a stale doc
+            proc = subprocess.run(
+                [sys.executable, "-u", "-c", code], cwd=REPO_ROOT,
+                env=env, capture_output=True, text=True, timeout=420)
+            # bench_spotfleet raises SystemExit(1) on an SLA fail but
+            # still writes the doc; anything else (crash, no doc) is a
+            # hard failure with no retry.
+            assert os.path.exists(out), \
+                f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n" \
+                f"{proc.stderr[-4000:]}"
+            with open(out) as f:
+                doc = json.load(f)
+            sla = doc["sla"]
+            assert doc["churn"]["graceful"]["completed"]
+            assert doc["churn"]["naive"]["completed"]
+            assert sla["lost_under_budget"], sla
+            assert sla["prebuy_before_deadline"], sla
+            assert sla["multislice_survivor_committed"], sla
+            assert sla["multislice_zero_lost_steps"], sla
+            if sla["pass"]:
+                assert proc.returncode == 0, \
+                    f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n" \
+                    f"{proc.stderr[-4000:]}"
+                break
+            failed = [k for k in self._LOAD_SENSITIVE if not sla[k]]
+            assert failed, f"SLA failed outside load-sensitive axes: {sla}"
+            assert attempt == 1, \
+                f"goodput SLA failed on both attempts: {sla}"
         assert "SLA_PASS True" in proc.stdout
-        with open(out) as f:
-            doc = json.load(f)
         assert doc["sla"]["pass"] is True
-        assert doc["churn"]["graceful"]["completed"]
-        assert doc["churn"]["naive"]["completed"]
-        assert doc["sla"]["multislice_zero_lost_steps"]
 
 
 class TestSpotfleetSmokeQuick:
