@@ -84,7 +84,7 @@ def load_store_library() -> Optional[ctypes.CDLL]:
         lib.rts_delete.restype = c.c_int
         lib.rts_delete.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
         lib.rts_stats.restype = None
-        lib.rts_stats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64 * 8)]
+        lib.rts_stats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64 * 10)]
         lib.rts_destroy.restype = None
         lib.rts_destroy.argtypes = [c.c_void_p]
         lib._rts_configured = True

@@ -363,13 +363,21 @@ int rts_delete(RtsStore *s, const uint8_t *id, uint32_t idlen) {
 }
 
 // out: [num_objects, used, capacity, spilled, restored, evictions,
-//       num_in_memory, pinned_count]
-void rts_stats(RtsStore *s, uint64_t out[8]) {
+//       num_in_memory, pinned_count, pinned_bytes, spilled_bytes]
+// (rebuilt-by-hash with its ctypes binding, so widening is safe)
+void rts_stats(RtsStore *s, uint64_t out[10]) {
   std::lock_guard<std::mutex> g(s->mu);
-  uint64_t in_mem = 0, pinned = 0;
+  uint64_t in_mem = 0, pinned = 0, pinned_bytes = 0, spilled_bytes = 0;
   for (auto &kv : s->table) {
-    if (kv.second.in_memory) ++in_mem;
-    if (kv.second.pinned > 0) ++pinned;
+    if (kv.second.in_memory) {
+      ++in_mem;
+    } else {
+      spilled_bytes += kv.second.nbytes;
+    }
+    if (kv.second.pinned > 0) {
+      ++pinned;
+      pinned_bytes += kv.second.nbytes;
+    }
   }
   out[0] = s->table.size();
   out[1] = s->alloc.used();
@@ -379,6 +387,8 @@ void rts_stats(RtsStore *s, uint64_t out[8]) {
   out[5] = s->num_evictions;
   out[6] = in_mem;
   out[7] = pinned;
+  out[8] = pinned_bytes;
+  out[9] = spilled_bytes;
 }
 
 void rts_destroy(RtsStore *s) {

@@ -35,6 +35,9 @@ from dataclasses import dataclass, field
 from multiprocessing.connection import Client, Listener
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.storeview import events as _sv
+from ray_tpu.util import telemetry, tracing
+
 from . import sanitizer
 from .config import Config
 from .controller import NodeInfo
@@ -396,8 +399,12 @@ class DataServer:
         try:
             while True:
                 desc = conn.recv()
+                t0 = time.monotonic()
                 payload = read_raw_payload(self._store, desc)
                 conn.send(payload)  # None = gone
+                if payload is not None:
+                    _record_transfer("push", self._store, desc,
+                                     len(payload), time.monotonic() - t0)
         except (EOFError, OSError):
             pass
         finally:
@@ -413,6 +420,40 @@ class DataServer:
             self._listener.close()
         except Exception:
             pass
+
+
+def _record_transfer(direction: str, store, desc, nbytes: int,
+                     dur_s: float,
+                     peer: Optional[str] = None,
+                     ctx=None) -> None:
+    """Transfer accounting for one cross-node payload move: the
+    ``ray_tpu_store_transfer_*`` series, a lifecycle ring event on the
+    local store, and (when a trace is in flight or tracing is enabled)
+    an ``obj.push``/``obj.pull`` span.  Never fails the transfer path."""
+    try:
+        telemetry.inc("ray_tpu_store_transfer_bytes_total", nbytes,
+                      tags={"direction": direction})
+        telemetry.observe("ray_tpu_store_transfer_seconds", dur_s,
+                          tags={"op": direction})
+        key = desc_key(desc) if isinstance(desc, tuple) else None
+        view = getattr(store, "view", None)
+        if view is not None and _sv.enabled() and key is not None:
+            kind = _sv.E_PUSH if direction == "push" else _sv.E_PULL
+            view.push(kind, key, nbytes, peer=peer,
+                      detail=f"{dur_s:.6f}")
+        parent = ctx if ctx is not None else tracing.current()
+        if parent is not None or tracing.is_enabled():
+            oid = desc_object_id(desc) if isinstance(desc, tuple) else None
+            end_s = time.time()
+            # Wall anchor for a monotonic duration, not interval math.
+            start_s = end_s - dur_s  # ray-tpu: noqa[RT203]
+            tracing.record_span(
+                parent, f"obj.{direction}", start_s, end_s,
+                attributes={"object_id": oid.hex() if oid else None,
+                            "nbytes": nbytes, "peer": peer},
+                kind="CLIENT" if direction == "pull" else "SERVER")
+    except Exception as e:  # noqa: BLE001
+        telemetry.note_swallowed("cluster.record_transfer", e)
 
 
 def read_raw_payload(store, desc) -> Optional[bytes]:
@@ -498,8 +539,10 @@ class ObjectPuller:
         self._local = local_node_id_bytes
         self._resolve_address = resolve_address  # node_id_bytes -> (h, p)|None
 
-    def localize(self, desc):
-        """Returns a local descriptor, or ("err", payload) if unreachable."""
+    def localize(self, desc, ctx=None):
+        """Returns a local descriptor, or ("err", payload) if unreachable.
+        ``ctx`` parents the pull span on the consuming task's trace (the
+        dispatch path runs on node threads with no ambient context)."""
         from . import serialization
         from .exceptions import ObjectLostError
 
@@ -515,6 +558,7 @@ class ObjectPuller:
         local = self._store.descriptor(oid)
         if local is not None:
             return local
+        t0 = time.monotonic()
         addr = self._resolve_address(desc[1])
         payload = None
         if addr is not None:
@@ -528,11 +572,14 @@ class ObjectPuller:
             return ("err", serialization.pack_payload(ObjectLostError(
                 f"object {oid} could not be cached locally",
                 object_id_bytes=oid.binary())))
+        _record_transfer("pull", self._store, inner, len(payload),
+                         time.monotonic() - t0,
+                         peer=desc[1].hex()[:16], ctx=ctx)
         return local
 
-    def localize_all(self, args: list, kwargs: dict):
-        return ([self.localize(d) for d in args],
-                {k: self.localize(d) for k, d in kwargs.items()})
+    def localize_all(self, args: list, kwargs: dict, ctx=None):
+        return ([self.localize(d, ctx=ctx) for d in args],
+                {k: self.localize(d, ctx=ctx) for k, d in kwargs.items()})
 
 
 # --------------------------------------------------------------------------
@@ -1511,7 +1558,15 @@ class NodeServer:
                 traceback.print_exc()
 
     def _do_dispatch(self, msg: DispatchTask) -> None:
-        args, kwargs = self.puller.localize_all(msg.args, msg.kwargs)
+        # Pull spans for arg localization parent on the task's submit
+        # span (carried in the spec), so a task tree shows what
+        # localizing its inputs cost.
+        ctx = None
+        tp = getattr(msg.spec, "trace_ctx", None)
+        if tp:
+            ctx = tracing.SpanContext.from_traceparent(tp)
+        args, kwargs = self.puller.localize_all(msg.args, msg.kwargs,
+                                                ctx=ctx)
         if getattr(msg, "pipelined", False):
             if not self.node.dispatch_pipelined(msg.spec, args, kwargs):
                 self.send_up(UpPipelineReject(msg.spec))
@@ -1573,7 +1628,8 @@ class NodeServer:
         for d in reply.values:
             local = self.puller.localize(d)
             if isinstance(local, tuple) and local and local[0] == "shma":
-                nd = self.node.store.pin_desc_by_key(local[4])
+                nd = self.node.store.pin_desc_by_key(
+                    local[4], pinner=worker_id.hex())
                 if nd is not None:
                     pins.append(nd[4])
                     local = nd

@@ -179,6 +179,19 @@ def write_debug_bundle(rt, reason: str,
         }, indent=1, default=str)
     section("sched_decisions.json", _sched)
 
+    def _objects():
+        # Data-plane counterpart of _sched: where the memory went.  A
+        # postmortem bundle should attribute occupancy (per node, top
+        # objects, leak candidates) and carry the store event-ring tail
+        # so spill/pull storms around the crash are reconstructable.
+        if not hasattr(rt, "ctl_memory_summary"):
+            return None
+        return json.dumps({
+            "memory": rt.ctl_memory_summary(),
+            "store_events": rt.ctl_store_events(limit=500),
+        }, indent=1, default=str)
+    section("objects.json", _objects)
+
     def _locks():
         # Lock-order detector findings (RAY_TPU_DEBUG_LOCKS=1): written
         # whenever the detector is active or has recorded anything, so a

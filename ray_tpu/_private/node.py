@@ -1190,10 +1190,41 @@ class NodeManager:
         except Exception as e:
             telemetry.note_swallowed("node.local_view", e)
         try:
-            view["store_bytes_used"] = int(self.store.stats()["used_bytes"])
+            stats = self.store.stats()
+            view["store_bytes_used"] = int(stats["used_bytes"])
+            # Full store sub-view for the head's memory summary, riding
+            # the existing change-driven syncer.  Only idle-stable fields
+            # (no ages/timestamps): an idle cluster must not resync.
+            view["store"] = self._store_view(stats)
         except Exception as e:
             telemetry.note_swallowed("node.local_view", e)
         return view
+
+    def _store_view(self, stats: Dict[str, Any],
+                    top_n: int = 5) -> Dict[str, Any]:
+        """Store occupancy + lifecycle summary for UpSyncView fan-out."""
+        out: Dict[str, Any] = dict(stats)
+        ring = getattr(self.store, "view", None)
+        if ring is None:
+            return out
+        out["counts"] = dict(ring.counts)
+        states = ring.latest_index()
+        live = [st for st in states
+                if st["state"] not in ("deleted", "evicted")]
+        live.sort(key=lambda st: st["nbytes"], reverse=True)
+        out["top_objects"] = [
+            {"object_id": st["object_id"], "nbytes": st["nbytes"],
+             "state": st["state"], "pins": st["pins"],
+             "pinners": st["pinners"]}
+            for st in live[:top_n]]
+        with self._lock:
+            live_tokens = {wid.hex() for wid in self._workers}
+        out["leak_candidates"] = [
+            {"object_id": rec["object_id"], "nbytes": rec["nbytes"],
+             "reason": rec["reason"], "reads": rec["reads"],
+             "pins": rec["pins"], "pinners": rec["pinners"]}
+            for rec in ring.leak_candidates(live_tokens=live_tokens)[:top_n]]
+        return out
 
     def prestart_workers(self, n: int) -> None:
         for _ in range(n):

@@ -22,6 +22,7 @@ name without talking to the store (the directory hands out the name).
 from __future__ import annotations
 
 import os
+import shutil
 import threading
 import time
 from collections import OrderedDict
@@ -29,9 +30,87 @@ from dataclasses import dataclass, field
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu.storeview import events as _sv
+
 from . import serialization
 from .config import Config
 from .ids import ObjectID
+
+#: default spill root swept for orphans (dirs named <pid>/arena_<pid>).
+SPILL_ROOT = os.path.join("/tmp", "ray_tpu_spill")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # exists but not ours (EPERM) — treat as alive
+    return True
+
+
+def _dir_nbytes(path: str) -> int:
+    total = 0
+    for dirpath, _dirs, files in os.walk(path):
+        for fname in files:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, fname))
+            except OSError:
+                pass
+    return total
+
+
+def sweep_orphan_spills(root: Optional[str] = None) -> int:
+    """Delete spill directories left by dead store processes.
+
+    Spill files live under ``SPILL_ROOT/<pid>`` (Python store) or
+    ``SPILL_ROOT/arena_<pid>`` (native arena); a SIGKILLed node leaves
+    them behind forever.  Sweeps only dirs whose embedded pid is dead,
+    so concurrent live stores on the host are never touched.  Returns
+    reclaimed bytes (also published as
+    ``ray_tpu_store_spill_reclaimed_bytes_total``).
+    """
+    root = root or SPILL_ROOT
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    reclaimed = 0
+    for name in names:
+        pid_s = name[6:] if name.startswith("arena_") else name
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            continue
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        path = os.path.join(root, name)
+        reclaimed += _dir_nbytes(path)
+        shutil.rmtree(path, ignore_errors=True)
+    if reclaimed:
+        from ray_tpu.util import telemetry
+        telemetry.inc("ray_tpu_store_spill_reclaimed_bytes_total",
+                      reclaimed)
+    return reclaimed
+
+
+_boot_sweep_done = False
+
+
+def _maybe_boot_sweep() -> None:
+    """Once-per-process orphan sweep, run from store construction (the
+    "next boot" half of spill-file GC; the shutdown half is each store's
+    own-dir cleanup)."""
+    global _boot_sweep_done
+    if _boot_sweep_done:
+        return
+    _boot_sweep_done = True
+    try:
+        sweep_orphan_spills()
+    except Exception as e:  # GC must never fail store construction
+        from ray_tpu.util import telemetry
+        telemetry.note_swallowed("object_store.boot_sweep", e)
 
 
 def _shm_name(object_id: ObjectID) -> str:
@@ -97,6 +176,12 @@ class SharedMemoryStore:
         self._lock = threading.RLock()
         self.num_spilled = 0
         self.num_restored = 0
+        self.num_evictions = 0  # Python store spills, never drops: stays 0
+        # Lifecycle ring (storeview): every mutation below records one
+        # event when tracing is on; `ray-tpu obj why` and the memory
+        # summary read it.
+        self.view = _sv.StoreEventRing()
+        _maybe_boot_sweep()
 
     # -- write path ---------------------------------------------------------
 
@@ -109,11 +194,15 @@ class SharedMemoryStore:
                                   size=max(nbytes, 1))
             self._entries[object_id] = _Entry(nbytes=nbytes, shm=shm)
             self._used += nbytes
+            if _sv.enabled():
+                self.view.push(_sv.E_CREATE, object_id.binary(), nbytes)
             return shm.buf[:nbytes]
 
     def seal(self, object_id: ObjectID) -> None:
         with self._lock:
             self._entries[object_id].sealed = True
+        if _sv.enabled():
+            self.view.push(_sv.E_SEAL, object_id.binary())
 
     def put_serialized(self, object_id: ObjectID, meta: bytes, buffers) -> int:
         nbytes = serialization.payload_nbytes(meta, buffers)
@@ -145,23 +234,33 @@ class SharedMemoryStore:
             if e.shm is None:
                 self._restore(object_id, e)
             self._entries.move_to_end(object_id)  # LRU touch
+            if _sv.enabled():
+                self.view.push(_sv.E_GET, object_id.binary(), e.nbytes)
             return e.shm.buf[: e.nbytes], e.shm
 
     def get(self, object_id: ObjectID) -> Any:
         buf, _keepalive = self.get_buffer(object_id)
         return serialization.read_payload_from(buf)
 
-    def pin(self, object_id: ObjectID) -> None:
+    def pin(self, object_id: ObjectID,
+            pinner: Optional[str] = None) -> None:
         with self._lock:
             self._entries[object_id].pinned += 1
+        if _sv.enabled():
+            self.view.push(_sv.E_PIN, object_id.binary(), detail=pinner)
 
-    def unpin(self, object_id: ObjectID) -> None:
+    def unpin(self, object_id: ObjectID,
+              pinner: Optional[str] = None) -> None:
         with self._lock:
             e = self._entries.get(object_id)
-            if e and e.pinned > 0:
-                e.pinned -= 1
+            if e is None or e.pinned <= 0:
+                return
+            e.pinned -= 1
+        if _sv.enabled():
+            self.view.push(_sv.E_UNPIN, object_id.binary(), detail=pinner)
 
-    def try_pin(self, object_id: ObjectID) -> bool:
+    def try_pin(self, object_id: ObjectID,
+                pinner: Optional[str] = None) -> bool:
         """Pin if the store owns this object (emergency-replica staging:
         a pinned snapshot is exempt from LRU spill/eviction).  Objects
         created by worker processes live in their own segments outside
@@ -172,15 +271,20 @@ class SharedMemoryStore:
             if e is None:
                 return False
             e.pinned += 1
-            return True
+        if _sv.enabled():
+            self.view.push(_sv.E_PIN, object_id.binary(), detail=pinner)
+        return True
 
-    def try_unpin(self, object_id: ObjectID) -> bool:
+    def try_unpin(self, object_id: ObjectID,
+                  pinner: Optional[str] = None) -> bool:
         with self._lock:
             e = self._entries.get(object_id)
             if e is None or e.pinned <= 0:
                 return False
             e.pinned -= 1
-            return True
+        if _sv.enabled():
+            self.view.push(_sv.E_UNPIN, object_id.binary(), detail=pinner)
+        return True
 
     def num_pinned(self) -> int:
         with self._lock:
@@ -200,6 +304,8 @@ class SharedMemoryStore:
                     pass
             if e.spilled_path and os.path.exists(e.spilled_path):
                 os.unlink(e.spilled_path)
+        if _sv.enabled():
+            self.view.push(_sv.E_DELETE, object_id.binary(), e.nbytes)
 
     def shm_name(self, object_id: ObjectID) -> str:
         return _shm_name(object_id)
@@ -231,22 +337,58 @@ class SharedMemoryStore:
             return self.descriptor(object_id)
         except ObjectStoreFullError:
             return None
+        except FileExistsError:
+            # The producer lives on this host: its segment already
+            # carries this payload (ids are globally unique, payloads
+            # immutable), and shm names are host-global.  Point the
+            # caller at the live segment instead of caching a copy
+            # under a name we cannot create.
+            return ("shm", _shm_name(object_id), len(payload))
         view[:] = payload
         del view
         self.seal(object_id)
         return self.descriptor(object_id)
 
     def stats(self) -> Dict[str, int]:
+        # Same keys as NativeArenaStore.stats() (native=0|1 tells them
+        # apart) so the memory summary renders identically for both.
         with self._lock:
-            return {"num_objects": len(self._entries), "used_bytes": self._used,
+            in_mem = pinned = pinned_bytes = spilled_bytes = 0
+            for e in self._entries.values():
+                if e.shm is not None:
+                    in_mem += 1
+                else:
+                    spilled_bytes += e.nbytes
+                if e.pinned > 0:
+                    pinned += 1
+                    pinned_bytes += e.nbytes
+            return {"num_objects": len(self._entries),
+                    "used_bytes": self._used,
                     "capacity_bytes": self._capacity,
+                    "pinned_bytes": pinned_bytes,
+                    "spilled_bytes": spilled_bytes,
                     "num_spilled": self.num_spilled,
-                    "num_restored": self.num_restored}
+                    "num_restored": self.num_restored,
+                    "num_evictions": self.num_evictions,
+                    "num_in_memory": in_mem,
+                    "num_pinned": pinned,
+                    "native": 0}
 
     def shutdown(self) -> None:
         with self._lock:
             for oid in list(self._entries):
                 self.delete(oid)
+        # Shutdown half of spill-file GC: per-object deletes above remove
+        # tracked spill files; anything left in our default spill dir is
+        # an orphan (crashed mid-spill, or an untracked leftover).
+        if not self._spill_dir:
+            own = os.path.join(SPILL_ROOT, str(os.getpid()))
+            leftover = _dir_nbytes(own)
+            shutil.rmtree(own, ignore_errors=True)
+            if leftover:
+                from ray_tpu.util import telemetry
+                telemetry.inc("ray_tpu_store_spill_reclaimed_bytes_total",
+                              leftover)
 
     # -- eviction / spill ---------------------------------------------------
 
@@ -262,7 +404,26 @@ class SharedMemoryStore:
         if self._used + nbytes > self._capacity:
             raise ObjectStoreFullError(
                 f"need {nbytes} bytes; {self._used}/{self._capacity} used and "
-                "nothing evictable")
+                "nothing evictable" + self._pinned_detail())
+
+    def _pinned_detail(self, top_n: int = 3) -> str:
+        """Actionable tail for ObjectStoreFullError: the largest pinned
+        objects and who pinned them (from the lifecycle ring)."""
+        try:
+            pinned = sorted(
+                ((oid, e) for oid, e in self._entries.items()
+                 if e.pinned > 0),
+                key=lambda kv: kv[1].nbytes, reverse=True)[:top_n]
+            if not pinned:
+                return ""
+            parts = []
+            for oid, e in pinned:
+                who = ",".join(self.view.pinners_of(oid.binary())) or "?"
+                parts.append(f"{oid.hex()[:12]} "
+                             f"({e.nbytes}B pins={e.pinned} by {who})")
+            return "; top pinned: " + ", ".join(parts)
+        except Exception:  # noqa: BLE001 — error enrichment is display-only
+            return ""
 
     def _spill_path(self, object_id: ObjectID) -> str:
         d = self._spill_dir
@@ -281,6 +442,8 @@ class SharedMemoryStore:
         e.shm = None
         self._used -= e.nbytes
         self.num_spilled += 1
+        if _sv.enabled():
+            self.view.push(_sv.E_SPILL, object_id.binary(), e.nbytes)
 
     def _restore(self, object_id: ObjectID, e: _Entry) -> None:
         if not e.spilled_path:
@@ -293,6 +456,8 @@ class SharedMemoryStore:
         e.shm = shm
         self._used += e.nbytes
         self.num_restored += 1
+        if _sv.enabled():
+            self.view.push(_sv.E_RESTORE, object_id.binary(), e.nbytes)
 
 
 class NativeArenaStore:
@@ -321,8 +486,14 @@ class NativeArenaStore:
         if not self._h:
             raise RuntimeError("native store arena creation failed")
         self.segment_name = name
+        self._spill_dir = spill
         self._shm = _open_untracked(name, create=False)
         self._closed = False
+        # Lifecycle ring (storeview): spill/evict decisions happen inside
+        # the C++ LRU so those arrive as stats-diff counters only; every
+        # Python-visible mutation records an event here.
+        self.view = _sv.StoreEventRing()
+        _maybe_boot_sweep()
 
     # -- write path ---------------------------------------------------------
 
@@ -333,12 +504,31 @@ class NativeArenaStore:
             raise ValueError(f"object {object_id} already exists")
         if off < 0:
             raise ObjectStoreFullError(
-                f"arena cannot fit {nbytes} bytes (all pinned or unsealed)")
+                f"arena cannot fit {nbytes} bytes (all pinned or unsealed)"
+                + self._pinned_detail())
+        if _sv.enabled():
+            self.view.push(_sv.E_CREATE, object_id.binary(), nbytes)
         return off
+
+    def _pinned_detail(self, top_n: int = 3) -> str:
+        """Actionable tail for ObjectStoreFullError, from the lifecycle
+        ring (the C++ index has no pinner attribution)."""
+        try:
+            pinned = self.view.top_pinned(top_n)
+            if not pinned:
+                return ""
+            parts = [f"{p['object_id'][:12]} ({p['nbytes']}B "
+                     f"pins={p['pins']} by "
+                     f"{','.join(p['pinners']) or '?'})" for p in pinned]
+            return "; top pinned: " + ", ".join(parts)
+        except Exception:  # noqa: BLE001 — error enrichment is display-only
+            return ""
 
     def seal(self, object_id: ObjectID) -> None:
         self._lib.rts_seal(self._h, object_id.binary(),
                            len(object_id.binary()))
+        if _sv.enabled():
+            self.view.push(_sv.E_SEAL, object_id.binary())
 
     def put_serialized(self, object_id: ObjectID, meta: bytes, buffers) -> int:
         nbytes = serialization.payload_nbytes(meta, buffers)
@@ -386,14 +576,20 @@ class NativeArenaStore:
             return None
         return ("shma", self.segment_name, res[0], res[1], key)
 
-    def pin_desc_by_key(self, key: bytes) -> Optional[tuple]:
+    def pin_desc_by_key(self, key: bytes,
+                        pinner: Optional[str] = None) -> Optional[tuple]:
         res = self._lookup(key, pin=True)
         if res is None:
             return None
+        if _sv.enabled():
+            self.view.push(_sv.E_PIN, key, res[1], detail=pinner)
         return ("shma", self.segment_name, res[0], res[1], key)
 
-    def unpin_key(self, key: bytes) -> None:
+    def unpin_key(self, key: bytes,
+                  pinner: Optional[str] = None) -> None:
         self._lib.rts_unpin(self._h, key, len(key))
+        if _sv.enabled():
+            self.view.push(_sv.E_UNPIN, key, detail=pinner)
 
     def read_by_key(self, key: bytes, pin: bool) -> Optional[Any]:
         """Owner-process zero-copy read (views into the arena mapping)."""
@@ -401,6 +597,10 @@ class NativeArenaStore:
         if res is None:
             return None
         off, nbytes = res
+        if _sv.enabled():
+            self.view.push(_sv.E_GET, key, nbytes)
+            if pin:
+                self.view.push(_sv.E_PIN, key, nbytes)
         return serialization.read_payload_from(self._shm.buf[off: off + nbytes])
 
     # -- cross-node transfer (raw payload bytes) ----------------------------
@@ -413,9 +613,12 @@ class NativeArenaStore:
             return None
         try:
             off, nbytes = res
+            if _sv.enabled():
+                self.view.push(_sv.E_GET, key, nbytes)
             return bytes(self._shm.buf[off: off + nbytes])
         finally:
-            self.unpin_key(key)
+            # Transient copy pin, not a reader pin: skip the ring events.
+            self._lib.rts_unpin(self._h, key, len(key))
 
     def put_raw(self, object_id: ObjectID, payload: bytes) -> Optional[tuple]:
         """Store a payload pulled from another node; returns the local
@@ -437,36 +640,53 @@ class NativeArenaStore:
             raise KeyError(f"object {object_id} not in store")
         return value
 
-    def pin(self, object_id: ObjectID) -> None:
+    def pin(self, object_id: ObjectID,
+            pinner: Optional[str] = None) -> None:
         key = object_id.binary()
-        self._lookup(key, pin=True)
+        if self._lookup(key, pin=True) is not None and _sv.enabled():
+            self.view.push(_sv.E_PIN, key, detail=pinner)
 
-    def unpin(self, object_id: ObjectID) -> None:
-        self.unpin_key(object_id.binary())
+    def unpin(self, object_id: ObjectID,
+              pinner: Optional[str] = None) -> None:
+        self.unpin_key(object_id.binary(), pinner=pinner)
 
-    def try_pin(self, object_id: ObjectID) -> bool:
+    def try_pin(self, object_id: ObjectID,
+                pinner: Optional[str] = None) -> bool:
         """Arena-store counterpart of SharedMemoryStore.try_pin (the
         emergency-replica pin API): pin when present, report whether the
         arena actually holds the object."""
-        return self._lookup(object_id.binary(), pin=True) is not None
+        key = object_id.binary()
+        if self._lookup(key, pin=True) is None:
+            return False
+        if _sv.enabled():
+            self.view.push(_sv.E_PIN, key, detail=pinner)
+        return True
 
-    def try_unpin(self, object_id: ObjectID) -> bool:
+    def try_unpin(self, object_id: ObjectID,
+                  pinner: Optional[str] = None) -> bool:
         if not self.contains(object_id):
             return False
-        self.unpin_key(object_id.binary())
+        self.unpin_key(object_id.binary(), pinner=pinner)
         return True
 
     def delete(self, object_id: ObjectID) -> None:
         key = object_id.binary()
         if self._lib.rts_delete(self._h, key, len(key)) != 0:
             raise KeyError(f"object {object_id} not in store")
+        if _sv.enabled():
+            self.view.push(_sv.E_DELETE, key)
 
     def stats(self) -> Dict[str, int]:
+        # Same keys as SharedMemoryStore.stats(); values come from the
+        # C++ index in one call (store.cc rts_stats).
         import ctypes
-        out = (ctypes.c_uint64 * 8)()
+        out = (ctypes.c_uint64 * 10)()
         self._lib.rts_stats(self._h, ctypes.byref(out))
         return {"num_objects": int(out[0]), "used_bytes": int(out[1]),
-                "capacity_bytes": int(out[2]), "num_spilled": int(out[3]),
+                "capacity_bytes": int(out[2]),
+                "pinned_bytes": int(out[8]),
+                "spilled_bytes": int(out[9]),
+                "num_spilled": int(out[3]),
                 "num_restored": int(out[4]), "num_evictions": int(out[5]),
                 "num_in_memory": int(out[6]), "num_pinned": int(out[7]),
                 "native": 1}
@@ -479,8 +699,17 @@ class NativeArenaStore:
             self._shm.close()
         except Exception:
             pass
-        self._lib.rts_destroy(self._h)
+        self._lib.rts_destroy(self._h)  # removes tracked spill files
         self._h = None
+        # Shutdown half of spill-file GC: anything left in our spill dir
+        # after rts_destroy is an orphan (crashed mid-spill).
+        if self._spill_dir.startswith(SPILL_ROOT):
+            leftover = _dir_nbytes(self._spill_dir)
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+            if leftover:
+                from ray_tpu.util import telemetry
+                telemetry.inc("ray_tpu_store_spill_reclaimed_bytes_total",
+                              leftover)
 
 
 def create_store(capacity_bytes: Optional[int] = None,

@@ -40,6 +40,7 @@ from .ids import (ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID,
                   WorkerID)
 from .node import NodeManager
 from .object_store import RemoteObjectReader
+from ..storeview import events as _store_events
 from .protocol import (ActorStateMsg, GetReply, GetRequest, PutFromWorker,
                        RpcCall, RpcReply, TaskDone, TaskSpec, WaitReply,
                        WaitRequest)
@@ -542,7 +543,31 @@ class Runtime:
         if kind == "shm":
             shm = self._mapped_segments.get(object_id)
             if shm is None:
-                value, shm = RemoteObjectReader.read(desc[1], desc[2])
+                try:
+                    value, shm = RemoteObjectReader.read(desc[1], desc[2])
+                    # The mapping read bypasses the store, so the lifecycle
+                    # ring would count this object as never-read (and flag
+                    # it as a leak candidate).  Record the read here; the
+                    # restore fallback below goes through get_buffer, which
+                    # records it itself.
+                    ring = getattr(self.node.store, "view", None)
+                    if ring is not None and _store_events.enabled():
+                        ring.push(_store_events.E_GET,
+                                  object_id.binary(), desc[2])
+                except FileNotFoundError:
+                    # The local store spilled this object: its segment
+                    # was unlinked when the payload moved to disk.  A
+                    # store read restores the segment under the same
+                    # name, after which the mapping works again.
+                    try:
+                        buf, _keep = self.node.store.get_buffer(object_id)
+                    except (KeyError, ValueError) as e:
+                        raise ObjectLostError(
+                            f"object {object_id} segment is gone and the "
+                            f"local store cannot restore it: {e}",
+                            object_id_bytes=object_id.binary()) from None
+                    buf.release()
+                    value, shm = RemoteObjectReader.read(desc[1], desc[2])
                 self._mapped_segments[object_id] = shm
             else:
                 value = serialization.read_payload_from(shm.buf[: desc[2]])
@@ -2187,7 +2212,9 @@ class Runtime:
         self.mark_escaped(oid)
         sanitizer.note_pin(oid.hex())
         store_pin = getattr(self.node.store, "try_pin", None)
-        return bool(store_pin(oid)) if store_pin is not None else False
+        if store_pin is None:
+            return False
+        return bool(store_pin(oid, pinner="ckpt_pin"))
 
     def ctl_unpin_object(self, oid_bytes: bytes) -> bool:
         oid = ObjectID(oid_bytes)
@@ -2195,7 +2222,9 @@ class Runtime:
             self._escaped.discard(oid)
         sanitizer.note_unpin(oid.hex())
         store_unpin = getattr(self.node.store, "try_unpin", None)
-        return bool(store_unpin(oid)) if store_unpin is not None else False
+        if store_unpin is None:
+            return False
+        return bool(store_unpin(oid, pinner="ckpt_pin"))
 
     def ctl_kv_put(self, key, value, namespace="default", overwrite=True):
         return self.controller.kv_put(key, value, namespace, overwrite)
@@ -2480,24 +2509,143 @@ class Runtime:
         out.setdefault("reasons", [])
         return out
 
+    @staticmethod
+    def _desc_location(desc, local_hex):
+        """(node_hex, inner_desc, nbytes) for a directory descriptor; a
+        bare descriptor lives on the head, an "at" tag names its owner."""
+        if not desc:
+            return None, None, None
+        node_hex, inner = local_hex, desc
+        if desc[0] == "at":
+            node_hex, inner = desc[1].hex(), desc[2]
+        nbytes = None
+        if inner[0] == "inline":
+            nbytes = len(inner[1])
+        elif inner[0] == "shm":
+            nbytes = inner[2]
+        elif inner[0] == "shma":
+            nbytes = inner[3]
+        return node_hex, inner, nbytes
+
     def ctl_list_objects(self, limit=10000):
+        ring = getattr(self.node.store, "view", None)
+        latest = {}
+        if ring is not None:
+            for rec in ring.latest_index():
+                latest[rec["object_id"]] = rec
         out = []
         with self._dir_lock:
             items = list(self.directory.items())[:limit]
+        local_hex = self.node_id.hex()
         for oid, st in items:
             desc = st.desc
             kind = desc[0] if desc else "pending"
-            nbytes = None
-            if desc:
-                if desc[0] == "inline":
-                    nbytes = len(desc[1])
-                elif desc[0] == "shm":
-                    nbytes = desc[2]
-                elif desc[0] == "shma":
-                    nbytes = desc[3]
-            out.append({"object_id": oid.hex(), "status": kind,
-                        "size_bytes": nbytes})
+            node_hex, _inner, nbytes = self._desc_location(desc, local_hex)
+            rec = {"object_id": oid.hex(), "status": kind,
+                   "size_bytes": nbytes, "node_id": node_hex,
+                   "task_id": oid.task_id().hex()}
+            seen = latest.get(oid.hex())
+            if seen is not None:
+                rec["store_state"] = seen["state"]
+                rec["pins"] = seen["pins"]
+            out.append(rec)
         return out
+
+    # -- data-plane telescope (storeview): memory summary, per-object
+    #    explain, store event ring — reference: `ray memory`, the
+    #    memory_summary state API ---------------------------------------- #
+
+    def ctl_memory_summary(self, top_n: int = 10):
+        """Cluster-wide object-store occupancy: per-node stats (the head
+        sampled live, remote nodes via their synced views), directory-
+        attributed top objects by size, and leak candidates.  Backs
+        `ray-tpu memory` and state.memory_summary()."""
+        self._publish_store_metrics(force=True)
+        nodes = {}
+        for nhex, view in self.ctl_node_views().items():
+            sub = view.get("store")
+            if isinstance(sub, dict):
+                nodes[nhex] = dict(sub)
+        totals = {}
+        for key in ("used_bytes", "capacity_bytes", "pinned_bytes",
+                    "spilled_bytes", "num_objects", "num_pinned",
+                    "num_spilled"):
+            totals[key] = sum(int(sub.get(key, 0))
+                              for sub in nodes.values())
+        objects = self.ctl_list_objects()
+        sized = [o for o in objects if o.get("size_bytes")]
+        sized.sort(key=lambda o: o["size_bytes"], reverse=True)
+        leaks = []
+        for nhex, sub in nodes.items():
+            for rec in sub.get("leak_candidates") or ():
+                leaks.append(dict(rec, node_id=nhex))
+        leaks.sort(key=lambda r: int(r.get("nbytes", 0)), reverse=True)
+        return {"nodes": nodes, "totals": totals,
+                "top_objects": sized[:top_n],
+                "leak_candidates": leaks,
+                "num_directory_objects": len(objects)}
+
+    def ctl_explain_object(self, object_id_hex: str):
+        """Answer `ray-tpu obj why <id>`: where an object lives (directory
+        descriptor + owner node), what produced it (owner task id from the
+        id itself), and what the store event ring saw it do (spill/restore
+        and pull history, pins and pinners).  Accepts id prefixes."""
+        prefix = (object_id_hex or "").lower()
+        with self._dir_lock:
+            matches = [oid for oid in self.directory
+                       if oid.hex().startswith(prefix)]
+        ring = getattr(self.node.store, "view", None)
+        if not matches:
+            # Deleted objects leave the directory but linger in the
+            # ring's latest-state index: still explainable.
+            if ring is not None:
+                rec = ring.explain(prefix)
+                if rec.get("status") in ("ok", "ambiguous"):
+                    rec.setdefault("directory", None)
+                    return rec
+            return {"object_id": prefix, "status": "unknown",
+                    "detail": "no object with this id (or prefix) in the "
+                              "directory or the store event ring"}
+        hexes = sorted(o.hex() for o in matches)
+        if len(matches) > 1 and prefix not in hexes:
+            return {"object_id": prefix, "status": "ambiguous",
+                    "matches": hexes[:8]}
+        oid = matches[0] if len(matches) == 1 \
+            else next(o for o in matches if o.hex() == prefix)
+        with self._dir_lock:
+            st = self.directory.get(oid)
+        desc = st.desc if st is not None else None
+        node_hex, inner, nbytes = self._desc_location(desc,
+                                                      self.node_id.hex())
+        out: Dict[str, Any] = {
+            "object_id": oid.hex(), "status": "ok",
+            "owner_task_id": oid.task_id().hex(),
+            "directory": {"state": desc[0] if desc else "pending",
+                          "node_id": node_hex, "size_bytes": nbytes,
+                          "error": bool(inner) and inner[0] == "err"}}
+        if ring is not None:
+            rec = ring.explain(oid.hex())
+            out["local"] = rec if rec.get("status") == "ok" else None
+        if node_hex and node_hex != self.node_id.hex():
+            # Remote object: its lifecycle lives in the owner's ring; the
+            # synced store view carries that node's top objects, so
+            # surface a match when one exists.
+            view = self.ctl_node_views().get(node_hex) or {}
+            sub = view.get("store") or {}
+            for ent in sub.get("top_objects") or ():
+                if ent.get("object_id") == oid.hex():
+                    out["owner_view"] = ent
+                    break
+        return out
+
+    def ctl_store_events(self, object_id=None, limit=200):
+        """Head store event-ring snapshot (newest-last); feeds the
+        flight-recorder bundle and tests."""
+        ring = getattr(self.node.store, "view", None)
+        if ring is None:
+            return {"events": [], "stats": {}}
+        return {"events": ring.snapshot(object_id, limit),
+                "stats": ring.stats()}
 
     def ctl_list_placement_groups(self):
         return [{"placement_group_id": pg.pg_id.hex(), "state": pg.state,
@@ -2806,12 +2954,87 @@ class Runtime:
             ProfileSpan(name, category, start_s, end_s, pid, tid, extra))
         return True
 
+    _STORE_OP_KINDS = ("create", "seal", "get", "pin", "unpin", "delete")
+    _STORE_SPILL_KEYS = (("spill", "num_spilled"),
+                         ("restore", "num_restored"),
+                         ("evict", "num_evictions"))
+
+    def _store_metrics_state(self):
+        state = getattr(self, "_store_pub", None)
+        if state is None:
+            state = self._store_pub = {"lock": threading.Lock(),
+                                       "last": 0.0, "counts": {}}
+        return state
+
+    def _publish_store_metrics(self, force: bool = False) -> None:
+        """Data-plane half of the telemetry flush: fold per-node object
+        store occupancy into head-registry gauges and turn event-ring /
+        stats tallies into counter deltas.  Piggybacks on the existing
+        metrics flush (no second reporting loop) and is rate-limited so a
+        busy cluster's flush storms don't rescan the views every push.
+        Counter deltas are clamped at zero: a node that restarts resets
+        its tallies, and a negative delta must not decrement a counter."""
+        pub = self._store_metrics_state()
+        now = time.monotonic()
+        with pub["lock"]:
+            if not force and now - pub["last"] < 1.0:
+                return
+            pub["last"] = now
+        try:
+            head = dict(self.node.store.stats())
+            ring = getattr(self.node.store, "view", None)
+            if ring is not None:
+                head["counts"] = dict(ring.counts)
+            per_node = {self.node_id.hex(): head}
+            with self._node_views_lock:
+                views = [(nid.hex(), view) for nid, (_v, view, _ts)
+                         in self._node_views.items()]
+            for nhex, view in views:
+                sub = view.get("store")
+                if isinstance(sub, dict):
+                    per_node[nhex] = sub
+            for nhex, sub in per_node.items():
+                tags = {"node": nhex}
+                telemetry.set_gauge("ray_tpu_store_used_bytes",
+                                    int(sub.get("used_bytes", 0)), tags=tags)
+                telemetry.set_gauge("ray_tpu_store_capacity_bytes",
+                                    int(sub.get("capacity_bytes", 0)),
+                                    tags=tags)
+                telemetry.set_gauge("ray_tpu_store_pinned_bytes",
+                                    int(sub.get("pinned_bytes", 0)),
+                                    tags=tags)
+                telemetry.set_gauge("ray_tpu_store_spilled_bytes",
+                                    int(sub.get("spilled_bytes", 0)),
+                                    tags=tags)
+                telemetry.set_gauge("ray_tpu_store_objects",
+                                    int(sub.get("num_objects", 0)),
+                                    tags=tags)
+                prev = pub["counts"].setdefault(nhex, {})
+                counts = sub.get("counts") or {}
+                for kind in self._STORE_OP_KINDS:
+                    cur = int(counts.get(kind, 0))
+                    delta = cur - prev.get(kind, 0)
+                    if delta > 0:
+                        telemetry.inc("ray_tpu_store_ops_total", delta,
+                                      tags={"op": kind})
+                    prev[kind] = cur
+                for op, key in self._STORE_SPILL_KEYS:
+                    cur = int(sub.get(key, 0))
+                    delta = cur - prev.get("_" + op, 0)
+                    if delta > 0:
+                        telemetry.inc("ray_tpu_store_spill_ops_total",
+                                      delta, tags={"op": op})
+                    prev["_" + op] = cur
+        except Exception as e:  # noqa: BLE001
+            telemetry.note_swallowed("runtime.store_metrics", e)
+
     def ctl_metrics_push(self, source_id: str, snapshot):
         """One batched per-process metrics flush (util/metrics.py flush
         paths).  Stores the latest snapshot for the merged scrape AND
         gives the time-series backplane its ingest tick — piggybacked
         here so history needs no second reporting loop."""
         self.metrics_snapshots[source_id] = snapshot
+        self._publish_store_metrics()
         self.metricsview.on_push()
         return True
 
@@ -2820,6 +3043,9 @@ class Runtime:
 
     def ctl_metrics_query(self, name: str, window_s: float = 60.0,
                           agg: str = "avg", tags=None):
+        # Give the store gauges a flush chance first: a driver-only
+        # session has no worker pushes to piggyback on.
+        self._publish_store_metrics()
         return self.metricsview.query(name, window_s, agg, tags=tags)
 
     def ctl_metrics_history(self, name: str, window_s: float = 300.0,

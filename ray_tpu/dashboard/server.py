@@ -11,7 +11,12 @@ Endpoints (reference: dashboard/modules/*):
                                   event-buffer health (?decisions=N adds
                                   decision-ring records)
     GET /api/tasks/explain?task_id=ID — why pending / why that node
-    GET /api/objects            — object directory
+    GET /api/objects            — object directory (owner node + store
+                                  state attributed per object)
+    GET /api/memory             — per-node object-store occupancy, top
+                                  objects, leak candidates
+    GET /api/objects/explain?object_id=ID — one object's location,
+                                  producer and store lifecycle
     GET /api/placement_groups   — PG table
     GET /api/jobs               — job table
     GET /api/timeline           — chrome-trace events
@@ -45,6 +50,7 @@ async function refresh(){
   const summary = await (await fetch('/api/tasks/summary')).json();
   const telem = await (await fetch('/api/metrics/summary')).json();
   const sched = await (await fetch('/api/sched')).json();
+  const mem = await (await fetch('/api/memory')).json();
   let h = '<h2>cluster</h2><table>';
   for (const [k,v] of Object.entries(c.total_resources))
     h += `<tr><td>${k}</td><td>${c.available_resources[k]??0} / ${v}</td></tr>`;
@@ -67,6 +73,20 @@ async function refresh(){
   h += `<tr><td>task events</td><td>${ss.events.num_events}/${ss.events.capacity} `
     + `(dropped ${ss.events.num_dropped}, fold backlog ${ss.events.fold_backlog})</td></tr>`;
   h += '</table>';
+  // Data-plane telescope: per-node store occupancy + leak candidates.
+  const mb = b => (b / 1048576).toFixed(1) + ' MB';
+  h += '<h2>object store</h2><table>'
+    + '<tr><th>node</th><th>used/capacity</th><th>pinned</th>'
+    + '<th>spilled</th><th>objects</th></tr>';
+  for (const [nid, s] of Object.entries(mem.nodes || {}))
+    h += `<tr><td>${nid.slice(0,12)}</td>`
+      + `<td>${mb(s.used_bytes||0)} / ${mb(s.capacity_bytes||0)}</td>`
+      + `<td>${mb(s.pinned_bytes||0)}</td><td>${mb(s.spilled_bytes||0)}</td>`
+      + `<td>${s.num_objects||0}</td></tr>`;
+  h += '</table>';
+  for (const l of mem.leak_candidates || [])
+    h += `<p>leak candidate: ${l.object_id.slice(0,16)}… `
+      + `${mb(l.nbytes||0)} ${l.reason}</p>`;
   // Built-in system telemetry: serving / training / llm / data metrics.
   h += '<h2>system telemetry</h2>';
   if (telem.goodput)
@@ -164,6 +184,21 @@ class DashboardServer:
 
         async def objects(req):
             return self._json(rt.ctl_list_objects())
+
+        async def memory_summary(req):
+            # Data-plane telescope: per-node occupancy, top objects by
+            # size, leak candidates (`ray-tpu memory` shape).
+            try:
+                top_n = int(req.query.get("top_n", 10))
+            except ValueError:
+                return web.Response(status=400, text="bad top_n")
+            return self._json(rt.ctl_memory_summary(top_n))
+
+        async def object_explain(req):
+            object_id = req.query.get("object_id", "")
+            if not object_id:
+                return web.Response(status=400, text="object_id required")
+            return self._json(rt.ctl_explain_object(object_id))
 
         async def pgs(req):
             return self._json(rt.ctl_list_placement_groups())
@@ -289,6 +324,8 @@ class DashboardServer:
         app.router.add_get("/api/sched", sched)
         app.router.add_get("/api/tasks/explain", task_explain)
         app.router.add_get("/api/objects", objects)
+        app.router.add_get("/api/memory", memory_summary)
+        app.router.add_get("/api/objects/explain", object_explain)
         app.router.add_get("/api/placement_groups", pgs)
         app.router.add_get("/api/jobs", jobs)
         app.router.add_get("/api/timeline", timeline)

@@ -233,6 +233,29 @@ class JobServer:
             return web.json_response(
                 await call(_control, "explain_task", task_id))
 
+        async def cluster_memory(request):
+            """Data-plane telescope (`ray-tpu memory`): per-node object
+            store occupancy, top objects by size, leak candidates."""
+            from ray_tpu._private.api import _control
+            try:
+                top_n = int(request.query.get("top_n", "10"))
+            except ValueError:
+                return web.json_response(
+                    {"error": "bad top_n"}, status=400)
+            return web.json_response(
+                await call(_control, "memory_summary", top_n))
+
+        async def cluster_object_explain(request):
+            """`ray-tpu obj why <id>`: one object's location, producer
+            and store lifecycle (id prefix ok)."""
+            from ray_tpu._private.api import _control
+            object_id = request.query.get("object_id", "")
+            if not object_id:
+                return web.json_response(
+                    {"error": "object_id required"}, status=400)
+            return web.json_response(
+                await call(_control, "explain_object", object_id))
+
         async def timeline(request):
             from ray_tpu._private.api import _control
             return web.json_response(await call(_control, "timeline"))
@@ -333,6 +356,9 @@ class JobServer:
             app.router.add_get("/api/cluster/sched", cluster_sched)
             app.router.add_get("/api/cluster/task_explain",
                                cluster_task_explain)
+            app.router.add_get("/api/cluster/memory", cluster_memory)
+            app.router.add_get("/api/cluster/object_explain",
+                               cluster_object_explain)
             app.router.add_get("/api/cluster/metrics/query",
                                cluster_metrics_query)
             app.router.add_get("/api/cluster/metrics/history",

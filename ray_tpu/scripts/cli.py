@@ -333,6 +333,141 @@ def task_why(address, task_id):
         click.echo(f"error: {out['error_message']}")
 
 
+@cli.command()
+@click.option("--address", default=None)
+@click.option("--top", "top_n", type=int, default=10, show_default=True,
+              help="Top-N objects by size to list.")
+def memory(address, top_n):
+    """Cluster-wide object-store occupancy (data-plane telescope):
+    per-node used/capacity/pinned/spilled bytes, op tallies, the top
+    objects by size attributed to their owner node and producing task,
+    and leak candidates.  `ray-tpu obj why <id>` digs into one object."""
+    from urllib.parse import urlencode
+    client = _client(address)
+    out = client._request(
+        "GET", "/api/cluster/memory?" + urlencode({"top_n": top_n}))
+    t = out["totals"]
+    click.echo(f"total: used {_fmt_bytes(t['used_bytes'])} / "
+               f"{_fmt_bytes(t['capacity_bytes'])}  "
+               f"pinned {_fmt_bytes(t['pinned_bytes'])}  "
+               f"spilled {_fmt_bytes(t['spilled_bytes'])}  "
+               f"objects {t['num_objects']} "
+               f"({t['num_pinned']} pinned, {t['num_spilled']} spilled)")
+    click.echo("nodes:")
+    for nhex, sub in sorted(out["nodes"].items()):
+        kind = "native" if sub.get("native") else "python"
+        click.echo(f"  {nhex[:12]}  "
+                   f"used {_fmt_bytes(sub.get('used_bytes', 0))}"
+                   f"/{_fmt_bytes(sub.get('capacity_bytes', 0))}  "
+                   f"pinned {_fmt_bytes(sub.get('pinned_bytes', 0))}  "
+                   f"spilled {_fmt_bytes(sub.get('spilled_bytes', 0))}  "
+                   f"objects {sub.get('num_objects', 0)}  [{kind}]")
+    ops = {}
+    for sub in out["nodes"].values():
+        for k, v in (sub.get("counts") or {}).items():
+            ops[k] = ops.get(k, 0) + v
+    if ops:
+        click.echo("ops: " + ", ".join(f"{k}={v}"
+                                       for k, v in sorted(ops.items())))
+    if out.get("top_objects"):
+        click.echo("top objects:")
+        for o in out["top_objects"]:
+            extra = ""
+            if o.get("store_state"):
+                extra = f"  state={o['store_state']} pins={o.get('pins', 0)}"
+            # Full object id: paste into `ray-tpu obj why`.
+            click.echo(f"  {o['object_id']}  "
+                       f"{_fmt_bytes(o['size_bytes']):>10}  "
+                       f"node={(o.get('node_id') or '-')[:12]} "
+                       f"task={(o.get('task_id') or '-')[:12]}{extra}")
+    leaks = out.get("leak_candidates") or []
+    if leaks:
+        click.echo("leak candidates:")
+        for rec in leaks:
+            click.echo(f"  {rec['object_id']}  "
+                       f"{_fmt_bytes(rec.get('nbytes', 0)):>10}  "
+                       f"{rec['reason']}  reads={rec.get('reads', 0)} "
+                       f"pins={rec.get('pins', 0)} "
+                       f"node={(rec.get('node_id') or '-')[:12]}")
+
+
+@cli.group()
+def obj():
+    """Object-level introspection (data-plane telescope)."""
+
+
+@obj.command("why")
+@click.option("--address", default=None)
+@click.argument("object_id")
+def obj_why(address, object_id):
+    """Explain OBJECT_ID (hex, prefix ok): where it lives (directory
+    descriptor + owner node), which task produced it, and its store
+    lifecycle — spills/restores, what localizing it cost, pins and who
+    holds them."""
+    from urllib.parse import urlencode
+    client = _client(address)
+    out = client._request(
+        "GET", "/api/cluster/object_explain?" + urlencode(
+            {"object_id": object_id}))
+    status = out.get("status", "unknown")
+    if status == "ambiguous":
+        raise click.ClickException(
+            f"ambiguous object prefix {object_id!r}:\n  "
+            + "\n  ".join(out.get("matches", [])))
+    if status == "unknown":
+        click.echo(f"object {object_id}: unknown")
+        click.echo(f"  {out.get('detail', 'not found')}")
+        raise SystemExit(1)
+    click.echo(f"object {out['object_id']}")
+    if out.get("owner_task_id"):
+        click.echo(f"owner task: {out['owner_task_id']}")
+    d = out.get("directory")
+    if d:
+        size = _fmt_bytes(d["size_bytes"]) \
+            if d.get("size_bytes") is not None else "?"
+        click.echo(f"directory: {d['state']}  "
+                   f"node={(d.get('node_id') or '?')[:12]}  size={size}"
+                   + ("  ERROR-PAYLOAD" if d.get("error") else ""))
+    else:
+        click.echo("directory: gone (deleted, or never escaped its worker)")
+    loc = out.get("local")
+    if loc:
+        click.echo(f"store: state={loc['state']}  "
+                   f"size={_fmt_bytes(loc.get('nbytes') or 0)}  "
+                   f"age={loc.get('age_s', 0):.1f}s  "
+                   f"reads={loc.get('reads', 0)}")
+        if loc.get("pins"):
+            click.echo(f"  pinned {loc['pins']}x by: "
+                       + ", ".join(loc.get("pinners") or ["?"]))
+        if loc.get("spills") or loc.get("restores"):
+            click.echo(f"  spills={loc.get('spills', 0)} "
+                       f"restores={loc.get('restores', 0)}"
+                       + ("  (currently on disk)"
+                          if loc.get("spilled") else ""))
+        if loc.get("pulls"):
+            click.echo(f"  pulls={loc['pulls']} "
+                       f"({_fmt_bytes(loc.get('pull_bytes', 0))}, "
+                       f"avg {loc.get('pull_avg_ms', 0):.2f}ms) "
+                       f"last peer {loc.get('last_peer') or '?'}")
+        if loc.get("pushes"):
+            click.echo(f"  pushes={loc['pushes']} "
+                       f"({_fmt_bytes(loc.get('push_bytes', 0))})")
+        events = loc.get("events") or []
+        if events:
+            click.echo("events:")
+            for ev in events[-12:]:
+                peer = f" peer={ev['peer']}" if ev.get("peer") else ""
+                det = f" [{ev['detail']}]" if ev.get("detail") else ""
+                click.echo(f"  {ev['kind']:>8}  "
+                           f"{_fmt_bytes(ev.get('nbytes') or 0):>10}"
+                           f"{peer}{det}")
+    ov = out.get("owner_view")
+    if ov:
+        click.echo(f"owner node view: state={ov.get('state')} "
+                   f"pins={ov.get('pins', 0)} "
+                   f"size={_fmt_bytes(ov.get('nbytes', 0))}")
+
+
 @cli.group()
 def metrics():
     """Metrics history + windowed queries (ray_tpu.metricsview)."""

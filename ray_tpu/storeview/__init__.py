@@ -1,0 +1,49 @@
+"""ray_tpu.storeview: object-store lifecycle tracing + memory telescope.
+
+The data-plane counterpart of ``schedview``: where the scheduler ring
+answers "why is this task pending", this package answers "where is this
+object, who pins it, why is it spilled, what did localizing it cost".
+
+* ``StoreEventRing`` — bounded, mono-stamped ring of object lifecycle
+  events (create→seal→pin/unpin→push/pull→spill→restore→delete), one per
+  store instance, folded lazily into a per-object latest-state index.
+  Reference analog: Ray reconstructs object state from plasma metadata +
+  the reference counter for ``ray memory``
+  (src/ray/object_manager/pull_manager.h:50); nothing keeps the history.
+* ``explain`` / ``leak_candidates`` / ``top_pinned`` — the point lookups
+  behind ``ray-tpu obj why``, ``ray-tpu memory`` leak detection, and the
+  enriched ``ObjectStoreFullError`` message.
+* ``RAY_TPU_STORE_TRACE=0`` kills recording (same switch idiom as
+  ``RAY_TPU_SCHED_TRACE``); the dataplane bench's off/on overhead reps
+  toggle ``set_enabled``.
+
+Series published from the ring + ``store.stats()`` live in the ``store``
+telemetry subsystem (see README "Data-plane introspection").
+"""
+
+from ray_tpu.storeview.events import (  # noqa: F401
+    EVENT_KINDS,
+    E_CREATE,
+    E_DELETE,
+    E_EVICT,
+    E_GET,
+    E_PIN,
+    E_PULL,
+    E_PUSH,
+    E_RESTORE,
+    E_SEAL,
+    E_SPILL,
+    E_UNPIN,
+    StoreEventRing,
+    enabled,
+    set_enabled,
+)
+
+__all__ = [
+    "StoreEventRing",
+    "enabled",
+    "set_enabled",
+    "EVENT_KINDS",
+    "E_CREATE", "E_SEAL", "E_GET", "E_PIN", "E_UNPIN", "E_PUSH",
+    "E_PULL", "E_SPILL", "E_RESTORE", "E_EVICT", "E_DELETE",
+]

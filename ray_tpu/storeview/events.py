@@ -1,0 +1,387 @@
+"""Bounded object-lifecycle event ring (the data-plane flight recorder).
+
+Reference analog: ``ray memory`` reconstructs object state from the
+reference counter + plasma metadata at query time
+(src/ray/object_manager/pull_manager.h:50, push_manager.h:28); the
+lifecycle *history* — when did this object spill, who pulled it, what
+did localizing it cost — is never kept.  Here every store mutation lands
+in one bounded ring per store instance, folded lazily into a per-object
+latest-state index, so those questions are point lookups.
+
+Hot-path contract (same as ``schedview.DecisionRing``): recording is ONE
+``deque.append`` of a tuple plus an integer bump — no locks, no hex
+encoding, no dict churn.  Folding tuples into per-object state and
+everything stringy happen at read time.  The put/get hot path is gated
+by the dataplane bench's <2% overhead budget, so additions here must
+stay on that contract.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, Iterable, List, Optional
+
+# -- event kinds (closed vocabulary) ----------------------------------------
+#
+# `ray-tpu obj why`, state.explain_object(), the memory summary's leak
+# scan and the dataplane bench's lifecycle assertions all match on these,
+# so additions here must ride a README update.
+E_CREATE = "create"    # buffer allocated (unsealed)
+E_SEAL = "seal"        # object immutable, readable
+E_GET = "get"          # local read (descriptor/buffer handed out)
+E_PIN = "pin"          # reader pin taken (detail = pinner token)
+E_UNPIN = "unpin"      # reader pin released
+E_PUSH = "push"        # served to a remote node (data-server side)
+E_PULL = "pull"        # localized from a remote node (puller side)
+E_SPILL = "spill"      # written to disk under memory pressure
+E_RESTORE = "restore"  # read back from spill file
+E_EVICT = "evict"      # dropped from memory (native arena LRU)
+E_DELETE = "delete"    # removed from the store
+
+EVENT_KINDS = (E_CREATE, E_SEAL, E_GET, E_PIN, E_UNPIN, E_PUSH, E_PULL,
+               E_SPILL, E_RESTORE, E_EVICT, E_DELETE)
+
+#: pinner tokens kept per object in the folded index (display bound).
+MAX_PINNERS = 8
+#: sealed-never-read age after which an object counts as a leak
+#: candidate in the memory summary.
+LEAK_TTL_S = float(os.environ.get("RAY_TPU_STORE_LEAK_TTL_S", "60"))
+#: ring events returned per object by ``explain``.
+EXPLAIN_EVENT_TAIL = 50
+
+# -- global enable switch ---------------------------------------------------
+
+_enabled = os.environ.get("RAY_TPU_STORE_TRACE", "1").strip().lower() \
+    not in ("0", "false", "no", "off")
+
+
+def enabled() -> bool:
+    """Whether store lifecycle tracing is on (module-global: one read on
+    the put/get path)."""
+    return _enabled
+
+
+def set_enabled(value: bool) -> None:
+    """Toggle lifecycle tracing (the dataplane bench's off/on overhead
+    reps; operators use RAY_TPU_STORE_TRACE=0)."""
+    global _enabled
+    _enabled = bool(value)
+
+
+class StoreEventRing:
+    """Bounded, lazily-folded ring of object lifecycle records.
+
+    ``push`` is on the per-op hot path; it appends a raw tuple
+    ``(mono, kind, key, nbytes, peer, detail)`` (``key`` stays raw
+    bytes — hex encoding is fold-time) and bumps a plain int counter.
+    The per-object latest-state index (what ``explain`` and the memory
+    summary read) is built at fold time under the ring lock.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(64, int(capacity))
+        # maxlen bounds the unfolded backlog in O(1) on the hot path; a
+        # threshold-triggered fold here would charge the whole fold
+        # (µs per event) against whichever put/get crossed the line.
+        self._pending: deque = deque(maxlen=self.capacity)
+        self._records: deque = deque()
+        self._latest: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.num_dropped = 0
+        # Plain-int per-kind totals (flushed into the telemetry counters
+        # by the head's rate-limited publisher, never on hot path).
+        self.counts: Dict[str, int] = {}
+
+    # -- hot path -----------------------------------------------------------
+
+    def push(self, kind: str, key: bytes, nbytes: int = 0,
+             peer: Optional[str] = None,
+             detail: Optional[str] = None,
+             _mono=time.monotonic) -> None:
+        # One clock read per event; records carry the monotonic stamp
+        # only, and snapshot() maps mono->wall through a single offset
+        # computed at read time.  Folding happens ONLY at read time: if
+        # no reader drains the ring, the bounded deque discards the
+        # oldest unfolded event instead of paying a fold here.
+        p = self._pending
+        if len(p) == self.capacity:
+            self.num_dropped += 1
+        p.append((_mono(), kind, key, nbytes, peer, detail))
+        c = self.counts
+        try:
+            c[kind] += 1
+        except KeyError:
+            c[kind] = 1
+
+    # -- folding ------------------------------------------------------------
+
+    def _fold(self) -> None:
+        with self._lock:
+            while True:
+                try:
+                    rec = self._pending.popleft()
+                except IndexError:
+                    break
+                self._records.append(rec)
+                if len(self._records) > self.capacity:
+                    self._records.popleft()
+                    self.num_dropped += 1
+                self._apply(rec)
+
+    def _apply(self, rec: tuple) -> None:
+        """Fold one record into the per-object state (under _lock)."""
+        mono, kind, key, nbytes, peer, detail = rec
+        hexkey = key.hex() if isinstance(key, (bytes, bytearray)) \
+            else str(key)
+        st = self._latest.get(hexkey)
+        if st is None:
+            st = {
+                "object_id": hexkey, "state": "created", "nbytes": 0,
+                "created_mono": mono, "sealed_mono": None,
+                "reads": 0, "last_read_mono": None,
+                "pins": 0, "pinners": [],
+                "spills": 0, "restores": 0, "spilled": False,
+                "pulls": 0, "pull_bytes": 0, "pull_seconds": 0.0,
+                "pushes": 0, "push_bytes": 0,
+                "last_peer": None, "last_mono": mono,
+            }
+            self._latest[hexkey] = st
+        st["last_mono"] = mono
+        self._latest.move_to_end(hexkey)
+        if nbytes:
+            st["nbytes"] = nbytes
+        if peer is not None:
+            st["last_peer"] = peer
+        if kind == E_CREATE:
+            st["created_mono"] = mono
+            if st["state"] in ("deleted", "evicted"):
+                st["state"] = "created"
+                st["spilled"] = False
+        elif kind == E_SEAL:
+            st["sealed_mono"] = mono
+            if not st["spilled"]:
+                st["state"] = "sealed"
+        elif kind == E_GET:
+            st["reads"] += 1
+            st["last_read_mono"] = mono
+        elif kind == E_PIN:
+            st["pins"] += 1
+            token = detail or "?"
+            if token not in st["pinners"] and \
+                    len(st["pinners"]) < MAX_PINNERS:
+                st["pinners"].append(token)
+        elif kind == E_UNPIN:
+            st["pins"] = max(0, st["pins"] - 1)
+            token = detail or "?"
+            if st["pins"] == 0:
+                st["pinners"] = []
+            elif token in st["pinners"]:
+                st["pinners"].remove(token)
+        elif kind == E_SPILL:
+            st["spills"] += 1
+            st["spilled"] = True
+            st["state"] = "spilled"
+        elif kind == E_RESTORE:
+            st["restores"] += 1
+            st["spilled"] = False
+            st["state"] = "sealed"
+        elif kind == E_EVICT:
+            st["state"] = "evicted"
+        elif kind == E_PULL:
+            st["pulls"] += 1
+            st["pull_bytes"] += nbytes
+            try:
+                st["pull_seconds"] += float(detail or 0.0)
+            except (TypeError, ValueError):
+                pass
+        elif kind == E_PUSH:
+            st["pushes"] += 1
+            st["push_bytes"] += nbytes
+        elif kind == E_DELETE:
+            st["state"] = "deleted"
+            st["pins"] = 0
+            st["pinners"] = []
+        if len(self._latest) > self.capacity:
+            self._latest.popitem(last=False)
+
+    # -- reads --------------------------------------------------------------
+
+    @staticmethod
+    def _state_dict(st: Dict[str, Any], now_mono: float,
+                    wall_offset: float) -> Dict[str, Any]:
+        """Display form of one folded per-object state: ages instead of
+        raw monotonic stamps."""
+        out = {k: v for k, v in st.items()
+               if not k.endswith("_mono")}
+        out["pinners"] = list(st["pinners"])
+        out["age_s"] = round(now_mono - st["created_mono"], 3)
+        out["time"] = st["last_mono"] + wall_offset
+        if st["sealed_mono"] is not None:
+            out["sealed_age_s"] = round(now_mono - st["sealed_mono"], 3)
+        if st["last_read_mono"] is not None:
+            out["idle_s"] = round(now_mono - st["last_read_mono"], 3)
+        if st["pulls"]:
+            out["pull_avg_ms"] = round(
+                1e3 * st["pull_seconds"] / st["pulls"], 3)
+        return out
+
+    @staticmethod
+    def _to_dict(rec: tuple, wall_offset: float) -> Dict[str, Any]:
+        mono, kind, key, nbytes, peer, detail = rec
+        return {
+            "time": mono + wall_offset, "mono": mono, "kind": kind,
+            "object_id": key.hex() if isinstance(key, (bytes, bytearray))
+            else str(key),
+            "nbytes": nbytes, "peer": peer, "detail": detail,
+        }
+
+    def snapshot(self, object_id: Optional[str] = None,
+                 limit: int = 200) -> List[Dict[str, Any]]:
+        """Newest-last lifecycle records; ``object_id`` filters (hex
+        prefix ok: operators paste truncated ids)."""
+        self._fold()
+        out: List[Dict[str, Any]] = []
+        # Mono->wall basis shift for display, not an interval.
+        wall_offset = time.time() - time.monotonic()  # ray-tpu: noqa[RT203]
+        with self._lock:
+            records = list(self._records)
+        for rec in reversed(records):
+            if object_id is not None:
+                key = rec[2]
+                hexkey = key.hex() if isinstance(key, (bytes, bytearray)) \
+                    else str(key)
+                if not hexkey.startswith(object_id):
+                    continue
+            out.append(self._to_dict(rec, wall_offset))
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
+    def latest_index(self, limit: int = 0) -> List[Dict[str, Any]]:
+        """Folded per-object states, most recently touched first
+        (``limit`` 0 = all tracked)."""
+        self._fold()
+        now_mono = time.monotonic()
+        wall_offset = time.time() - now_mono  # ray-tpu: noqa[RT203]
+        with self._lock:
+            states = [dict(st) for st in reversed(self._latest.values())]
+        if limit:
+            states = states[:limit]
+        return [self._state_dict(st, now_mono, wall_offset)
+                for st in states]
+
+    def explain(self, object_id: str) -> Dict[str, Any]:
+        """Point lookup behind ``ray-tpu obj why`` (hex prefix ok):
+        folded state + the object's recent lifecycle events."""
+        self._fold()
+        prefix = (object_id or "").lower()
+        with self._lock:
+            matches = [k for k in self._latest if k.startswith(prefix)]
+        if not matches:
+            return {"status": "unknown",
+                    "detail": "no lifecycle events recorded for this id "
+                              "(ring bounded, or tracing disabled)"}
+        if len(matches) > 1:
+            return {"status": "ambiguous",
+                    "matches": sorted(matches)[:8]}
+        hexkey = matches[0]
+        now_mono = time.monotonic()
+        wall_offset = time.time() - now_mono  # ray-tpu: noqa[RT203]
+        with self._lock:
+            st = dict(self._latest[hexkey])
+        out = self._state_dict(st, now_mono, wall_offset)
+        out["status"] = "ok"
+        out["events"] = self.snapshot(object_id=hexkey,
+                                      limit=EXPLAIN_EVENT_TAIL)
+        return out
+
+    def pinners_of(self, key: bytes) -> List[str]:
+        """Pinner tokens recorded for one object (exact raw key)."""
+        self._fold()
+        with self._lock:
+            st = self._latest.get(key.hex())
+            return list(st["pinners"]) if st is not None else []
+
+    def top_pinned(self, n: int = 3) -> List[Dict[str, Any]]:
+        """Largest currently-pinned objects with their pinners — the
+        actionable half of an ObjectStoreFullError message."""
+        self._fold()
+        with self._lock:
+            pinned = [dict(st) for st in self._latest.values()
+                      if st["pins"] > 0 and st["state"] not in
+                      ("deleted", "evicted")]
+        pinned.sort(key=lambda st: st["nbytes"], reverse=True)
+        return [{"object_id": st["object_id"], "nbytes": st["nbytes"],
+                 "pins": st["pins"], "pinners": list(st["pinners"])}
+                for st in pinned[:n]]
+
+    @staticmethod
+    def _is_incarnation_token(tok: str) -> bool:
+        """Pinner labels that name a worker/process incarnation (an id
+        hex) can be liveness-checked; descriptive labels ("driver",
+        "ckpt_stage", "?") cannot and never count as dead."""
+        if len(tok) < 16:
+            return False
+        try:
+            int(tok, 16)
+        except ValueError:
+            return False
+        return True
+
+    def leak_candidates(self, ttl_s: Optional[float] = None,
+                        live_tokens: Optional[Iterable[str]] = None
+                        ) -> List[Dict[str, Any]]:
+        """Objects that look leaked: sealed but never read past the TTL,
+        or pinned only by incarnation tokens no longer alive (pass the
+        current worker-id set as ``live_tokens``)."""
+        ttl_s = LEAK_TTL_S if ttl_s is None else ttl_s
+        self._fold()
+        now_mono = time.monotonic()
+        wall_offset = time.time() - now_mono  # ray-tpu: noqa[RT203]
+        live = set(live_tokens) if live_tokens is not None else None
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            states = [dict(st) for st in self._latest.values()]
+        for st in states:
+            if st["state"] in ("deleted", "evicted"):
+                continue
+            anchor = st["sealed_mono"] if st["sealed_mono"] is not None \
+                else st["created_mono"]
+            if st["reads"] == 0 and st["pins"] == 0 and \
+                    st["sealed_mono"] is not None and \
+                    now_mono - anchor > ttl_s:
+                rec = self._state_dict(st, now_mono, wall_offset)
+                rec["reason"] = "sealed_never_read"
+                out.append(rec)
+                continue
+            if st["pins"] > 0 and live is not None and st["pinners"] and \
+                    all(self._is_incarnation_token(tok) and tok not in live
+                        for tok in st["pinners"]):
+                rec = self._state_dict(st, now_mono, wall_offset)
+                rec["reason"] = "pinned_by_dead_incarnation"
+                out.append(rec)
+        out.sort(key=lambda r: r["nbytes"], reverse=True)
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        self._fold()
+        with self._lock:
+            size = len(self._records)
+            tracked = len(self._latest)
+        return {"counts": dict(self.counts),
+                "total": sum(self.counts.values()),
+                "size": size, "tracked": tracked,
+                "capacity": self.capacity,
+                "num_dropped": self.num_dropped}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self._records.clear()
+            self._latest.clear()
+            self.counts = {}
+            self.num_dropped = 0

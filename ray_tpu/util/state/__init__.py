@@ -74,6 +74,32 @@ def explain_task(task_id: str) -> Dict[str, Any]:
     return _control("explain_task", task_id)
 
 
+def memory_summary(top_n: int = 10) -> Dict[str, Any]:
+    """Cluster-wide object-store occupancy (reference: `ray memory`):
+    per-node used/capacity/pinned/spilled bytes with op tallies, the
+    directory's top objects by size attributed to their owner node and
+    producing task, and leak candidates (sealed-never-read past the TTL,
+    pinned by a dead worker incarnation)."""
+    return _control("memory_summary", top_n)
+
+
+def explain_object(object_id: str) -> Dict[str, Any]:
+    """Why does this object look the way it does — where it lives
+    (directory descriptor + owner node), which task produced it, and its
+    store lifecycle from the event ring (spills/restores, pull cost,
+    pins and who holds them).  ``object_id`` may be a prefix
+    (`ray-tpu obj why` rides this)."""
+    return _control("explain_object", object_id)
+
+
+def store_events(object_id: Optional[str] = None,
+                 limit: int = 200) -> Dict[str, Any]:
+    """Head store event-ring snapshot: ``{"events", "stats"}`` with
+    events newest-last (``objects.json`` in flight-recorder bundles is
+    the same snapshot)."""
+    return _control("store_events", object_id, limit)
+
+
 def sched_stats() -> Dict[str, Any]:
     """Live control-plane stats: scheduler queue depths, decision
     totals + trailing decision rates, task-event buffer health."""

@@ -409,6 +409,51 @@ CATALOG: Dict[str, Dict[str, Any]] = {
     "ray_tpu_data_blocks_total": {
         "type": "counter", "tag_keys": ("operator",),
         "description": "Blocks processed by data-pipeline operators."},
+    # -- store (object store + transfer data plane; see storeview/) --------
+    "ray_tpu_store_used_bytes": {
+        "type": "gauge", "tag_keys": ("node",),
+        "description": "Object-store bytes in use per node (arena/shm "
+                       "occupancy; spilled objects excluded)."},
+    "ray_tpu_store_capacity_bytes": {
+        "type": "gauge", "tag_keys": ("node",),
+        "description": "Configured object-store capacity per node."},
+    "ray_tpu_store_pinned_bytes": {
+        "type": "gauge", "tag_keys": ("node",),
+        "description": "Bytes held by reader-pinned objects per node "
+                       "(never evictable/spillable while pinned)."},
+    "ray_tpu_store_spilled_bytes": {
+        "type": "gauge", "tag_keys": ("node",),
+        "description": "Bytes currently spilled to disk per node."},
+    "ray_tpu_store_objects": {
+        "type": "gauge", "tag_keys": ("node",),
+        "description": "Objects tracked by the store per node (in "
+                       "memory + spilled)."},
+    "ray_tpu_store_ops_total": {
+        "type": "counter", "tag_keys": ("op",),
+        "description": "Store operations, from the lifecycle ring's "
+                       "per-kind tallies (op=create|seal|get|pin|unpin|"
+                       "delete), published by the head's metrics-flush "
+                       "piggyback."},
+    "ray_tpu_store_spill_ops_total": {
+        "type": "counter", "tag_keys": ("op",),
+        "description": "Memory-pressure events "
+                       "(op=spill|restore|evict)."},
+    "ray_tpu_store_spill_reclaimed_bytes_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Orphaned spill-file bytes deleted by the "
+                       "boot/shutdown GC sweep (files left by dead "
+                       "store processes)."},
+    "ray_tpu_store_transfer_bytes_total": {
+        "type": "counter", "tag_keys": ("direction",),
+        "description": "Cross-node object payload bytes moved by this "
+                       "process (direction=push|pull: push = served by "
+                       "the local data server, pull = localized from a "
+                       "remote node)."},
+    "ray_tpu_store_transfer_seconds": {
+        "type": "histogram", "tag_keys": ("op",),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Cross-node transfer latency (op=push|pull; pull "
+                       "= resolve + fetch + local put of one object)."},
 }
 
 _instances_lock = threading.Lock()

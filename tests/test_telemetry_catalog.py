@@ -23,7 +23,7 @@ from ray_tpu.util import telemetry
 _NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
 SUBSYSTEMS = ("serve", "llm", "train", "ckpt", "data", "node", "profiler",
               "internal", "autoscaler", "slice", "sched", "metricsview",
-              "alerts")
+              "alerts", "store")
 
 
 class TestCatalog:
@@ -238,6 +238,39 @@ class TestCatalog:
         telemetry.set_gauge("ray_tpu_alerts_firing", 0.0)
         telemetry.inc("ray_tpu_alerts_transitions_total", 0.0,
                       tags={"state": "pending"})
+
+    def test_store_series_registered(self):
+        """The data-plane telescope's series (object-store occupancy
+        gauges, lifecycle/spill op counters, spill-GC reclaimed bytes,
+        cross-node transfer bytes + latency) are declared in the
+        catalog — RT204 lints every call site against it."""
+        specs = {
+            "ray_tpu_store_used_bytes": ("gauge", ("node",)),
+            "ray_tpu_store_capacity_bytes": ("gauge", ("node",)),
+            "ray_tpu_store_pinned_bytes": ("gauge", ("node",)),
+            "ray_tpu_store_spilled_bytes": ("gauge", ("node",)),
+            "ray_tpu_store_objects": ("gauge", ("node",)),
+            "ray_tpu_store_ops_total": ("counter", ("op",)),
+            "ray_tpu_store_spill_ops_total": ("counter", ("op",)),
+            "ray_tpu_store_spill_reclaimed_bytes_total": ("counter", ()),
+            "ray_tpu_store_transfer_bytes_total": ("counter",
+                                                   ("direction",)),
+            "ray_tpu_store_transfer_seconds": ("histogram", ("op",)),
+        }
+        for name, (typ, tags) in specs.items():
+            assert name in telemetry.CATALOG, name
+            assert telemetry.CATALOG[name]["type"] == typ, name
+            assert tuple(telemetry.CATALOG[name]["tag_keys"]) == tags
+            assert telemetry.CATALOG[name]["description"].strip(), name
+            assert name.split("_")[2] == "store", name
+        # The exception-safe helpers record them without raising.
+        telemetry.set_gauge("ray_tpu_store_used_bytes", 0.0,
+                            tags={"node": "smoke"})
+        telemetry.inc("ray_tpu_store_ops_total", 0.0, tags={"op": "get"})
+        telemetry.inc("ray_tpu_store_transfer_bytes_total", 0.0,
+                      tags={"direction": "pull"})
+        telemetry.observe("ray_tpu_store_transfer_seconds", 0.0,
+                          tags={"op": "pull"})
 
     def test_profiler_series_registered(self):
         """The profiler subsystem's series (PR 10: step-phase
