@@ -37,6 +37,14 @@ if _os.environ.get("RAY_TPU_DEBUG_LOCKS") == "1":
     from .devtools import lockdebug as _lockdebug
     _lockdebug.install()
 
+# Lighter opt-in lock-contention profiler (same module): per-site
+# wait/hold histograms only, no order graph — cheap enough for real
+# runs.  A no-op when the full debug mode above is active (its wrappers
+# already collect contention stats).
+if _os.environ.get("RAY_TPU_LOCK_PROFILE") == "1":
+    from .devtools import lockdebug as _lockdebug
+    _lockdebug.install_profile()
+
 # Opt-in runtime resource-leak sanitizer (_private/sanitizer.py):
 # registries for framework threads / pins / tracked files / named
 # actors, snapshotted at cluster start and diffed at shutdown.

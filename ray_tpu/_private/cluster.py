@@ -492,7 +492,10 @@ class DataClient:
         with self._lock:
             lk = self._locks.setdefault(address, threading.Lock())
         with lk:
-            conn = self._conns.get(address)
+            # Safe bare access: the per-address lock serializes all work
+            # on this key, and dict get/setitem are GIL-atomic; _lock
+            # only guards the map shape on shutdown.
+            conn = self._conns.get(address)  # ray-tpu: noqa[RT401]
             for attempt in (0, 1):
                 try:
                     if conn is None:
@@ -775,7 +778,9 @@ class HeadServer:
     # -- membership ----------------------------------------------------------
 
     def _accept_loop(self) -> None:
-        while not self._closed:
+        # Safe bare reads: _closed is a monotonic shutdown latch; the
+        # worst a stale False costs is one extra loop iteration.
+        while not self._closed:  # ray-tpu: noqa[RT401]
             try:
                 conn = self._listener.accept()
             except Exception:
@@ -1329,7 +1334,10 @@ class NodeServer:
         from .node import NodeManager
 
         self._reg_args = (node_resources, int(num_tpus or 0))
-        self.conn.send(RegisterNode(socket.gethostname(), node_resources,
+        # Safe bare access: _pre_register runs single-threaded, before
+        # the serve/poll threads that contend on _send_lock exist.
+        self.conn.send(RegisterNode(socket.gethostname(),  # ray-tpu: noqa[RT401]
+                                    node_resources,
                                     int(num_tpus or 0), ("pending", 0),
                                     os_pid=os.getpid()))
         ack: RegisterAck = self.conn.recv()
@@ -1365,8 +1373,10 @@ class NodeServer:
         self.data_client = DataClient(token)
         self._addr_cache: Dict[bytes, Tuple[str, int]] = {}
         self._rpc_lock = threading.Lock()
-        self._rpc_next = 0
-        self._rpc_waiters: Dict[int, Any] = {}
+        # Safe bare writes: registration-time initialization, before any
+        # thread that uses the rpc lock exists.
+        self._rpc_next = 0  # ray-tpu: noqa[RT401]
+        self._rpc_waiters: Dict[int, Any] = {}  # ray-tpu: noqa[RT401]
         self.puller = ObjectPuller(self.node.store, self.data_client,
                                    self.node_id.binary(),
                                    self._resolve_address)
@@ -1442,7 +1452,10 @@ class NodeServer:
         the local plane (workers, running tasks, actors) alive.  Returns
         False when the head refused (grace expired / head restarted) — the
         caller tears down and rejoins fresh."""
-        if self._up_ring_overflow:
+        # Safe bare read: the head connection is down during rejoin, so
+        # no send_up() writer is running; a stale False only delays the
+        # fresh-rejoin decision one attempt.
+        if self._up_ring_overflow:  # ray-tpu: noqa[RT401]
             # Unacked up-frames were evicted: a same-identity rejoin
             # would silently skip them — rejoin fresh instead.
             return False

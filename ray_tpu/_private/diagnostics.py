@@ -203,6 +203,18 @@ def write_debug_bundle(rt, reason: str,
         return json.dumps(rep, indent=1, default=str)
     section("lock_findings.json", _locks)
 
+    def _lock_contention():
+        # Contention profiler snapshot (RAY_TPU_LOCK_PROFILE=1 or
+        # RAY_TPU_DEBUG_LOCKS=1): per-site wait/hold histograms, so a
+        # slow-control-plane bundle names its hottest lock.  Render
+        # with `ray-tpu lint --lock-report <file>`.
+        from ray_tpu.devtools import lockdebug
+        rep = lockdebug.contention_report()
+        if not rep["installed"] and not rep["sites"]:
+            return None
+        return json.dumps(rep, indent=1, default=str)
+    section("lock_contention.json", _lock_contention)
+
     def _profile():
         # On-demand cluster profile for the incident window (opt-in:
         # the capture blocks for its duration).

@@ -245,7 +245,9 @@ class DirectChannel:
         delay = 0.02
         deadline = time.monotonic() + 120.0
         while True:
-            if self._closed:
+            # Safe bare read: _closed is a monotonic shutdown latch; a
+            # stale False costs one extra resolve round.
+            if self._closed:  # ray-tpu: noqa[RT401]
                 return
             try:
                 res = self.owner.control("resolve_actor_direct",

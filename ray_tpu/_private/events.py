@@ -116,7 +116,10 @@ class TaskEventBuffer:
         # are mono-minus-mono, so an NTP step between two transitions
         # can never mint a negative/garbage latency) and the fold maps
         # mono->wall through a per-batch offset for state_times.
-        self._pending.append((task_id, state, time.monotonic(),
+        # Safe bare access: deque.append is thread-safe by design (the
+        # documented lock-free hot path above); _lock only guards folds.
+        self._pending.append((task_id, state,  # ray-tpu: noqa[RT401]
+                              time.monotonic(),
                               name, task_type, actor_id, node_id, worker_id,
                               error_message))
         if len(self._pending) >= self._fold_at:

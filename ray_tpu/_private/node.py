@@ -179,7 +179,9 @@ class NodeManager:
     # -- worker lifecycle ---------------------------------------------------
 
     def _accept_loop(self) -> None:
-        while not self._closed:
+        # Safe bare reads: _closed is a monotonic shutdown latch; the
+        # worst a stale False costs is one extra loop iteration.
+        while not self._closed:  # ray-tpu: noqa[RT401]
             try:
                 conn = self._listener.accept()
             except Exception:  # noqa: BLE001
@@ -676,6 +678,7 @@ class NodeManager:
         if spec.create_actor_id is not None:
             handle.actor_id = spec.create_actor_id
         if grant:
+            died = False
             with self._lock:
                 if handle.state == DEAD or \
                         handle.worker_id not in self._workers:
@@ -683,10 +686,16 @@ class NodeManager:
                     # death handler saw no assigned chips, so return them
                     # here and fail the task cleanly.
                     self._chip_pool.extend(grant)
-                    self.runtime.on_dispatch_failed(
-                        spec, "worker died before chip assignment")
-                    return
-                handle.assigned_chips[spec.task_id] = grant
+                    died = True
+                else:
+                    handle.assigned_chips[spec.task_id] = grant
+            if died:
+                # Fail OUTSIDE the node lock (RT404): the dispatch-failed
+                # path re-enters scheduler/runtime state and must not
+                # hold this lock across that work.
+                self.runtime.on_dispatch_failed(
+                    spec, "worker died before chip assignment")
+                return
         if env_vars:
             # Never mutate the caller's spec (retries rebuild from it).
             import copy as _copy

@@ -105,7 +105,10 @@ class StateStore:
             if self._fsync:
                 os.fsync(f.fileno())
             self._wal_count += 1
-        if self._wal_count >= self._compact_every and \
+            wal_count = self._wal_count
+        # Compaction trigger reads the snapshot taken under the lock
+        # (RT401): a concurrent append must not tear the threshold read.
+        if wal_count >= self._compact_every and \
                 self.on_compact is not None:
             try:
                 self.on_compact()

@@ -112,7 +112,9 @@ class ObjectState:
                 self._evt.clear()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
-        if self.ready:
+        # Safe bare read: double-checked fast path — ready only flips
+        # under the class lock, and we re-check under it below.
+        if self.ready:  # ray-tpu: noqa[RT401]
             return True
         with ObjectState._lock:
             if self.ready:
@@ -1388,7 +1390,7 @@ class Runtime:
         # Lock-free read first: dict.get is GIL-atomic and entries are
         # never replaced once inserted, so the hot path (one lookup per
         # direct call) skips the lock.
-        st = self._actors.get(actor_id)
+        st = self._actors.get(actor_id)  # ray-tpu: noqa[RT401]
         if st is not None:
             return st
         with self._actors_lock:
@@ -1552,7 +1554,7 @@ class Runtime:
             # (see submit_spec's pre-create note).  The oids are freshly
             # minted — no concurrent creator exists — so GIL-atomic
             # setitem is enough (skips the directory lock).
-            directory = self.directory
+            directory = self.directory  # ray-tpu: noqa[RT401]
             for oid in return_ids:
                 if oid not in directory:
                     directory[oid] = ObjectState()
@@ -1729,7 +1731,9 @@ class Runtime:
             self.events.record(msg.task_id.hex(), FINISHED)
             for oid, desc in msg.results:
                 self.mark_ready(oid, desc)
-            if self._recovering:
+            # Safe bare read: empty-dict fast path; a stale non-empty
+            # view just takes the locked _finish_recovery slow path.
+            if self._recovering:  # ray-tpu: noqa[RT401]
                 self._finish_recovery(msg.task_id)
         if spec is not None and spec.task_id in self._pipelined:
             # Pipelined task: never booked resources — nothing to release
@@ -1767,7 +1771,9 @@ class Runtime:
             # Deps stay retained across the resubmit (releasing first could
             # let GC free a sibling input that nothing would re-produce).
             self.submit_spec(spec)
-        elif self._deps_retained:
+        # Safe bare read: empty-dict fast path; _release_deps re-checks
+        # membership under its own lock.
+        elif self._deps_retained:  # ray-tpu: noqa[RT401]
             self._release_deps(msg.task_id)
 
     def on_dispatch_failed(self, spec: TaskSpec, reason: str,

@@ -375,6 +375,7 @@ class ClusterScheduler:
 
     def notify_object_ready(self, object_id: ObjectID) -> None:
         trace = self.on_stage is not None and _dec.enabled()
+        stamped: List[str] = []
         with self._wake:
             tasks = self._waiting.pop(object_id, [])
             moved = False
@@ -389,9 +390,14 @@ class ClusterScheduler:
                         # dep-free task's queue wait is PLACED-submit
                         # and an extra zero-length stage would tax
                         # every queued submit to record it.
-                        self.on_stage(t.spec.task_id.hex(), STAGE_READY)
+                        stamped.append(t.spec.task_id.hex())
             if moved:
                 self._wake.notify_all()
+        # Stage stamps ride OUTSIDE the condvar (RT404): on_stage fans
+        # into the decision ring / user tracing, and a slow consumer
+        # there must not convoy submitters and the scheduler loop.
+        for tid in stamped:
+            self.on_stage(tid, STAGE_READY)
 
     def release(self, node_id: NodeID, resources: ResourceSet,
                 pg: Optional[PlacementGroupID] = None,
@@ -700,7 +706,10 @@ class ClusterScheduler:
         telemetry is fine here, unlike the per-task path)."""
         created = self._pg_created_mono.pop(pg.pg_id, None)
         if created is not None:
-            telemetry.observe("ray_tpu_sched_pg_commit_seconds",
+            # PG commits are rare (not the per-task hot path), so one
+            # observe under the lock is cheaper than restructuring the
+            # two-phase-commit flow to stamp outside it.
+            telemetry.observe("ray_tpu_sched_pg_commit_seconds",  # ray-tpu: noqa[RT404]
                               max(0.0, time.monotonic() - created))
         if _dec.enabled():
             nodes = {b.node_id.hex()[:12] for b in pg.bundles
@@ -861,17 +870,21 @@ class ClusterScheduler:
                     "pending_pgs": len(self._pending_pgs),
                 }
                 samples, self._attempt_samples = self._attempt_samples, []
+            # _publish_lock exists ONLY to serialize these publishes (it
+            # single-admits publishers; schedulers never block on it) —
+            # publishing under it is the lock's whole purpose, and the
+            # hot scheduler lock was already dropped above.
             for queue, depth in depths.items():
-                telemetry.set_gauge("ray_tpu_sched_queue_depth",
+                telemetry.set_gauge("ray_tpu_sched_queue_depth",  # ray-tpu: noqa[RT404]
                                     float(depth), tags={"queue": queue})
             counts = dict(self.ring.counts)
             for kind, total in counts.items():
                 delta = total - self._published_counts.get(kind, 0)
                 if delta > 0:
-                    telemetry.inc("ray_tpu_sched_decisions_total",
+                    telemetry.inc("ray_tpu_sched_decisions_total",  # ray-tpu: noqa[RT404]
                                   float(delta), tags={"kind": kind})
             self._published_counts = counts
-            telemetry.observe_many("ray_tpu_sched_placement_attempts",
+            telemetry.observe_many("ray_tpu_sched_placement_attempts",  # ray-tpu: noqa[RT404]
                                    [float(a) for a in samples])
         finally:
             self._publish_lock.release()

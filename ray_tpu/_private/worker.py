@@ -332,7 +332,9 @@ class WorkerRuntime:
         self.send(SubmitFromWorker(spec))
 
     def get(self, object_ids: List[ObjectID], timeout: Optional[float] = None):
-        if self._local_objects:
+        # Safe bare read: empty-dict fast path; _split_local takes the
+        # lock before touching individual entries.
+        if self._local_objects:  # ray-tpu: noqa[RT401]
             local = self._split_local(object_ids, timeout)
             if local is not None:
                 return local

@@ -169,7 +169,9 @@ class InstanceStore:
                     rec = json.loads(line)
                 except ValueError:
                     continue  # torn tail
-                inst = self._instances.get(rec["instance_id"])
+                # _replay runs only from __init__, before the store is
+                # published to any other thread — no lock needed.
+                inst = self._instances.get(rec["instance_id"])  # ray-tpu: noqa[RT401]
                 if inst is None:
                     inst = Instance(rec["instance_id"], rec["node_type"])
                     self._instances[inst.instance_id] = inst

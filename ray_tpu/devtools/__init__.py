@@ -14,11 +14,25 @@ and understands ``ray_tpu`` semantics):
   messages with registered handlers.  ``tests/test_lint.py`` keeps the
   tree self-lint-clean (tier-1 gate).
 
-* ``ray_tpu.devtools.lockdebug`` — an opt-in runtime lock-order detector
-  (``RAY_TPU_DEBUG_LOCKS=1``): instrumented ``threading.Lock``/``RLock``
-  wrappers build a per-process acquisition-order graph, flag cycles
-  (AB/BA potential deadlocks) and sleeps under a held lock, and feed the
-  findings into the flight-recorder debug bundle.
+* ``ray_tpu.devtools.lockdebug`` — opt-in runtime lock instrumentation,
+  two modes sharing one wrapper stack.  ``RAY_TPU_DEBUG_LOCKS=1`` is
+  the full lock-order detector: a per-process acquisition-order graph
+  flags cycles (AB/BA potential deadlocks) and sleeps under a held
+  lock.  ``RAY_TPU_LOCK_PROFILE=1`` is the lighter contention
+  profiler: per-creation-site wait/hold histograms only (<2% on
+  scheduler throughput, gated by ``bench.py --spec control_plane``),
+  reported by ``contention_report()``, published to the
+  ``ray_tpu_lock_{wait,hold}_seconds`` catalog series, dumped into
+  flight-recorder bundles as ``lock_contention.json`` and rendered by
+  ``ray-tpu lint --lock-report``.
+
+* ``ray_tpu.devtools.rules_concurrency`` — the RT4xx guarded-by family
+  over the same CFG machinery: per class, infer which attributes are
+  guarded by which locks (``_locked``-contract and private-helper entry
+  assumptions solved to a fixpoint) and flag inconsistent guarding
+  (RT401), check-then-act outside the lock (RT402), release
+  mid-iteration (RT403), callbacks/publishes under hot control-plane
+  locks (RT404) and ``_locked`` methods called bare (RT405).
 
 * ``ray_tpu.devtools.dataflow`` — a per-function CFG builder + an
   acquire/release pairing analysis over it; the RT3xx rule family

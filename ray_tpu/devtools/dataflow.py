@@ -328,9 +328,12 @@ def _node_exprs(node: Node) -> List[ast.AST]:
 
 
 def _iter_calls(root: ast.AST) -> Iterator[ast.Call]:
-    """Calls under an expression/statement, not descending into nested
-    function/class bodies (their execution is deferred; a release inside
-    a callback does not release on this path)."""
+    """Calls under an expression/statement — including ``root`` itself
+    when it IS a call (an ``if f():`` condition) — not descending into
+    nested function/class bodies (their execution is deferred; a
+    release inside a callback does not release on this path)."""
+    if isinstance(root, ast.Call):
+        yield root
     stack: List[ast.AST] = [root]
     while stack:
         node = stack.pop()
@@ -657,3 +660,149 @@ def iter_function_leaks(tree: ast.AST) -> Iterator[Tuple[ast.AST, Leak]]:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for leak in analyze_function(node):
                 yield node, leak
+
+
+# --------------------------------------------------------------------------
+# lock-held-set analysis (RT4xx guarded-by inference)
+# --------------------------------------------------------------------------
+#
+# Which locks are held at each CFG node of one function.  Two sources,
+# unioned:
+#
+# * **Lexical** ``with self._lock:`` ranges.  The builder allocates the
+#   "with" node, then every body node, then the matching "with-exit"
+#   node, so body nodes occupy exactly the index interval between the
+#   pair — including per-path ``finally`` copies instantiated for
+#   returns inside the body.  Exception edges jump *out* of the
+#   interval to handlers built outside it, which matches the runtime:
+#   ``__exit__`` released the lock before the handler ran.
+#
+# * **Flow** for bare ``X.acquire()`` / ``X.release()`` pairs: a
+#   forward must-hold dataflow (meet = intersection over predecessors),
+#   so a lock counts as held at a node only when EVERY path reaching it
+#   acquired and did not release.
+#
+# Lock names are canonical dotted receivers ("self._lock").  ``aliases``
+# maps other receivers onto them — ``self._wake -> self._lock`` for
+# ``self._wake = threading.Condition(self._lock)`` (entering the
+# condition IS entering the lock).
+
+
+class LockAnalysis:
+    """Per-function lock-held-set machinery, built once per method and
+    re-solved cheaply per entry assumption (the per-class fixpoint in
+    rules_concurrency re-runs only the flow part)."""
+
+    def __init__(self, fn: ast.AST, locks: Set[str],
+                 aliases: Optional[Dict[str, str]] = None):
+        self.fn = fn
+        self.locks = frozenset(locks)
+        self.aliases = dict(aliases or {})
+        self.cfg = build_cfg(fn)
+        self._lexical = self._lexical_ranges()
+        self._gen, self._kill = self._gen_kill()
+        self._preds: Dict[int, Set[int]] = {
+            n.idx: set() for n in self.cfg.nodes}
+        for a, dsts in self.cfg.succ.items():
+            for b, _lab in dsts:
+                self._preds[b].add(a)
+
+    # -- lock name resolution ---------------------------------------------
+
+    def resolve(self, expr: ast.AST) -> Optional[str]:
+        """Canonical lock name for an expression, through aliases, or
+        None when the expression is not one of this class's locks."""
+        d = _dotted(expr)
+        if d is None:
+            return None
+        d = self.aliases.get(d, d)
+        return d if d in self.locks else None
+
+    # -- lexical `with` ranges --------------------------------------------
+
+    def _lexical_ranges(self) -> Dict[int, frozenset]:
+        held: Dict[int, Set[str]] = {}
+        opens: Dict[int, Tuple[int, frozenset]] = {}
+        for n in self.cfg.nodes:
+            if n.kind == "with":
+                got = frozenset(
+                    name for item in n.stmt.items
+                    if (name := self.resolve(item.context_expr)))
+                opens[n.idx] = (n.idx, got)
+            elif n.kind == "with-exit":
+                # Match the open with the same stmt (each With statement
+                # produces exactly one with/with-exit pair).
+                for widx, (start, got) in list(opens.items()):
+                    if self.cfg.nodes[widx].stmt is n.stmt:
+                        if got:
+                            for i in range(start + 1, n.idx):
+                                held.setdefault(i, set()).update(got)
+                        del opens[widx]
+                        break
+        return {i: frozenset(s) for i, s in held.items()}
+
+    # -- bare acquire/release gen/kill ------------------------------------
+
+    def _gen_kill(self) -> Tuple[Dict[int, frozenset], Dict[int, frozenset]]:
+        gen: Dict[int, frozenset] = {}
+        kill: Dict[int, frozenset] = {}
+        for n in self.cfg.nodes:
+            g: Set[str] = set()
+            k: Set[str] = set()
+            for call in _node_calls(n):
+                if not isinstance(call.func, ast.Attribute):
+                    continue
+                name = self.resolve(call.func.value)
+                if name is None:
+                    continue
+                if call.func.attr == "acquire":
+                    g.add(name)
+                elif call.func.attr == "release":
+                    k.add(name)
+            if g:
+                gen[n.idx] = frozenset(g)
+            if k:
+                kill[n.idx] = frozenset(k)
+        return gen, kill
+
+    # -- solving -----------------------------------------------------------
+
+    def held_map(self, entry_held: frozenset = frozenset()
+                 ) -> Dict[int, frozenset]:
+        """node idx -> locks held when the node *executes*.  The entry
+        assumption models the caller's locks (``_locked`` contract)."""
+        entry_held = frozenset(entry_held) & self.locks
+        flow = self._solve_flow(entry_held)
+        return {n.idx: flow.get(n.idx, frozenset()) |
+                self._lexical.get(n.idx, frozenset())
+                for n in self.cfg.nodes}
+
+    def _solve_flow(self, entry_held: frozenset) -> Dict[int, frozenset]:
+        if not self._gen and not entry_held:
+            return {}
+        UNIV = self.locks
+        inn: Dict[int, frozenset] = {self.cfg.entry: entry_held}
+        work = [self.cfg.entry]
+        while work:
+            i = work.pop()
+            cur = inn.get(i, UNIV)
+            o = (cur - self._kill.get(i, frozenset())) | \
+                self._gen.get(i, frozenset())
+            for b, _lab in self.cfg.succ[i]:
+                old = inn.get(b)
+                new = o if old is None else (old & o)
+                if old is None or new != old:
+                    inn[b] = new
+                    work.append(b)
+        # Unreachable nodes (no computed IN) report the entry assumption:
+        # dead code should not mint bare-access findings.
+        return {n.idx: inn.get(n.idx, UNIV) for n in self.cfg.nodes}
+
+
+def lock_held_map(fn: ast.AST, locks: Set[str],
+                  aliases: Optional[Dict[str, str]] = None,
+                  entry_held: frozenset = frozenset()
+                  ) -> Tuple[CFG, Dict[int, frozenset]]:
+    """One-shot convenience: (cfg, node idx -> held lock names)."""
+    la = LockAnalysis(fn, locks, aliases)
+    return la.cfg, la.held_map(entry_held)

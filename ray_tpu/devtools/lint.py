@@ -56,6 +56,14 @@ class Finding:
 class LintResult:
     findings: List[Finding]
     files_checked: int
+    #: rule id -> number of findings silenced by ``# ray-tpu: noqa``
+    #: comments.  Reported (not hidden) so the suppression debt stays
+    #: visible in every lint run.
+    suppressed: Dict[str, int] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.suppressed is None:
+            self.suppressed = {}
 
     @property
     def ok(self) -> bool:
@@ -192,7 +200,9 @@ def _suppressed(f: Finding, noqa: Dict[int, Optional[Set[str]]]) -> bool:
 
 def lint_source(source: str, path: str = "<snippet>",
                 internal: bool = False,
-                rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+                rules: Optional[Sequence[Rule]] = None,
+                suppressed_counts: Optional[Dict[str, int]] = None,
+                ) -> List[Finding]:
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
@@ -207,6 +217,9 @@ def lint_source(source: str, path: str = "<snippet>",
         for f in rule.check(ctx):
             if not _suppressed(f, noqa):
                 out.append(f)
+            elif suppressed_counts is not None:
+                suppressed_counts[f.rule] = \
+                    suppressed_counts.get(f.rule, 0) + 1
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 
@@ -229,6 +242,34 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
                     yield os.path.join(root, fname)
 
 
+def changed_python_files(base: str = "HEAD",
+                         repo_root: Optional[str] = None) -> List[str]:
+    """Python files modified per ``git diff <base>`` plus untracked ones
+    — the ``ray-tpu lint --changed`` pre-commit set.  Raises
+    RuntimeError when git fails (not a repo, unknown ref): a broken
+    diff must be loud, never an empty green run."""
+    import subprocess
+    root = os.path.abspath(repo_root or os.getcwd())
+    def _git(*args: str) -> List[str]:
+        proc = subprocess.run(["git", *args], cwd=root,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                proc.stderr.strip() or f"git {' '.join(args)} failed")
+        return proc.stdout.splitlines()
+    top = _git("rev-parse", "--show-toplevel")[0]
+    names = _git("diff", "--name-only", "--diff-filter=d", base, "--")
+    names += _git("ls-files", "--others", "--exclude-standard")
+    out: List[str] = []
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        path = os.path.join(top, name)
+        if os.path.exists(path) and path not in out:
+            out.append(path)
+    return sorted(out)
+
+
 def lint_paths(paths: Sequence[str],
                internal: Optional[bool] = None,
                rules: Optional[Sequence[Rule]] = None) -> LintResult:
@@ -236,6 +277,7 @@ def lint_paths(paths: Sequence[str],
     internal rules apply to files living under a ``ray_tpu`` package
     directory."""
     findings: List[Finding] = []
+    suppressed: Dict[str, int] = {}
     n = 0
     # A missing input is a loud error, never a green no-op: a typo'd CI
     # path must not turn the lint gate into `0 findings in 0 files`.
@@ -255,9 +297,10 @@ def lint_paths(paths: Sequence[str],
         is_internal = _is_internal_path(fpath) if internal is None \
             else internal
         findings.extend(lint_source(source, fpath, internal=is_internal,
-                                    rules=rules))
+                                    rules=rules,
+                                    suppressed_counts=suppressed))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return LintResult(findings, n)
+    return LintResult(findings, n, suppressed)
 
 
 # -- output -----------------------------------------------------------------
@@ -265,17 +308,44 @@ def lint_paths(paths: Sequence[str],
 
 def format_text(result: LintResult) -> str:
     lines = [f.render() for f in result.findings]
-    lines.append(f"{len(result.findings)} finding(s) in "
-                 f"{result.files_checked} file(s)")
+    tail = f"{len(result.findings)} finding(s) in " \
+           f"{result.files_checked} file(s)"
+    if result.suppressed:
+        per = ", ".join(f"{rid}×{n}" for rid, n in
+                        sorted(result.suppressed.items()))
+        tail += f"; {sum(result.suppressed.values())} suppressed ({per})"
+    lines.append(tail)
     return "\n".join(lines)
 
 
 def format_json(result: LintResult) -> str:
+    summaries = {r.id: r.summary for r in _RULES}
     return json.dumps({
         "version": 1,
         "files_checked": result.files_checked,
-        "findings": [f.to_dict() for f in result.findings],
+        "suppressed": dict(sorted(result.suppressed.items())),
+        "findings": [dict(f.to_dict(),
+                          explain=summaries.get(f.rule, ""))
+                     for f in result.findings],
     }, indent=1)
+
+
+def _gh_escape(text: str) -> str:
+    """GitHub workflow-command property/data escaping."""
+    return text.replace("%", "%25").replace("\r", "%0D").replace("\n",
+                                                                 "%0A")
+
+
+def format_github(result: LintResult) -> str:
+    """GitHub annotations (`::error file=...`) — one line per finding,
+    so a CI step surfaces findings inline on the PR diff."""
+    lines = []
+    for f in result.findings:
+        lines.append(
+            f"::error file={_gh_escape(f.path)},line={f.line},"
+            f"col={f.col},title={f.rule}::"
+            f"{_gh_escape(f.rule + ' ' + f.message)}")
+    return "\n".join(lines)
 
 
 def rule_catalog_text() -> str:
@@ -318,4 +388,5 @@ def explain_text(rule_id: str) -> Optional[str]:
 
 # Rule modules self-register on import; they import helpers from this
 # module, so this must stay at the bottom.
-from . import rules_dataflow, rules_internal, rules_user  # noqa: E402,F401
+from . import (rules_concurrency, rules_dataflow, rules_internal,  # noqa: E402,F401
+               rules_user)

@@ -1,26 +1,42 @@
-"""Opt-in runtime lock-order detector (``RAY_TPU_DEBUG_LOCKS=1``).
+"""Opt-in runtime lock instrumentation: order detector + contention
+profiler.
 
-Static analysis (RT201) catches blocking calls lexically inside a
-``with lock:`` block; orderings that only exist at runtime — lock A
-taken in one module, lock B in another, reversed on a third path —
-need instrumentation.  ``install()`` replaces ``threading.Lock`` /
-``threading.RLock`` with wrappers that maintain:
+Two modes share one set of wrappers around ``threading.Lock`` /
+``threading.RLock``:
 
-* a per-thread stack of currently held locks,
-* a process-wide acquisition-order graph (edge ``A -> B``: some thread
-  acquired B while holding A).  A new edge that closes a cycle is a
-  potential deadlock (the classic AB/BA) and is recorded as a finding
-  with both acquisition sites,
-* a patched ``time.sleep`` that records sleeping while holding any
-  instrumented lock (the runtime twin of RT201).
+* ``RAY_TPU_DEBUG_LOCKS=1`` (``install()``) — the heavyweight
+  *order detector*.  Static analysis (RT201) catches blocking calls
+  lexically inside a ``with lock:`` block; orderings that only exist at
+  runtime — lock A taken in one module, lock B in another, reversed on
+  a third path — need instrumentation.  The debug wrappers maintain a
+  per-thread stack of held locks, a process-wide acquisition-order
+  graph (a new edge that closes a cycle is a potential AB/BA deadlock,
+  recorded with both acquisition sites), and a patched ``time.sleep``
+  that records sleeping while holding any instrumented lock.
 
-Findings land in ``report()`` and are picked up by the flight recorder
-(``diagnostics.write_debug_bundle`` writes ``lock_findings.json``), so
-a watchdog-triggered bundle of a wedged run carries the lock story.
+* ``RAY_TPU_LOCK_PROFILE=1`` (``install_profile()``) — the lightweight
+  *contention profiler*.  Every instrumented lock keeps per-creation-
+  site wait-time and hold-time histograms (fixed log buckets), counts
+  of acquires and contended acquires, and max/total times.  Stats are
+  mutated only while the profiled lock itself is held, so the counters
+  need no extra synchronization; the uncontended fast path costs one
+  non-blocking try-acquire plus two clock reads per acquire/release
+  pair.  Roughly every 64th release also publishes a sampled
+  observation to the ``ray_tpu_lock_wait_seconds`` /
+  ``ray_tpu_lock_hold_seconds`` catalog series (post-release, with a
+  thread-local recursion guard so telemetry's own locks cannot
+  re-enter).
 
-The detector is a debugging tool: it is conservative about overhead
-(one dict lookup per acquire; stacks only on *new* edges) but is not
-meant for production hot paths — hence the env-var opt-in.
+The debug wrappers collect the same contention stats, so either mode
+feeds ``contention_report()``.  Only locks created *after* install are
+instrumented (the wrappers replace the constructors, not live locks).
+
+Findings land in ``report()`` / ``contention_report()`` and are picked
+up by the flight recorder (``diagnostics.write_debug_bundle`` writes
+``lock_findings.json`` and ``lock_contention.json``), so a
+watchdog-triggered bundle of a wedged run carries the lock story;
+``ray-tpu lint --lock-report FILE`` renders the contention JSON as a
+table via ``format_contention()``.
 """
 
 from __future__ import annotations
@@ -30,16 +46,31 @@ import sys
 import threading
 import time
 import traceback
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 _real_Lock = threading.Lock
 _real_RLock = threading.RLock
 _real_sleep = time.sleep
+_pc = time.perf_counter
 
 _installed = False
+_prof_installed = False
 
 #: Frames of acquisition stack kept per new edge / finding.
 _STACK_DEPTH = 6
+
+#: Histogram bucket upper bounds (seconds); one overflow bucket rides
+#: at the end.  Decade buckets from 1µs keep the arrays tiny (8 ints).
+_PROF_BOUNDS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0)
+
+#: Publish one sampled (wait, hold) observation to telemetry every
+#: N-th release of a given lock.
+_PUBLISH_EVERY = 64
+
+#: Measured waits above this count as contended when the non-blocking
+#: fast path was skipped (timeout/non-blocking acquires).
+_CONTENDED_S = 1e-5
 
 
 class _State:
@@ -60,6 +91,12 @@ class _State:
 
 _state = _State()
 _tls = threading.local()
+
+# Every instrumented lock (debug or profile) registers here so
+# contention_report() can aggregate per creation site.  WeakSet: dead
+# locks drop out with their stats.
+_reg_mu = _real_Lock()
+_registry: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def _held() -> List[Tuple["_DebugLockBase", int]]:
@@ -178,7 +215,63 @@ def _note_release(lock: "_DebugLockBase") -> None:
             return
 
 
-class _DebugLockBase:
+# -- contention stats -------------------------------------------------------
+
+
+class _Stats:
+    """Per-lock wait/hold accounting.  Mutated only by code that holds
+    the instrumented lock (post-acquire / pre-release), so no extra
+    synchronization; report-time reads are advisory snapshots.
+
+    Cost model (the <2% overhead budget): waits are timed only on the
+    CONTENDED path — the uncontended fast path's failed non-blocking
+    try IS the contention detector and needs no clock, so its zero
+    waits are backfilled into bucket 0 at report time.  Holds are
+    timed on a 1-in-8 acquire sample (``hold_samples`` counts them);
+    totals are scaled back up by the report."""
+
+    __slots__ = ("acquires", "contended", "wait_total", "wait_max",
+                 "hold_total", "hold_max", "hold_samples",
+                 "wait_hist", "hold_hist", "last_wait")
+
+    def __init__(self):
+        self.acquires = 0
+        self.contended = 0
+        self.wait_total = 0.0
+        self.wait_max = 0.0
+        self.hold_total = 0.0
+        self.hold_max = 0.0
+        self.hold_samples = 0
+        self.wait_hist = [0] * (len(_PROF_BOUNDS) + 1)
+        self.hold_hist = [0] * (len(_PROF_BOUNDS) + 1)
+        self.last_wait = 0.0
+
+
+from bisect import bisect_left as _bidx  # noqa: E402 (bucket index)
+
+
+def _maybe_publish(site: str, wait: float, hold: float) -> None:
+    """Sampled telemetry publish, post-release.  The TLS guard stops
+    telemetry's own (possibly instrumented) locks from re-entering."""
+    if getattr(_tls, "publishing", False):
+        return
+    _tls.publishing = True
+    try:
+        from ray_tpu.util import telemetry
+        tags = {"site": site}
+        telemetry.observe("ray_tpu_lock_wait_seconds", wait, tags=tags)
+        telemetry.observe("ray_tpu_lock_hold_seconds", hold, tags=tags)
+    except Exception:
+        pass
+    finally:
+        _tls.publishing = False
+
+
+class _InstrumentedBase:
+    """Shared machinery: creation-site naming, contention stats, and
+    the acquire/release timing protocol.  The profile wrappers use it
+    directly; the debug wrappers layer the order graph on top."""
+
     _kind = "Lock"
 
     def __init__(self):
@@ -186,26 +279,123 @@ class _DebugLockBase:
             _state.seq += 1
             n = _state.seq
         self._inner = self._make_inner()
-        self.name = f"{self._kind}#{n}@{_caller_site(2)}"
+        site = _caller_site(2)
+        self.site = site
+        self.name = f"{self._kind}#{n}@{site}"
+        self._stats = _Stats()
+        self._depth = 0
+        self._t_acq = 0.0
+        with _reg_mu:
+            _registry.add(self)
 
     def _make_inner(self):
         return _real_Lock()
 
-    def acquire(self, *args, **kwargs):
-        got = self._inner.acquire(*args, **kwargs)
-        if got:
-            _note_acquire(self)
+    def acquire(self, blocking=True, timeout=-1):
+        # HOT PATH: an uncontended default acquire does one failed-free
+        # non-blocking try, a couple of attribute ops, and (1 in 8) a
+        # clock read — that's the whole <2% overhead budget.
+        if blocking and timeout == -1:
+            if self._inner.acquire(False):
+                d = self._depth
+                if d:  # reentrant re-acquire (RLock): outermost only
+                    self._depth = d + 1
+                    return True
+                self._depth = 1
+                st = self._stats
+                n = st.acquires + 1
+                st.acquires = n
+                if not n & 7:  # sampled hold timing
+                    self._t_acq = _pc()
+                return True
+            # Contended: the wait itself amortizes the clock reads.
+            t0 = _pc()
+            self._inner.acquire()
+            wait = _pc() - t0
+            got = True
+        else:
+            t0 = _pc()
+            got = self._inner.acquire(blocking, timeout)
+            if not got:
+                return False
+            wait = _pc() - t0
+            d = self._depth
+            if d:
+                self._depth = d + 1
+                return True
+        self._depth = 1
+        st = self._stats
+        st.acquires += 1
+        if wait > _CONTENDED_S:
+            st.contended += 1
+        st.wait_total += wait
+        if wait > st.wait_max:
+            st.wait_max = wait
+        st.wait_hist[_bidx(_PROF_BOUNDS, wait)] += 1
+        st.last_wait = wait
+        self._t_acq = _pc()  # contended holds are always timed
         return got
 
     def release(self):
-        _note_release(self)
+        d = self._depth - 1
+        if d > 0:  # reentrant: lock stays held
+            self._depth = d
+            self._inner.release()
+            return
+        self._depth = 0
+        t = self._t_acq
+        if not t:  # unsampled hold: nothing to finalize
+            self._inner.release()
+            return
+        self._t_acq = 0.0
+        hold = _pc() - t
+        st = self._stats
+        n = st.hold_samples + 1
+        st.hold_samples = n
+        st.hold_total += hold
+        if hold > st.hold_max:
+            st.hold_max = hold
+        st.hold_hist[_bidx(_PROF_BOUNDS, hold)] += 1
         self._inner.release()
+        if not n & 7:  # ~every 64th acquire (1/8 of 1/8-sampled holds)
+            _maybe_publish(self.site, st.last_wait, hold)
 
-    def __enter__(self):
-        self.acquire()
-        return self
+    # Condition support (RLock wrappers): finalize the hold across a
+    # cond.wait() release and measure the re-acquire wait on wakeup.
+    def _prof_release_save(self) -> int:
+        t = self._t_acq
+        if t:
+            self._t_acq = 0.0
+            hold = _pc() - t
+            st = self._stats
+            st.hold_samples += 1
+            st.hold_total += hold
+            if hold > st.hold_max:
+                st.hold_max = hold
+            st.hold_hist[_bidx(_PROF_BOUNDS, hold)] += 1
+        depth = self._depth
+        self._depth = 0
+        return depth
 
-    def __exit__(self, *exc):
+    def _prof_acquire_restore(self, depth: int, wait: float) -> None:
+        st = self._stats
+        st.acquires += 1
+        if wait > _CONTENDED_S:
+            st.contended += 1
+        st.wait_total += wait
+        if wait > st.wait_max:
+            st.wait_max = wait
+        st.wait_hist[_bidx(_PROF_BOUNDS, wait)] += 1
+        st.last_wait = wait
+        self._t_acq = _pc()  # post-wait holds are always timed
+        self._depth = depth
+
+    # `with lock:` is THE hot usage: alias __enter__ straight to
+    # acquire (the context manager protocol ignores the return value)
+    # so the pair costs two Python frames, not four.
+    __enter__ = acquire
+
+    def __exit__(self, t, v, tb):
         self.release()
         return False
 
@@ -217,6 +407,54 @@ class _DebugLockBase:
         return f"<{type(self).__name__} {self.name}>"
 
 
+class _ProfileLock(_InstrumentedBase):
+    """Contention-profiling Lock: stats only, no order graph."""
+
+    _kind = "Lock"
+
+
+class _ProfileRLock(_InstrumentedBase):
+    """Contention-profiling RLock; forwards the protocol Condition uses
+    so ``threading.Condition(rlock)`` keeps exact reentrant semantics."""
+
+    _kind = "RLock"
+
+    def _make_inner(self):
+        return _real_RLock()
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        depth = self._prof_release_save()
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, state):
+        saved, depth = state
+        t0 = _pc()
+        self._inner._acquire_restore(saved)
+        self._prof_acquire_restore(depth, _pc() - t0)
+
+
+# -- debug (order-detector) wrappers ----------------------------------------
+
+
+class _DebugLockBase(_InstrumentedBase):
+    def acquire(self, blocking=True, timeout=-1):
+        got = _InstrumentedBase.acquire(self, blocking, timeout)
+        if got:
+            _note_acquire(self)
+        return got
+
+    # Re-alias: `__enter__ = acquire` binds the function at class-body
+    # time, so each override must rebind or `with lock:` would skip it.
+    __enter__ = acquire
+
+    def release(self):
+        _note_release(self)
+        _InstrumentedBase.release(self)
+
+
 class _DebugLock(_DebugLockBase):
     _kind = "Lock"
 
@@ -225,11 +463,16 @@ class _DebugLock(_DebugLockBase):
     # acquiring thread so a foreign release queues a prune of the
     # owner's held list instead of silently leaving a phantom entry.
 
-    def acquire(self, *args, **kwargs):
-        got = super().acquire(*args, **kwargs)
+    def acquire(self, blocking=True, timeout=-1):
+        # Wrapper delegation, not a lock acquisition of our own:
+        # acquire/release pairing is the CALLER's obligation.
+        got = _DebugLockBase.acquire(  # ray-tpu: noqa[RT301]
+            self, blocking, timeout)
         if got:
             self._owner_ident = threading.get_ident()
         return got
+
+    __enter__ = acquire
 
     def release(self):
         owner = getattr(self, "_owner_ident", None)
@@ -237,10 +480,10 @@ class _DebugLock(_DebugLockBase):
         if owner is not None and owner != threading.get_ident():
             with _state.mu:
                 _state.foreign_released.add((owner, id(self)))
-            self._inner.release()
+            _InstrumentedBase.release(self)
         else:
             _note_release(self)
-            self._inner.release()
+            _InstrumentedBase.release(self)
 
 
 class _DebugRLock(_DebugLockBase):
@@ -257,10 +500,14 @@ class _DebugRLock(_DebugLockBase):
 
     def _release_save(self):
         _note_release(self)
-        return self._inner._release_save()
+        depth = self._prof_release_save()
+        return (self._inner._release_save(), depth)
 
     def _acquire_restore(self, state):
-        self._inner._acquire_restore(state)
+        saved, depth = state
+        t0 = _pc()
+        self._inner._acquire_restore(saved)
+        self._prof_acquire_restore(depth, _pc() - t0)
         _note_acquire(self)
 
 
@@ -288,7 +535,8 @@ def _debug_sleep(seconds):
 
 def install() -> None:
     """Patch ``threading.Lock``/``RLock`` (locks created from now on are
-    instrumented) and ``time.sleep``.  Idempotent."""
+    instrumented) and ``time.sleep``.  Idempotent.  Supersedes the
+    lighter profiler: debug wrappers collect contention stats too."""
     global _installed
     if _installed:
         return
@@ -300,18 +548,54 @@ def install() -> None:
 
 def uninstall() -> None:
     """Restore the real primitives (already-created wrappers keep
-    working: they delegate to real locks)."""
+    working: they delegate to real locks).  Falls back to the profile
+    wrappers when the profiler is still on."""
     global _installed
     if not _installed:
         return
     _installed = False
-    threading.Lock = _real_Lock  # type: ignore[misc]
-    threading.RLock = _real_RLock  # type: ignore[misc]
+    if _prof_installed:
+        threading.Lock = _ProfileLock  # type: ignore[misc]
+        threading.RLock = _ProfileRLock  # type: ignore[misc]
+    else:
+        threading.Lock = _real_Lock  # type: ignore[misc]
+        threading.RLock = _real_RLock  # type: ignore[misc]
     time.sleep = _real_sleep
 
 
 def is_installed() -> bool:
     return _installed
+
+
+def install_profile() -> None:
+    """Patch ``threading.Lock``/``RLock`` with the lightweight
+    contention-profiling wrappers (``RAY_TPU_LOCK_PROFILE=1``).
+    Idempotent; a no-op patch-wise when the heavier debug mode is
+    already active (its wrappers profile too)."""
+    global _prof_installed
+    if _prof_installed:
+        return
+    _prof_installed = True
+    if _installed:
+        return
+    threading.Lock = _ProfileLock  # type: ignore[misc]
+    threading.RLock = _ProfileRLock  # type: ignore[misc]
+
+
+def uninstall_profile() -> None:
+    global _prof_installed
+    if not _prof_installed:
+        return
+    _prof_installed = False
+    if _installed:
+        return  # debug mode still owns the constructors
+    threading.Lock = _real_Lock  # type: ignore[misc]
+    threading.RLock = _real_RLock  # type: ignore[misc]
+
+
+def profile_installed() -> bool:
+    """True when contention stats are being collected (either mode)."""
+    return _prof_installed or _installed
 
 
 def findings() -> List[Dict[str, Any]]:
@@ -326,6 +610,15 @@ def clear() -> None:
         _state.seen_cycles.clear()
         _state.seen_blocking.clear()
         _state.foreign_released.clear()
+    clear_contention()
+
+
+def clear_contention() -> None:
+    """Reset contention stats on every live instrumented lock."""
+    with _reg_mu:
+        locks = list(_registry)
+    for lk in locks:
+        lk._stats = _Stats()
 
 
 def report() -> Dict[str, Any]:
@@ -337,3 +630,105 @@ def report() -> Dict[str, Any]:
             "edges": len(_state.edges),
             "findings": [dict(f) for f in _state.findings],
         }
+
+
+def contention_report(top: int = 20) -> Dict[str, Any]:
+    """Aggregate per-creation-site contention stats across every live
+    instrumented lock, hottest (by total wait) first.  Snapshot for
+    ``lock_contention.json`` and ``ray-tpu lint --lock-report``.
+
+    Waits were only timed on contended acquires: the report backfills
+    the untimed zero-wait fast-path acquires into wait bucket 0, so
+    ``sum(wait_hist) == acquires``.  Holds were timed on a 1-in-8
+    sample (plus all contended holds): ``hold_samples`` is the measured
+    count, ``hold_mean_s`` the unbiased-per-sample mean, and
+    ``hold_total_s`` the ``mean * acquires`` estimate."""
+    with _reg_mu:
+        locks = list(_registry)
+    agg: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for lk in locks:
+        st = lk._stats
+        if not st.acquires:
+            continue
+        row = agg.get((lk.site, lk._kind))
+        if row is None:
+            row = agg[(lk.site, lk._kind)] = {
+                "site": lk.site, "kind": lk._kind, "locks": 0,
+                "acquires": 0, "contended": 0,
+                "wait_total_s": 0.0, "wait_max_s": 0.0,
+                "hold_samples": 0,
+                "_hold_measured_s": 0.0, "hold_max_s": 0.0,
+                "wait_hist": [0] * (len(_PROF_BOUNDS) + 1),
+                "hold_hist": [0] * (len(_PROF_BOUNDS) + 1),
+            }
+        row["locks"] += 1
+        row["acquires"] += st.acquires
+        row["contended"] += st.contended
+        row["wait_total_s"] += st.wait_total
+        row["wait_max_s"] = max(row["wait_max_s"], st.wait_max)
+        row["hold_samples"] += st.hold_samples
+        row["_hold_measured_s"] += st.hold_total
+        row["hold_max_s"] = max(row["hold_max_s"], st.hold_max)
+        for i, v in enumerate(st.wait_hist):
+            row["wait_hist"][i] += v
+        for i, v in enumerate(st.hold_hist):
+            row["hold_hist"][i] += v
+    rows = sorted(agg.values(),
+                  key=lambda r: (-r["wait_total_s"], -r["acquires"]))
+    for r in rows:
+        r["wait_hist"][0] += r["acquires"] - sum(r["wait_hist"])
+        r["wait_mean_s"] = r["wait_total_s"] / r["acquires"]
+        measured = r.pop("_hold_measured_s")
+        samples = r["hold_samples"]
+        r["hold_mean_s"] = measured / samples if samples else 0.0
+        r["hold_total_s"] = r["hold_mean_s"] * r["acquires"]
+    return {
+        "installed": profile_installed(),
+        "pid": os.getpid(),
+        "bucket_bounds_s": list(_PROF_BOUNDS),
+        "total_sites": len(rows),
+        "truncated": max(0, len(rows) - top),
+        "sites": rows[:top],
+    }
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.2f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    if v >= 1e-6:
+        return f"{v * 1e6:.1f}us"
+    return "0" if v <= 0 else f"{v * 1e9:.0f}ns"
+
+
+def format_contention(doc: Dict[str, Any]) -> str:
+    """Render a ``contention_report()`` document (e.g. a bundle's
+    ``lock_contention.json``) as a top-contended-locks table."""
+    sites = doc.get("sites") or []
+    if not sites:
+        return ("no lock contention data "
+                "(profiler not installed, or no lock was acquired)")
+    lines = [f"lock contention: {doc.get('total_sites', len(sites))} "
+             f"site(s), pid {doc.get('pid', '?')} "
+             f"(sorted by total wait)",
+             f"{'site':<36} {'kind':<5} {'locks':>5} {'acquires':>9} "
+             f"{'cont%':>6} {'wait total':>10} {'wait mean':>9} "
+             f"{'wait max':>9} {'hold total':>10} {'hold mean':>9} "
+             f"{'hold max':>9}"]
+    for r in sites:
+        acq = r.get("acquires") or 1
+        cont = 100.0 * r.get("contended", 0) / acq
+        lines.append(
+            f"{r.get('site', '?')[-36:]:<36} {r.get('kind', '?'):<5} "
+            f"{r.get('locks', 0):>5} {r.get('acquires', 0):>9} "
+            f"{cont:>5.1f}% "
+            f"{_fmt_s(r.get('wait_total_s', 0.0)):>10} "
+            f"{_fmt_s(r.get('wait_mean_s', 0.0)):>9} "
+            f"{_fmt_s(r.get('wait_max_s', 0.0)):>9} "
+            f"{_fmt_s(r.get('hold_total_s', 0.0)):>10} "
+            f"{_fmt_s(r.get('hold_mean_s', 0.0)):>9} "
+            f"{_fmt_s(r.get('hold_max_s', 0.0)):>9}")
+    if doc.get("truncated"):
+        lines.append(f"... {doc['truncated']} more site(s) truncated")
+    return "\n".join(lines)

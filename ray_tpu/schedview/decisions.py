@@ -123,10 +123,13 @@ class DecisionRing:
         # One clock read per decision: records carry the monotonic stamp
         # only, and snapshot() maps mono->wall through a single offset
         # computed at read time.
-        self._pending.append((time.monotonic(), kind,
+        # Documented lock-free hot path: deque.append is thread-safe and
+        # _fold() drains under the lock; counts is written only by the
+        # scheduler loop (single writer) and read advisorily.
+        self._pending.append((time.monotonic(), kind,  # ray-tpu: noqa[RT401]
                               task_id_hex, name, class_key, candidates,
                               rejected, node_hex, attempt))
-        self.counts[kind] = self.counts.get(kind, 0) + 1
+        self.counts[kind] = self.counts.get(kind, 0) + 1  # ray-tpu: noqa[RT401]
         if len(self._pending) >= self._fold_at:
             self._fold()
 
@@ -209,10 +212,11 @@ class DecisionRing:
         self._fold()
         with self._lock:
             size = len(self._records)
+        # Advisory snapshot: slightly-stale counters are fine for stats.
         return {"counts": dict(self.counts),
                 "total": sum(self.counts.values()),
                 "size": size, "capacity": self.capacity,
-                "num_dropped": self.num_dropped}
+                "num_dropped": self.num_dropped}  # ray-tpu: noqa[RT401]
 
     def clear(self) -> None:
         with self._lock:

@@ -732,7 +732,8 @@ def stack(address, timeout, output):
 
 @cli.command()
 @click.argument("paths", nargs=-1)
-@click.option("--format", "fmt", type=click.Choice(["text", "json"]),
+@click.option("--format", "fmt",
+              type=click.Choice(["text", "json", "github"]),
               default="text", show_default=True)
 @click.option("--list-rules", is_flag=True,
               help="Print the rule catalog and exit.")
@@ -743,16 +744,27 @@ def stack(address, timeout, output):
               help="Force framework-internal rules on/off (default: "
                    "auto-detect per file — on for files inside a "
                    "ray_tpu package tree).")
-def lint(paths, fmt, list_rules, explain_rule, internal):
+@click.option("--changed", is_flag=True,
+              help="Lint only files modified per git diff (plus "
+                   "untracked .py files) — the fast pre-commit run.")
+@click.option("--base", default="HEAD", show_default=True,
+              metavar="REF", help="Diff base ref for --changed.")
+@click.option("--lock-report", "lock_report", metavar="FILE",
+              default=None,
+              help="Print the top-contended-locks table from a "
+                   "lock_contention.json (flight-recorder bundle or "
+                   "RAY_TPU_LOCK_PROFILE=1 dump), then exit.")
+def lint(paths, fmt, list_rules, explain_rule, internal, changed, base,
+         lock_report):
     """Framework-aware static analysis (see README "Static analysis").
 
     Checks user code for ray_tpu anti-patterns (blocking get() inside
     @remote, get()-in-a-loop, bad captures, actor self-calls) and — on
     the framework's own tree — internal invariants (no blocking under a
     lock, no swallowed control-plane exceptions, monotonic durations,
-    telemetry catalog names, protocol handler completeness).  Exits
-    non-zero when findings remain; suppress a line with
-    `# ray-tpu: noqa[RULE]`.
+    telemetry catalog names, protocol handler completeness, and the
+    RT4xx guarded-by/lock-discipline family).  Exits non-zero when
+    findings remain; suppress a line with `# ray-tpu: noqa[RULE]`.
     """
     from ray_tpu.devtools import lint as lint_mod
     if list_rules:
@@ -766,11 +778,41 @@ def lint(paths, fmt, list_rules, explain_rule, internal):
             raise SystemExit(1)
         click.echo(text)
         return
-    if not paths:
+    if lock_report is not None:
+        from ray_tpu.devtools import lockdebug
+        try:
+            with open(lock_report, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            click.echo(f"cannot read lock report {lock_report!r}: {e}")
+            raise SystemExit(2)
+        click.echo(lockdebug.format_contention(doc))
+        return
+    if changed:
+        try:
+            files = lint_mod.changed_python_files(base=base)
+        except RuntimeError as e:
+            click.echo(f"--changed: {e}")
+            raise SystemExit(2)
+        if paths:
+            roots = [os.path.abspath(p) for p in paths]
+            files = [f for f in files
+                     if any(f == r or f.startswith(r + os.sep)
+                            for r in roots)]
+        if not files:
+            click.echo("0 finding(s) in 0 file(s) (no changed .py "
+                       "files)")
+            return
+        paths = tuple(files)
+    elif not paths:
         paths = (".",)
     result = lint_mod.lint_paths(list(paths), internal=internal)
     if fmt == "json":
         click.echo(lint_mod.format_json(result))
+    elif fmt == "github":
+        out = lint_mod.format_github(result)
+        if out:
+            click.echo(out)
     else:
         click.echo(lint_mod.format_text(result))
     if result.findings:

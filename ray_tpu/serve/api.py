@@ -205,7 +205,9 @@ class _DeploymentState:
 
     def add_replica(self, wait_ready: bool = False):
         import ray_tpu
-        if self.stopped:
+        # Safe bare read: stopped is a monotonic shutdown latch; a stale
+        # False only delays the error to the actor-create round trip.
+        if self.stopped:  # ray-tpu: noqa[RT401]
             raise RuntimeError("deployment is stopped")
         cls_blob, opts = self._replica_opts()
         actor_cls = ray_tpu.remote(_ReplicaActor)

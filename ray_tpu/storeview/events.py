@@ -106,11 +106,14 @@ class StoreEventRing:
         # computed at read time.  Folding happens ONLY at read time: if
         # no reader drains the ring, the bounded deque discards the
         # oldest unfolded event instead of paying a fold here.
-        p = self._pending
+        # Documented lock-free hot path (see class docstring): deque ops
+        # are thread-safe, num_dropped/counts are advisory single-writer
+        # counters, and _fold() drains under the lock at read time.
+        p = self._pending  # ray-tpu: noqa[RT401]
         if len(p) == self.capacity:
-            self.num_dropped += 1
+            self.num_dropped += 1  # ray-tpu: noqa[RT401]
         p.append((_mono(), kind, key, nbytes, peer, detail))
-        c = self.counts
+        c = self.counts  # ray-tpu: noqa[RT401]
         try:
             c[kind] += 1
         except KeyError:

@@ -166,14 +166,18 @@ class TrainWatchdog:
             if st.hung:
                 st.hung = False
                 recovered = True
+            # Counter snapshot under the lock (RT401): _check_straggler
+            # and _poll_loop bump these under it concurrently.
+            straggler_total = self.straggler_count
+            hang_total = self.hang_count
         if recovered:
             # Refresh the KV verdict too: `ray-tpu status` must stop
             # saying "hang" once the rank is demonstrably reporting.
             self.last_verdict = {
                 "status": "recovered", "run_id": self.run_id,
                 "rank": rank, "pid": pid, "time": time.time(),
-                "straggler_total": self.straggler_count,
-                "hang_total": self.hang_count}
+                "straggler_total": straggler_total,
+                "hang_total": hang_total}
             self._export("recovered", rank, {"detail": "report resumed"})
             self._publish_verdict()
         self._check_straggler(rank)
@@ -263,11 +267,15 @@ class TrainWatchdog:
         telemetry.inc(f"ray_tpu_train_{kind}_total")
         with self._lock:
             pid = self._ranks.get(rank).pid if rank in self._ranks else None
+            # Counter snapshot under the lock (RT401): the poll loop
+            # bumps these under it concurrently.
+            straggler_total = self.straggler_count
+            hang_total = self.hang_count
         self.last_verdict = {
             "status": kind, "run_id": self.run_id, "rank": rank,
             "pid": pid, "time": time.time(), "detail": detail,
-            "straggler_total": self.straggler_count,
-            "hang_total": self.hang_count}
+            "straggler_total": straggler_total,
+            "hang_total": hang_total}
         self._export(kind, rank, dict(detail, pid=pid))
         self._publish_verdict()
         if self.config.write_bundle:

@@ -375,6 +375,22 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "description": "Control-plane exceptions intentionally swallowed "
                        "(best-effort paths), by call site.  A climbing "
                        "series names the subsystem eating errors."},
+    "ray_tpu_lock_wait_seconds": {
+        "type": "histogram", "tag_keys": ("site",),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Sampled lock-acquire wait by creation site "
+                       "(~1/64th of releases), from the opt-in "
+                       "contention profiler (RAY_TPU_LOCK_PROFILE=1 / "
+                       "RAY_TPU_DEBUG_LOCKS=1).  A fat tail names a "
+                       "lock threads queue on."},
+    "ray_tpu_lock_hold_seconds": {
+        "type": "histogram", "tag_keys": ("site",),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Sampled lock hold time by creation site "
+                       "(~1/64th of releases), from the opt-in "
+                       "contention profiler.  Long holds on a "
+                       "contended site are the thing to shrink first "
+                       "(see ray-tpu lint --lock-report)."},
     # -- metricsview (time-series backplane) -------------------------------
     "ray_tpu_metricsview_points_total": {
         "type": "counter", "tag_keys": (),
@@ -765,10 +781,12 @@ class GoodputTracker:
         """Move ``seconds`` of already-elapsed current-phase time into
         ``phase`` (clamped to what the current phase has actually
         accrued, including the open interval)."""
-        if seconds <= 0 or phase == self._phase:
+        if seconds <= 0:
             return
         with self._lock:
-            if self._finished:
+            # Same-phase check under the lock: a concurrent enter() can
+            # swap _phase between a bare check and the accounting below.
+            if self._finished or phase == self._phase:
                 return
             self._accumulate_locked(time.monotonic())
             avail = self.seconds.get(self._phase, 0.0)

@@ -389,6 +389,13 @@ class TestControlPlaneBenchGate:
         assert doc["sla"]["every_pending_explained"]
         assert doc["sla"]["overhead_within_budget"]
         assert doc["overhead"]["overhead_pct"] < 2.0
+        assert doc["sla"]["scheduler_lock_profiled"]
+        assert doc["sla"]["lock_profile_within_budget"]
+        assert doc["lock_profile_overhead"]["overhead_pct"] < 2.0
+        cont = doc["contention"]
+        assert cont["hottest_scheduler_site"], cont
+        hot = cont["scheduler_sites"][0]
+        assert hot["acquires"] > 0 and hot["wait_total_s"] >= 0.0
         assert "1000" in doc["scales"]
         s1k = doc["scales"]["1000"]
         assert s1k["decisions_per_s"] > 0
@@ -445,13 +452,15 @@ class TestControlPlaneBenchSmoke:
 
         doc = run_once()
         sla = doc["sla"]
-        if not sla["pass"] and not sla["overhead_within_budget"] and all(
+        noisy = ("overhead_within_budget", "lock_profile_within_budget")
+        if not sla["pass"] and all(
                 v for k, v in sla.items()
                 if isinstance(v, bool)
-                and k not in ("pass", "overhead_within_budget")):
-            # The overhead gate is the one criterion with residual
-            # measurement noise on a one-core CI box (~1.6% true cost
-            # vs a 2% budget); everything else is deterministic.  One
+                and k != "pass" and k not in noisy):
+            # The two overhead gates are the criteria with residual
+            # measurement noise on a one-core CI box (true costs well
+            # under the 2% budgets, but block-to-block floors swing a
+            # few percent); everything else is deterministic.  One
             # retry bounds the flake rate without weakening the strict
             # gate on the checked-in FULL baseline above.
             doc = run_once()

@@ -536,3 +536,104 @@ class TestCliSurface:
             assert "Bad:" in text and "Good:" in text, \
                 f"{rule.id} needs a bad/good example pair"
             assert f"noqa[{rule.id}]" in text
+
+
+# -- LockAnalysis (lock-held-set dataflow) ----------------------------------
+
+
+def held_for(src, line, locks=("self._lock",), aliases=None,
+             entry=frozenset()):
+    """Union of lock-held sets over the CFG nodes anchored at `line`."""
+    from ray_tpu.devtools.dataflow import LockAnalysis
+    la = LockAnalysis(fn_of(src), set(locks), dict(aliases or {}))
+    hm = la.held_map(entry)
+    out = set()
+    for n in la.cfg.nodes:
+        if n.stmt is not None and getattr(n.stmt, "lineno", None) == line:
+            out |= hm[n.idx]
+    return out
+
+
+class TestLockAnalysis:
+    def test_nested_with_holds_both(self):
+        src = """
+def m(self):
+    with self._a:
+        with self._b:
+            x = 1
+        y = 2
+    z = 3
+"""
+        locks = ("self._a", "self._b")
+        assert held_for(src, 5, locks) == {"self._a", "self._b"}
+        assert held_for(src, 6, locks) == {"self._a"}
+        assert held_for(src, 7, locks) == set()
+
+    def test_explicit_acquire_release(self):
+        src = """
+def m(self):
+    self._lock.acquire()
+    x = 1
+    self._lock.release()
+    y = 2
+"""
+        assert held_for(src, 4) == {"self._lock"}
+        assert held_for(src, 6) == set()
+
+    def test_finally_release_covers_early_return(self):
+        # The classic acquire/try/finally-release shape: held inside
+        # the try on both the early-return and fall-through paths, and
+        # released by the finally before anything after it runs.
+        src = """
+def m(self, cond):
+    self._lock.acquire()
+    try:
+        if cond:
+            return 1
+        x = 2
+    finally:
+        self._lock.release()
+    y = 3
+"""
+        assert held_for(src, 7) == {"self._lock"}
+        assert held_for(src, 10) == set()
+
+    def test_branch_acquire_meets_to_not_held(self):
+        # Held only on one inbound path => not held at the join (the
+        # meet is intersection: "held" must be certain, not possible).
+        src = """
+def m(self, c):
+    if c:
+        self._lock.acquire()
+    x = 1
+"""
+        assert held_for(src, 5) == set()
+
+    def test_entry_assumption_models_locked_contract(self):
+        src = """
+def _flush_locked(self):
+    x = 1
+"""
+        assert held_for(src, 3) == set()
+        assert held_for(src, 3, entry=frozenset({"self._lock"})) == \
+            {"self._lock"}
+
+    def test_condition_alias_resolves_to_its_lock(self):
+        src = """
+def m(self):
+    with self._wake:
+        x = 1
+"""
+        held = held_for(src, 4, aliases={"self._wake": "self._lock"})
+        assert held == {"self._lock"}
+
+    def test_resolve_through_alias(self):
+        import ast as _ast
+        from ray_tpu.devtools.dataflow import LockAnalysis
+        la = LockAnalysis(fn_of("def m(self):\n    pass\n"),
+                          {"self._lock"},
+                          {"self._wake": "self._lock"})
+        wake = _ast.parse("self._wake", mode="eval").body
+        other = _ast.parse("self._other", mode="eval").body
+        assert la.resolve(wake) == "self._lock"
+        assert la.resolve(other) is None

@@ -8,6 +8,7 @@ controller implements.
 
 import json
 import re
+import threading
 import time
 
 import jax
@@ -23,7 +24,7 @@ from ray_tpu.util import telemetry
 _NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
 SUBSYSTEMS = ("serve", "llm", "train", "ckpt", "data", "node", "profiler",
               "internal", "autoscaler", "slice", "sched", "metricsview",
-              "alerts", "store")
+              "alerts", "store", "lock")
 
 
 class TestCatalog:
@@ -116,6 +117,21 @@ class TestCatalog:
         telemetry.set_gauge("ray_tpu_node_draining", 0.0)
         telemetry.inc("ray_tpu_train_urgent_ckpt_total", 0.0)
         telemetry.observe("ray_tpu_train_restart_backoff_seconds", 0.0)
+
+    def test_lock_contention_series_registered(self):
+        """The lock-contention profiler's sampled wait/hold series are
+        declared in the catalog — RT204 lints lockdebug's publish path
+        against it."""
+        for name in ("ray_tpu_lock_wait_seconds",
+                     "ray_tpu_lock_hold_seconds"):
+            assert name in telemetry.CATALOG, name
+            assert telemetry.CATALOG[name]["type"] == "histogram", name
+            assert tuple(telemetry.CATALOG[name]["tag_keys"]) == ("site",)
+            assert telemetry.CATALOG[name]["description"].strip(), name
+        telemetry.observe("ray_tpu_lock_wait_seconds", 0.0,
+                          tags={"site": "test.py:1"})
+        telemetry.observe("ray_tpu_lock_hold_seconds", 0.0,
+                          tags={"site": "test.py:1"})
 
     def test_disagg_admission_series_registered(self):
         """The disaggregated-serving / admission-control series (PR 6)
@@ -383,6 +399,21 @@ class TestSmokeAllSubsystems:
         tracked = profiler.track(jax.jit(lambda x: x + 1),
                                  name="telemetry_smoke_inc")
         tracked(jnp.ones((4,), jnp.float32))
+
+        # -- lock: the contention profiler publishes on a double 1/8
+        # sample (hold timing every 8th acquire, telemetry every 8th
+        # sampled hold), so 64 acquire/release pairs on a lock created
+        # under install_profile() deterministically lands one
+        # observation on each ray_tpu_lock_* series.
+        from ray_tpu.devtools import lockdebug
+        lockdebug.install_profile()
+        try:
+            lk = threading.Lock()
+            for _ in range(64):
+                with lk:
+                    pass
+        finally:
+            lockdebug.uninstall_profile()
 
         # -- data: a small pipeline through the streaming executor --------
         import ray_tpu.data as rdata
