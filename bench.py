@@ -2025,7 +2025,8 @@ def _copy_baseline_aside(path: str) -> str:
     return dst
 
 
-def bench_serve_load(fast: bool = False) -> None:
+def bench_serve_load(fast: bool = False,
+                     out_path: Optional[str] = None) -> dict:
     """Open-loop Poisson serving bench -> BENCH_serve_load.json.
 
     Three equal-load phases through the disagg plane — inline prefill
@@ -2038,6 +2039,15 @@ def bench_serve_load(fast: bool = False) -> None:
     improves >= 2x over inline at equal load; (b) past saturation the
     router sheds (rejection rate rises) while p99 TTFT of ADMITTED
     requests stays bounded.
+
+    Fleet phases (ISSUE 19): (c) under prefix-heavy saturating load a
+    2-replica fleet sustains >= 1.7x the single-replica throughput at
+    bounded ITL p99 — on one core the win is aggregate prefix-cache
+    capacity, not FLOPs (the prompt pool overflows one replica's cache
+    but partitions across two under affinity routing); (d) cache-hit
+    TTFT p50 <= 0.5x cold at unsaturated load; (e) the autoscaler adds
+    a replica under a sustained queue burn and drains it back away once
+    idle, with zero unfinished requests.
     """
     import jax
     import jax.numpy as jnp
@@ -2066,19 +2076,20 @@ def bench_serve_load(fast: bool = False) -> None:
         # this — the reference-attention prefill is O(S^2) per layer,
         # while max_seq_len stays tight so the decode step itself (which
         # gathers the whole block table on the exact CPU path) doesn't
-        # drown the prefill-stall signal.  Calibrated on this host:
-        # decode step ~9 ms (4 slots), monolithic 440-token prefill
-        # ~59 ms, one 48-token chunk ~21 ms.
+        # drown the prefill-stall signal.  Re-calibrated on this host
+        # (PR 19): the 440-token prefill fell to ~25 ms here, inside the
+        # decode-contention noise floor, so the long prompt grew to 960
+        # tokens (~120 ms monolithic prefill vs ~10-20 ms decode steps).
         cfg = LlamaConfig(vocab_size=512, hidden=128, layers=4, heads=8,
                           kv_heads=4, head_dim=32, mlp_dim=512,
-                          max_seq_len=512, dtype=jnp.float32,
+                          max_seq_len=1024, dtype=jnp.float32,
                           remat=False, attention_impl="reference")
-        eo = {"max_slots": 4, "page_size": 16, "num_pages": 320,
-              "prefill_buckets": (32, 448)}
+        eo = {"max_slots": 4, "page_size": 16, "num_pages": 640,
+              "prefill_buckets": (32, 960)}
         chunk = 48
         spec = ServeLoadSpec(rps=5.0, duration_s=12.0, long_fraction=0.25,
                              short_prompt=16, short_max_tokens=32,
-                             long_prompt=440, long_max_tokens=16)
+                             long_prompt=960, long_max_tokens=16)
         sat_rps = 40.0
     params = init_params(cfg, jax.random.key(0))
 
@@ -2144,6 +2155,191 @@ def bench_serve_load(fast: bool = False) -> None:
           f"{doc['saturation']['shed_rate']:.2f} ttft_p99(admitted)="
           f"{doc['saturation']['ttft_p99_ms']:.1f}ms", file=sys.stderr)
 
+    # ---- Fleet: multi-replica decode + prefix-affinity routing ---------
+    # Prefix-heavy traffic (a fixed prompt pool) on a fixed compute
+    # budget: extra replicas add no FLOPs on this host, so honest 1->2
+    # throughput scaling must come from AGGREGATE prefix-cache capacity.
+    # Each replica's cache holds half the pool — one replica churns its
+    # LRU and keeps re-prefilling, while two replicas partition the pool
+    # under affinity routing and full hits replay the retained handoff,
+    # skipping the prefill tier entirely.
+    from ray_tpu.llm.disagg import PrefillWorker
+    from ray_tpu.llm.engine import SamplingParams
+    from ray_tpu.llm.fleet import (FleetConfig, FleetServer,
+                                   ServeScaleConfig)
+
+    if fast:
+        pool, f_rps, f_dur, light_rps = 6, 40.0, 2.0, 15.0
+        f_long, f_max = 96, 4
+        fleet_counts = (1, 2)
+    else:
+        # max_tokens kept small: the phase measures prefill-avoidance
+        # scaling, and decode FLOPs are the part that CANNOT scale with
+        # replica count on an oversubscribed host.
+        pool, f_rps, f_dur, light_rps = 8, 40.0, 5.0, 4.0
+        f_long, f_max = spec.long_prompt, 4
+        fleet_counts = (1, 2, 4)
+    # Size each replica's cache to HALF the pool, measured in real
+    # handoff bytes (one probe prefill), plus half an entry of slack.
+    probe_pw = PrefillWorker(params, cfg,
+                             prefill_buckets=eo["prefill_buckets"],
+                             page_size=eo["page_size"])
+    entry_bytes = probe_pw.prefill(
+        list(range(1, f_long + 1)),
+        SamplingParams(max_tokens=f_max), 0.0).nbytes
+    del probe_pw
+    cache_bytes = int(entry_bytes * (pool // 2) + entry_bytes // 2)
+
+    fleet_spec = ServeLoadSpec(
+        rps=f_rps, duration_s=f_dur, long_fraction=1.0,
+        long_prompt=f_long, long_max_tokens=f_max,
+        short_prompt=spec.short_prompt, short_max_tokens=f_max,
+        prompt_pool=pool, drain_timeout_s=600.0)
+    doc["fleet"] = {"prompt_pool": pool, "rps": f_rps,
+                    "duration_s": f_dur, "entry_bytes": entry_bytes,
+                    "cache_capacity_bytes": cache_bytes}
+
+    def warm_fleet(srv, n):
+        # Compile prefill+decode on EVERY replica pre-clock: 2n distinct
+        # warm prompts round-robin across replicas via least-loaded miss
+        # routing (constant prompts; the pool draws random tokens, so no
+        # accidental prefix hits against the measured workload).
+        pubs = [srv.submit({"prompt_tokens": [1] * (f_long - i),
+                            "max_tokens": 2, "timeout_s": 600})
+                for i in range(2 * n)]
+        for p in pubs:
+            srv.result(p, timeout_s=600)
+
+    for n in fleet_counts:
+        srv = FleetServer(build, name=f"bench{n}",
+                          admission=open_adm,
+                          config=FleetConfig(
+                              num_replicas=n, engine_options=dict(eo),
+                              cache_capacity_bytes=cache_bytes),
+                          record_token_times=True)
+        try:
+            warm_fleet(srv, n)
+            if n == 1:
+                # Unsaturated split phase: with an empty queue the
+                # hit-vs-cold TTFT ratio measures replay-vs-prefill,
+                # not queueing delay (a 1-replica cache holds half the
+                # pool, so both populations are well represented).
+                light = ServeLoadSpec(
+                    rps=light_rps, duration_s=f_dur,
+                    long_fraction=1.0, long_prompt=f_long,
+                    long_max_tokens=f_max,
+                    short_prompt=spec.short_prompt,
+                    short_max_tokens=f_max, prompt_pool=pool,
+                    seed=7, drain_timeout_s=600.0)
+                doc["fleet"]["ttft_split"] = run_open_loop(
+                    srv, light, vocab_size=cfg.vocab_size)
+            r = run_open_loop(srv, fleet_spec, vocab_size=cfg.vocab_size)
+            doc["fleet"][f"replicas_{n}"] = r
+        finally:
+            srv.close()
+        print(f"# serve_load[fleet x{n}] sustained="
+              f"{r['sustained_rps']:.2f}rps hit_rate="
+              f"{r['prefix_hit_rate']:.2f} itl_p99="
+              f"{r['itl_p99_ms'] or float('nan'):.2f}ms unfinished="
+              f"{r['unfinished']}", file=sys.stderr)
+
+    f1 = doc["fleet"]["replicas_1"]
+    f2 = doc["fleet"]["replicas_2"]
+    split = doc["fleet"]["ttft_split"]
+    doc["fleet_scaling_2x"] = round(
+        f2["sustained_rps"] / f1["sustained_rps"], 2) \
+        if f1["sustained_rps"] else None
+    doc["fleet_hit_ttft_ratio"] = round(
+        split["ttft_hit_p50_ms"] / split["ttft_cold_p50_ms"], 4) \
+        if split["ttft_hit_p50_ms"] is not None \
+        and split["ttft_cold_p50_ms"] else None
+    # Absolute ITL ceiling: every replica shares one CPU core here, so
+    # a decode step can queue behind up to two back-to-back 960-token
+    # monolithic prefills (~120 ms each) — the p99 floor tracks prefill
+    # cost, not replica count.  The relative term below is the real
+    # scaling gate: adding a replica must not make ITL worse.
+    fleet_itl_bound_ms = 300.0
+    clean = all(doc["fleet"][f"replicas_{n}"]["unfinished"] == 0
+                and doc["fleet"][f"replicas_{n}"]["errors"] == 0
+                for n in fleet_counts)
+    doc["fleet_ok"] = bool(
+        clean and f2["prefix_hits"] > 0
+        and doc["fleet_hit_ttft_ratio"] is not None
+        and doc["fleet_hit_ttft_ratio"] <= 0.5
+        # Throughput scaling + ITL bound gate only on the calibrated
+        # full run; the --fast smoke checks the mechanism, not capacity.
+        and (fast or (doc["fleet_scaling_2x"] is not None
+                      and doc["fleet_scaling_2x"] >= 1.7
+                      and f2["itl_p99_ms"] is not None
+                      and f2["itl_p99_ms"] < fleet_itl_bound_ms
+                      and f1["itl_p99_ms"] is not None
+                      and f2["itl_p99_ms"] < f1["itl_p99_ms"] * 1.25)))
+
+    # ---- Fleet autoscaling: burn up under queue pressure, drain down ---
+    # Capacity is pinned (max_slots=1) so the burst rate can be derived
+    # from a measured sequential service time — deterministic saturation
+    # on any host speed.  Scale-down must go through drain: zero
+    # unfinished requests is part of the gate.
+    eo_auto = dict(eo)
+    eo_auto["max_slots"] = 1
+    scale_cfg = ServeScaleConfig(
+        min_replicas=1, max_replicas=2, queue_high=2.0,
+        sustain_s=0.5, down_sustain_s=1.5, cooldown_s=1.0,
+        window_s=2.0)
+    srv = FleetServer(build, name="benchauto", admission=open_adm,
+                      config=FleetConfig(
+                          num_replicas=1, engine_options=eo_auto,
+                          cache_capacity_bytes=cache_bytes,
+                          autoscale=scale_cfg, manager_interval_s=0.1),
+                      record_token_times=True)
+    auto: dict = {}
+    auto_max_tokens = 16
+    try:
+        for i in range(2):  # compile prefill + decode off-clock
+            srv({"prompt_tokens": [2 + i] * spec.short_prompt,
+                 "max_tokens": auto_max_tokens, "timeout_s": 600})
+        t0 = time.perf_counter()
+        for i in range(3):  # sequential service-time probe
+            srv({"prompt_tokens": [9 + i] * spec.short_prompt,
+                 "max_tokens": auto_max_tokens, "timeout_s": 600})
+        t_seq = (time.perf_counter() - t0) / 3
+        burst_rps = min(400.0, max(10.0, 3.0 / t_seq))
+        auto["t_seq_ms"] = round(t_seq * 1000.0, 2)
+        auto["burst_rps"] = round(burst_rps, 1)
+        burst = ServeLoadSpec(
+            rps=burst_rps, duration_s=3.0 if not fast else 2.0,
+            long_fraction=0.0, short_prompt=spec.short_prompt,
+            short_max_tokens=auto_max_tokens,
+            drain_timeout_s=600.0)
+        auto["burst"] = run_open_loop(srv, burst, cfg.vocab_size)
+        st = srv.status()
+        auto["replicas_after_burst"] = len(st["replicas"])
+        auto["scales_after_burst"] = dict(st["scales"])
+        # Quiet: no traffic — the idle fleet must drain the extra
+        # replica away (down through drain, never killing work).
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            st = srv.status()
+            if st["scales"].get("down", 0) >= 1 \
+                    and len(st["replicas"]) <= 1 and not st["draining"]:
+                break
+            time.sleep(0.2)
+        auto["scales"] = dict(st["scales"])
+        auto["final_replicas"] = len(st["replicas"])
+    finally:
+        srv.close()
+    doc["autoscale"] = auto
+    doc["autoscale_ok"] = bool(
+        auto["scales"].get("up", 0) >= 1
+        and auto["scales"].get("down", 0) >= 1
+        and auto["final_replicas"] == 1
+        and auto["burst"]["unfinished"] == 0
+        and auto["burst"]["errors"] == 0)
+    print(f"# serve_load[autoscale] burst={auto['burst_rps']}rps "
+          f"scales={auto['scales']} final_replicas="
+          f"{auto['final_replicas']} unfinished="
+          f"{auto['burst']['unfinished']}", file=sys.stderr)
+
     inline_itl = doc["inline"]["itl_p99_ms"]
     cands = [x for x in (doc["chunked"]["itl_p99_ms"],
                          doc["disagg"]["itl_p99_ms"]) if x is not None]
@@ -2165,9 +2361,11 @@ def bench_serve_load(fast: bool = False) -> None:
     doc["within_budget"] = bool(
         doc["itl_p99_improvement_x"] is not None
         and doc["itl_p99_improvement_x"] >= 2.0
-        and doc["graceful_shed"])
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_serve_load.json")
+        and doc["graceful_shed"]
+        and doc["fleet_ok"] and doc["autoscale_ok"])
+    path = out_path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_serve_load.json")
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
     print(json.dumps({
@@ -2177,12 +2375,16 @@ def bench_serve_load(fast: bool = False) -> None:
         "shed_rate_at_saturation": round(sat["shed_rate"], 3),
         "ttft_p99_ms_admitted_at_saturation":
             round(sat["ttft_p99_ms"], 1) if sat["ttft_p99_ms"] else None,
+        "fleet_scaling_2x": doc["fleet_scaling_2x"],
+        "fleet_hit_ttft_ratio": doc["fleet_hit_ttft_ratio"],
+        "autoscale_ok": doc["autoscale_ok"],
         "within_budget": doc["within_budget"],
     }))
     print(f"# serve_load bench -> {path}", file=sys.stderr)
     _dump_telemetry("serve_load")
     if not doc["within_budget"]:
         raise SystemExit(1)
+    return doc
 
 
 def bench_profile(steps: int = 150, reps: int = 8) -> None:

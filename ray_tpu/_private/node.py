@@ -1217,6 +1217,7 @@ class NodeManager:
         if ring is None:
             return out
         out["counts"] = dict(ring.counts)
+        out["transfer_bytes"] = dict(ring.transfer_bytes)
         states = ring.latest_index()
         live = [st for st in states
                 if st["state"] not in ("deleted", "evicted")]

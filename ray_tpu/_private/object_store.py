@@ -489,6 +489,13 @@ class NativeArenaStore:
         self._spill_dir = spill
         self._shm = _open_untracked(name, create=False)
         self._closed = False
+        # Guards stats() vs shutdown(): a diagnostics/death-bundle
+        # thread reading stats concurrent with rts_destroy is a native
+        # use-after-free (segfault, not an exception).  Data-path calls
+        # don't take this — the C++ store locks internally and the node
+        # stops dispatching before it shuts its store down; only the
+        # postmortem reader crosses that line.
+        self._life = threading.Lock()
         # Lifecycle ring (storeview): spill/evict decisions happen inside
         # the C++ LRU so those arrive as stats-diff counters only; every
         # Python-visible mutation records an event here.
@@ -681,7 +688,9 @@ class NativeArenaStore:
         # C++ index in one call (store.cc rts_stats).
         import ctypes
         out = (ctypes.c_uint64 * 10)()
-        self._lib.rts_stats(self._h, ctypes.byref(out))
+        with self._life:
+            if not self._closed:
+                self._lib.rts_stats(self._h, ctypes.byref(out))
         return {"num_objects": int(out[0]), "used_bytes": int(out[1]),
                 "capacity_bytes": int(out[2]),
                 "pinned_bytes": int(out[8]),
@@ -692,15 +701,20 @@ class NativeArenaStore:
                 "native": 1}
 
     def shutdown(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        # _life serializes the close flag against stats(): once we hold
+        # the lock no stats call is mid-rts_stats, and every later one
+        # sees _closed and skips the native call — so destroying the
+        # handle below cannot race a reader.  _h itself stays set (all
+        # its accesses are the data path's, which ends before shutdown).
+        with self._life:
+            if self._closed:
+                return
+            self._closed = True
         try:
             self._shm.close()
         except Exception:
             pass
         self._lib.rts_destroy(self._h)  # removes tracked spill files
-        self._h = None
         # Shutdown half of spill-file GC: anything left in our spill dir
         # after rts_destroy is an orphan (crashed mid-spill).
         if self._spill_dir.startswith(SPILL_ROOT):

@@ -3031,6 +3031,22 @@ class Runtime:
                         telemetry.inc("ray_tpu_store_spill_ops_total",
                                       delta, tags={"op": op})
                     prev["_" + op] = cur
+                # Remote nodes' transfers happen in THEIR processes:
+                # _record_transfer incs a registry the merged scrape
+                # never sees, so the bytes ride the synced ring tallies
+                # instead.  The head's own entry is skipped — its
+                # transfers already inc'd in-process (double count).
+                if nhex == self.node_id.hex():
+                    continue
+                tb = sub.get("transfer_bytes") or {}
+                for direction in ("push", "pull"):
+                    cur = int(tb.get(direction, 0))
+                    delta = cur - prev.get("_tb_" + direction, 0)
+                    if delta > 0:
+                        telemetry.inc(
+                            "ray_tpu_store_transfer_bytes_total",
+                            delta, tags={"direction": direction})
+                    prev["_tb_" + direction] = cur
         except Exception as e:  # noqa: BLE001
             telemetry.note_swallowed("runtime.store_metrics", e)
 

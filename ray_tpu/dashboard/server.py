@@ -21,6 +21,8 @@ Endpoints (reference: dashboard/modules/*):
     GET /api/jobs               — job table
     GET /api/timeline           — chrome-trace events
     GET /api/metrics/summary    — built-in telemetry by subsystem + goodput
+    GET /api/serve/fleet        — published decode-fleet snapshots
+                                  (llm.fleet: replicas, router, autoscale)
     GET /api/stacks             — cluster-wide stack capture (`ray stack`)
     POST /api/debug/dump        — write a flight-recorder bundle
     POST /api/profile           — on-demand cluster profile (merged
@@ -51,6 +53,7 @@ async function refresh(){
   const telem = await (await fetch('/api/metrics/summary')).json();
   const sched = await (await fetch('/api/sched')).json();
   const mem = await (await fetch('/api/memory')).json();
+  const fleet = await (await fetch('/api/serve/fleet')).json();
   let h = '<h2>cluster</h2><table>';
   for (const [k,v] of Object.entries(c.total_resources))
     h += `<tr><td>${k}</td><td>${c.available_resources[k]??0} / ${v}</td></tr>`;
@@ -87,6 +90,31 @@ async function refresh(){
   for (const l of mem.leak_candidates || [])
     h += `<p>leak candidate: ${l.object_id.slice(0,16)}… `
       + `${mb(l.nbytes||0)} ${l.reason}</p>`;
+  // Serving fleet: per-replica decode state + autoscale posture
+  // (published by llm.fleet FleetServer instances via the cluster KV).
+  for (const f of fleet.fleets || []) {
+    h += `<h2>serving fleet: ${f.name}</h2>`
+      + `<p>replicas ${(f.replicas||[]).length}/${f.target_replicas} `
+      + `queue ${f.router_queue} completed ${f.completed} `
+      + `shed ${f.shed} rebalances ${f.rebalances}</p>`
+      + '<table><tr><th>replica</th><th>state</th><th>ongoing</th>'
+      + '<th>waiting</th><th>kv%</th><th>cache</th><th>hit rate</th></tr>';
+    for (const r of f.replicas || []) {
+      const cache = r.cache || {};
+      h += `<tr><td>${r.name}</td><td>${r.state}</td>`
+        + `<td>${r.ongoing}</td><td>${r.waiting}</td>`
+        + `<td>${((r.kv_occupancy||0)*100).toFixed(0)}%</td>`
+        + `<td>${cache.entries||0} / ${mb(cache.bytes||0)}</td>`
+        + `<td>${(cache.hit_rate||0).toFixed(2)}</td></tr>`;
+    }
+    h += '</table>';
+    if (f.autoscale)
+      h += `<p>autoscale: queue/replica ${f.autoscale.signals.queue_per_replica?.toFixed(2)} `
+        + `shed/s ${f.autoscale.signals.shed_rate?.toFixed(3)} `
+        + `burning ${f.autoscale.burning_for_s.toFixed(1)}s `
+        + `idle ${f.autoscale.idle_for_s.toFixed(1)}s `
+        + `cooldown ${f.autoscale.cooldown_remaining_s.toFixed(1)}s</p>`;
+  }
   // Built-in system telemetry: serving / training / llm / data metrics.
   h += '<h2>system telemetry</h2>';
   if (telem.goodput)
@@ -312,6 +340,22 @@ class DashboardServer:
                 out = {k: v for k, v in out.items() if k != "trace"}
             return self._json(out)
 
+        async def serve_fleet(req):
+            # Published decode-fleet snapshots: each llm.fleet
+            # FleetServer writes its status() JSON to the cluster KV
+            # under serve:fleet:<name> (same feed as `ray-tpu serve
+            # status`).
+            fleets = []
+            for key in sorted(rt.ctl_kv_keys("serve:fleet:")):
+                raw = rt.ctl_kv_get(key)
+                if raw is None:
+                    continue
+                try:
+                    fleets.append(json.loads(raw.decode()))
+                except Exception:
+                    continue
+            return self._json({"fleets": fleets})
+
         async def healthz(req):
             return web.Response(text="ok")
 
@@ -333,6 +377,7 @@ class DashboardServer:
         app.router.add_get("/api/metrics/history", metrics_history)
         app.router.add_get("/api/metrics/query", metrics_query)
         app.router.add_get("/api/alerts", alerts)
+        app.router.add_get("/api/serve/fleet", serve_fleet)
         app.router.add_get("/api/stacks", stacks)
         app.router.add_post("/api/debug/dump", debug_dump)
         app.router.add_post("/api/profile", profile)

@@ -92,3 +92,7 @@ class JobSubmissionClient:
 
     def cluster_status(self) -> Dict[str, Any]:
         return self._request("GET", "/api/cluster/status")
+
+    def serve_fleet(self) -> Dict[str, Any]:
+        """Published decode-fleet snapshots (`ray-tpu serve status`)."""
+        return self._request("GET", "/api/cluster/serve/fleet")

@@ -324,6 +324,27 @@ class JobServer:
             return web.json_response(
                 await call(_control, "alerts", recent))
 
+        async def cluster_serve_fleet(request):
+            """`ray-tpu serve status`: published decode-fleet snapshots
+            (per-replica load + prefix-cache stats, autoscale state)."""
+            import json as _json
+
+            from ray_tpu._private.api import _control
+
+            def read():
+                fleets = []
+                for key in sorted(_control("kv_keys", "serve:fleet:")):
+                    blob = _control("kv_get", key)
+                    if not blob:
+                        continue
+                    try:
+                        fleets.append(_json.loads(blob.decode()))
+                    except Exception:
+                        continue
+                return {"fleets": fleets}
+
+            return web.json_response(await call(read))
+
         async def cluster_slo(request):
             """POST: replace the SLO objective set (JSON list of
             objective specs); GET: list the registered specs."""
@@ -366,6 +387,8 @@ class JobServer:
             app.router.add_get("/api/cluster/metrics/series",
                                cluster_metrics_series)
             app.router.add_get("/api/cluster/alerts", cluster_alerts)
+            app.router.add_get("/api/cluster/serve/fleet",
+                               cluster_serve_fleet)
             app.router.add_get("/api/cluster/slo", cluster_slo)
             app.router.add_post("/api/cluster/slo", cluster_slo)
             app.router.add_get("/metrics", metrics)

@@ -18,14 +18,16 @@ __all__ = [
     "InferenceEngine", "SamplingParams", "Request", "PagePool",
     "LLMServer", "build_llm_deployment",
     # Disaggregated serving (prefill/decode split + SLO router) lives in
-    # ray_tpu.llm.disagg; imported lazily to keep bare engine imports
-    # light.
+    # ray_tpu.llm.disagg; the multi-replica decode fleet (prefix-affinity
+    # routing + replica autoscaling) in ray_tpu.llm.fleet; both imported
+    # lazily to keep bare engine imports light.
     "disagg",
+    "fleet",
 ]
 
 
 def __getattr__(name):
-    if name == "disagg":
+    if name in ("disagg", "fleet"):
         import importlib
-        return importlib.import_module(".disagg", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

@@ -39,6 +39,12 @@ class ServeLoadSpec:
     #: Wall-clock budget for collecting stragglers after the last
     #: arrival (requests past it count as unfinished, not completed).
     drain_timeout_s: float = 60.0
+    #: >0 = prefix-heavy traffic: prompts draw from a fixed pool of
+    #: this many distinct prompts (per kind) instead of fresh random
+    #: tokens per request — the regime where a fleet's prefix-affinity
+    #: routing and per-replica KV caches pay off.  0 = every prompt
+    #: unique (the original workload).
+    prompt_pool: int = 0
 
 
 def _percentile_ms(samples: List[float], q: float) -> Optional[float]:
@@ -64,9 +70,21 @@ def run_open_loop(server, spec: ServeLoadSpec,
         arrivals.append(t)
     kinds = rng.random(len(arrivals)) < spec.long_fraction
     prompts = []
-    for long in kinds:
-        n = spec.long_prompt if long else spec.short_prompt
-        prompts.append(rng.integers(1, vocab_size, n).tolist())
+    if spec.prompt_pool > 0:
+        pool = {
+            True: [rng.integers(1, vocab_size, spec.long_prompt).tolist()
+                   for _ in range(spec.prompt_pool)],
+            False: [rng.integers(1, vocab_size,
+                                 spec.short_prompt).tolist()
+                    for _ in range(spec.prompt_pool)],
+        }
+        picks = rng.integers(0, spec.prompt_pool, len(arrivals))
+        for long, pick in zip(kinds, picks):
+            prompts.append(pool[bool(long)][int(pick)])
+    else:
+        for long in kinds:
+            n = spec.long_prompt if long else spec.short_prompt
+            prompts.append(rng.integers(1, vocab_size, n).tolist())
 
     submitted: List[tuple] = []   # (pub_id, is_long)
     shed_submit = 0
@@ -86,6 +104,9 @@ def run_open_loop(server, spec: ServeLoadSpec,
     submit_span = time.perf_counter() - t0
 
     ttft: List[float] = []
+    ttft_hit: List[float] = []    # full prefix hits (fleet replay path)
+    ttft_cold: List[float] = []
+    prefix_full = 0
     itl: List[float] = []
     completed = 0
     shed_deadline = 0
@@ -117,8 +138,11 @@ def run_open_loop(server, spec: ServeLoadSpec,
             continue
         completed += 1
         t_last_done = max(t_last_done, time.perf_counter())
+        hit = res.get("prefix_outcome") == "full"
+        prefix_full += int(hit)
         if res.get("ttft_s") is not None:
             ttft.append(res["ttft_s"])
+            (ttft_hit if hit else ttft_cold).append(res["ttft_s"])
         itl.extend(res.get("itl_s") or [])
 
     offered = len(arrivals)
@@ -140,4 +164,10 @@ def run_open_loop(server, spec: ServeLoadSpec,
         "itl_p50_ms": _percentile_ms(itl, 50),
         "itl_p99_ms": _percentile_ms(itl, 99),
         "itl_samples": len(itl),
+        # Fleet prefix-affinity split (None/0 for single-engine servers,
+        # which report no prefix_outcome).
+        "prefix_hits": prefix_full,
+        "prefix_hit_rate": prefix_full / completed if completed else 0.0,
+        "ttft_hit_p50_ms": _percentile_ms(ttft_hit, 50),
+        "ttft_cold_p50_ms": _percentile_ms(ttft_cold, 50),
     }

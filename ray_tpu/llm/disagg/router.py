@@ -78,6 +78,8 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._queued: Dict[str, int] = {}
         self._inflight_tokens: Dict[str, int] = {}
+        #: EWMA of observed queue wait at dequeue (deadline feasibility).
+        self._wait_ewma: Optional[float] = None
 
     def try_admit(self, clazz: str, total_tokens: int,
                   load: Dict[str, Any]) -> Optional[str]:
@@ -95,6 +97,18 @@ class AdmissionController:
             if load.get("kv_occupancy", 0.0) >= self.cfg.kv_high_watermark \
                     and (q or load.get("waiting", 0)):
                 return "backpressure"
+            # Deadline feasibility: when requests currently LEAVING the
+            # queue already waited past this class's deadline and work
+            # is still queued ahead, a new arrival is hopeless — it
+            # would age to its deadline and shed at dequeue anyway.
+            # Shed it NOW (retriable, microseconds after submit)
+            # instead of parking it to die.  Guarded on a non-empty
+            # queue so a stale EWMA from a past saturation burst never
+            # sheds the first arrivals of a fresh one.
+            if self._wait_ewma is not None \
+                    and self._wait_ewma > rc.queue_deadline_s \
+                    and sum(self._queued.values()) > 0:
+                return "deadline_infeasible"
             self._queued[clazz] = q + 1
             self._inflight_tokens[clazz] = \
                 self._inflight_tokens.get(clazz, 0) + total_tokens
@@ -105,6 +119,13 @@ class AdmissionController:
         with self._lock:
             self._queued[clazz] = max(0, self._queued.get(clazz, 0) - 1)
         self._set_depth_gauge(clazz)
+
+    def note_queue_wait(self, wait_s: float) -> None:
+        """Dispatcher-observed queue wait for one dequeued request —
+        feeds the admission-time deadline-feasibility estimate."""
+        with self._lock:
+            self._wait_ewma = wait_s if self._wait_ewma is None \
+                else 0.7 * self._wait_ewma + 0.3 * wait_s
 
     def note_finished(self, clazz: str, total_tokens: int) -> None:
         with self._lock:
@@ -368,7 +389,9 @@ class DisaggServer:
                 continue
             self._trace_phase(item, "queue_wait", item.t_submit_wall,
                               {"class": item.clazz})
-            if time.perf_counter() > item.deadline:
+            now = time.perf_counter()
+            self.admission.note_queue_wait(now - item.t_submit)
+            if now > item.deadline:
                 self._finish_shed(item, "deadline")
                 continue
             try:

@@ -1,0 +1,29 @@
+"""ray_tpu.llm.fleet — multi-replica decode serving.
+
+The serving fleet: N continuous-batching decode replicas behind the
+disagg admission router, with prefix-cache-affinity routing (longest
+shared prompt prefix wins, load-imbalance override), a shared prefill
+tier whose KV handoffs ride the shm object store same-host and the p2p
+pull path cross-host, and SLO-driven replica autoscaling off the
+metricsview backplane (queue depth / shed rate / ITL p99).  Reference
+analog: the reference's multi-replica LLM serving deployments — vLLM
+engines behind a prefix-aware router with replica autoscaling.
+"""
+
+from .autoscale import (FleetScaleDecision, ServeAutoscalePolicy,
+                        ServeScaleConfig)
+from .prefix import (DEFAULT_BLOCK, PrefixCache, full_hash, prefix_chain,
+                     score_summary)
+from .remote import RemoteReplica, ReplicaHost
+from .replica import DecodeReplica
+from .router import FleetRouter, RouteDecision, RoutingConfig
+from .server import FLEET_KV_PREFIX, FleetConfig, FleetServer
+
+__all__ = [
+    "DEFAULT_BLOCK", "PrefixCache", "prefix_chain", "full_hash",
+    "score_summary",
+    "DecodeReplica", "RemoteReplica", "ReplicaHost",
+    "FleetRouter", "RouteDecision", "RoutingConfig",
+    "ServeAutoscalePolicy", "ServeScaleConfig", "FleetScaleDecision",
+    "FleetConfig", "FleetServer", "FLEET_KV_PREFIX",
+]

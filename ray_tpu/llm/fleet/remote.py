@@ -1,0 +1,270 @@
+"""Cross-host decode replicas: the fleet's p2p prefill handoff path.
+
+Same-host handoff is zero-copy through the shm object store; a replica
+on ANOTHER node instead receives its handoffs through the normal
+object-transfer plane: the dispatcher ``ray_tpu.put``s the
+:class:`~ray_tpu.llm.disagg.KVHandoff` once and passes the ref to the
+replica actor — argument materialization on the remote node pulls the
+blob over the DataServer's p2p path and records the existing
+``ray_tpu_store_transfer_bytes_total{direction="pull"}`` /
+``..._seconds{op}`` series, so KV shipping shows up in the data-plane
+telescope with zero new transfer code.
+
+Two classes:
+
+* :class:`ReplicaHost` — the actor body: owns a
+  :class:`~ray_tpu.llm.fleet.replica.DecodeReplica` and buffers its
+  finishes for the handle to drain (callbacks can't cross processes).
+* :class:`RemoteReplica` — the FleetServer-side handle, duck-typed to
+  DecodeReplica's router surface (``accepting`` / ``import_prefill`` /
+  ``try_serve_cached`` / ``load_stats`` / ``summary`` / ``drain`` /
+  ``kill``): a poll thread drains finishes into the fleet's normal
+  ``on_finish`` callback and refreshes a cached load/summary snapshot
+  so routing never blocks on a cross-host RPC.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..._private import sanitizer
+from .replica import (DecodeReplica, STATE_ACTIVE, STATE_DEAD,
+                      STATE_DRAINING)
+
+
+class ReplicaHost:
+    """Actor body hosting one DecodeReplica on its placement node."""
+
+    def __init__(self, build_params, name: str,
+                 engine_options: Optional[Dict[str, Any]] = None,
+                 cache_capacity_bytes: int = 64 * 1024 * 1024,
+                 record_token_times: bool = False):
+        self._lock = threading.Lock()
+        self._finished: List[Dict[str, Any]] = []
+        self._replica = DecodeReplica(
+            build_params, name=name, engine_options=engine_options,
+            cache_capacity_bytes=cache_capacity_bytes,
+            record_token_times=record_token_times,
+            on_finish=self._buffer)
+
+    def _buffer(self, _replica, req) -> None:
+        with self._lock:
+            self._finished.append({
+                "rid": req.request_id,
+                "output_tokens": list(req.output_tokens),
+                "finish_reason": req.finish_reason,
+                # perf_counter stamps are process-local: ship the deltas
+                # (ITL) — absolute TTFT doesn't survive the host hop.
+                "itl_s": [b - a for a, b in zip(req.token_times,
+                                                req.token_times[1:])],
+            })
+
+    def import_prefill(self, handoff, retain: bool = True
+                       ) -> Optional[int]:
+        return self._replica.import_prefill(handoff, retain=retain)
+
+    def try_serve_cached(self, prompt_tokens, params,
+                         t_submit: float = 0.0) -> Optional[int]:
+        # t_submit is the CALLER's clock; replay against our own so the
+        # engine's TTFT math stays monotonic.
+        return self._replica.try_serve_cached(
+            prompt_tokens, params, t_submit=time.perf_counter())
+
+    def cancel(self, rid: int) -> None:
+        self._replica.cancel(rid)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"load": self._replica.load_stats(),
+                "summary": self._replica.summary(),
+                "state": self._replica.state,
+                "idle": self._replica.idle()}
+
+    def drain_finished(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = self._finished
+            self._finished = []
+        return out
+
+    def drain(self) -> None:
+        self._replica.drain()
+
+    def kill(self) -> List[int]:
+        return self._replica.kill()
+
+
+class RemoteReplica:
+    """FleetServer-side handle for a replica actor on another node."""
+
+    def __init__(self, build_params, *, name: str,
+                 engine_options: Optional[Dict[str, Any]] = None,
+                 cache_capacity_bytes: int = 64 * 1024 * 1024,
+                 record_token_times: bool = False,
+                 on_finish=None, num_cpus: int = 1,
+                 poll_interval_s: float = 0.02):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        self.name = name
+        self._on_finish = on_finish
+        self._actor = ray_tpu.remote(num_cpus=num_cpus)(
+            ReplicaHost).remote(
+                build_params, name, engine_options,
+                cache_capacity_bytes, record_token_times)
+        self._state = STATE_ACTIVE
+        self._snap: Dict[str, Any] = {"load": {}, "summary": None,
+                                      "idle": False}
+        self._snap_lock = threading.Lock()
+        #: One put per handoff object even across import retries — the
+        #: dispatcher re-attempts the same object under backpressure and
+        #: re-shipping megabytes per retry would swamp the p2p plane.
+        self._put_cache: tuple = (None, None)
+        self._stop = threading.Event()
+        self._poll = poll_interval_s
+        self._poller = sanitizer.spawn(
+            self._poll_loop, name=f"fleet-remote-{name}")
+
+    # -- router surface (DecodeReplica-compatible) --------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def accepting(self) -> bool:
+        return self._state == STATE_ACTIVE
+
+    def _handoff_ref(self, handoff):
+        cached_id, ref = self._put_cache
+        if cached_id != id(handoff):
+            ref = self._ray.put(handoff)
+            self._put_cache = (id(handoff), ref)
+        return ref
+
+    def import_prefill(self, handoff, retain: bool = True
+                       ) -> Optional[int]:
+        if not self.accepting:
+            return None
+        try:
+            return self._ray.get(self._actor.import_prefill.remote(
+                self._handoff_ref(handoff), retain))
+        except Exception:
+            self._state = STATE_DEAD
+            return None
+
+    def try_serve_cached(self, prompt_tokens: Sequence[int], params,
+                         t_submit: float = 0.0) -> Optional[int]:
+        if not self.accepting or params.temperature > 0.0:
+            return None
+        with self._snap_lock:
+            summ = self._snap.get("summary")
+        if not summ:
+            return None
+        try:
+            return self._ray.get(self._actor.try_serve_cached.remote(
+                list(prompt_tokens), params, t_submit))
+        except Exception:
+            self._state = STATE_DEAD
+            return None
+
+    def cancel(self, rid: int) -> None:
+        try:
+            self._actor.cancel.remote(rid)  # ray-tpu: detached
+        except Exception:
+            pass
+
+    def load_stats(self) -> Dict[str, Any]:
+        with self._snap_lock:
+            load = dict(self._snap.get("load") or {})
+        load.setdefault("name", self.name)
+        load.setdefault("state", self._state)
+        load.setdefault("ongoing", 0)
+        load.setdefault("kv_occupancy", 0.0)
+        load.setdefault("waiting", 0)
+        return load
+
+    def summary(self):
+        with self._snap_lock:
+            return self._snap.get("summary")
+
+    def idle(self) -> bool:
+        with self._snap_lock:
+            return bool(self._snap.get("idle"))
+
+    @property
+    def engine(self):
+        """Depth accounting shim: scale_down reads
+        ``len(rep.engine.running)``; surface the cached ongoing count
+        through the same shape."""
+        with self._snap_lock:
+            n = int((self._snap.get("load") or {}).get("ongoing", 0))
+        return SimpleNamespace(running=list(range(n)))
+
+    # -- poll (finishes + snapshot) -----------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self._poll)
+            if self._stop.is_set():
+                return
+            try:
+                done = self._ray.get(self._actor.drain_finished.remote())
+                snap = self._ray.get(self._actor.snapshot.remote())
+            except Exception:
+                # Actor gone (node loss, kill): stop polling; the fleet
+                # manager reaps dead replicas and sheds their in-flight.
+                self._state = STATE_DEAD
+                return
+            with self._snap_lock:
+                self._snap = snap
+            if self._state != STATE_DEAD \
+                    and snap.get("state") == STATE_DRAINING:
+                self._state = STATE_DRAINING
+            for rec in done:
+                if self._on_finish is not None:
+                    self._on_finish(self, _as_request(rec))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def drain(self) -> None:
+        if self._state == STATE_ACTIVE:
+            self._state = STATE_DRAINING
+            try:
+                self._actor.drain.remote()  # ray-tpu: detached
+            except Exception:
+                self._state = STATE_DEAD
+
+    def kill(self, timeout_s: float = 5.0) -> List[int]:
+        self._state = STATE_DEAD
+        self._stop.set()
+        self._poller.join(timeout_s)
+        lost: List[int] = []
+        try:
+            lost = self._ray.get(self._actor.kill.remote())
+        except Exception:
+            pass
+        try:
+            self._ray.kill(self._actor)
+        except Exception:
+            pass
+        return lost
+
+    close = kill
+
+
+def _as_request(rec: Dict[str, Any]):
+    """Shape one drained finish record like an engine Request for the
+    fleet's on_finish callback.  Cross-host TTFT is not reconstructable
+    from process-local clocks, so t_submit/t_first stay zero (the
+    result carries ttft_s=None) while ITL rides the shipped deltas."""
+    times = [0.0]
+    for d in rec.get("itl_s") or []:
+        times.append(times[-1] + d)
+    return SimpleNamespace(
+        request_id=rec["rid"],
+        output_tokens=rec.get("output_tokens") or [],
+        finish_reason=rec.get("finish_reason", ""),
+        t_submit=0.0, t_first=0.0,
+        token_times=times if len(times) > 1 else [])

@@ -630,6 +630,68 @@ def slo_set(address, objectives_file):
 
 
 @cli.group()
+def serve():
+    """Serving-plane introspection (decode fleets)."""
+
+
+@serve.command("status")
+@click.option("--address", default=None)
+def serve_status(address):
+    """Decode-fleet status: per-replica ongoing/queue/KV occupancy and
+    prefix-cache hit rate, routing outcome counters, and the
+    autoscaler's live signals/cooldown."""
+    out = _client(address)._request("GET", "/api/cluster/serve/fleet")
+    fleets = out.get("fleets") or []
+    if not fleets:
+        click.echo("no serving fleets published")
+        return
+    for f in fleets:
+        reps = f.get("replicas") or []
+        click.echo(f"fleet {f.get('name')}: {len(reps)} replica(s) "
+                   f"(target {f.get('target_replicas')}), "
+                   f"router queue {f.get('router_queue', 0)}, "
+                   f"completed {f.get('completed', 0)}, "
+                   f"shed {f.get('shed', 0)}")
+        pf = f.get("prefix") or {}
+        scales = f.get("scales") or {}
+        click.echo(f"  routing: full={pf.get('full', 0)} "
+                   f"partial={pf.get('partial', 0)} "
+                   f"miss={pf.get('miss', 0)} "
+                   f"rebalances={f.get('rebalances', 0)}  "
+                   f"scales: up={scales.get('up', 0)} "
+                   f"down={scales.get('down', 0)}")
+        for r in reps:
+            cache = r.get("cache") or {}
+            hr = cache.get("hit_rate")
+            click.echo(
+                f"  {r.get('name')}  [{r.get('state')}]  "
+                f"ongoing={r.get('ongoing', 0)} "
+                f"waiting={r.get('waiting', 0)} "
+                f"assigned={r.get('assigned', 0)}  "
+                f"kv={float(r.get('kv_occupancy') or 0.0):.0%}  "
+                f"cache={cache.get('entries', 0)} entries/"
+                f"{_fmt_bytes(cache.get('bytes', 0))} "
+                f"hit_rate={'-' if hr is None else format(hr, '.0%')}")
+        a = f.get("autoscale")
+        if a:
+            sig = a.get("signals") or {}
+
+            def _fmt(v, spec=".2f"):
+                return "-" if v is None else format(float(v), spec)
+
+            click.echo(
+                f"  autoscale: queue/replica="
+                f"{_fmt(sig.get('queue_per_replica'))} "
+                f"shed_rate={_fmt(sig.get('shed_rate'))} "
+                f"itl_p99={_fmt(sig.get('itl_p99_ms'), '.1f')}ms  "
+                f"burning={_fmt(a.get('burning_for_s'), '.1f')}s "
+                f"idle={_fmt(a.get('idle_for_s'), '.1f')}s "
+                f"cooldown={_fmt(a.get('cooldown_remaining_s'), '.1f')}s"
+                f"  bounds=[{a.get('min_replicas')},"
+                f"{a.get('max_replicas')}]")
+
+
+@cli.group()
 def job():
     """Job submission and management."""
 

@@ -94,6 +94,12 @@ class StoreEventRing:
         # Plain-int per-kind totals (flushed into the telemetry counters
         # by the head's rate-limited publisher, never on hot path).
         self.counts: Dict[str, int] = {}
+        # Cumulative transfer bytes by direction ("push"/"pull"): node
+        # processes record transfers into their own telemetry registry,
+        # which never reaches the head's merged scrape — the head folds
+        # these tallies (synced via the node view) into
+        # ray_tpu_store_transfer_bytes_total instead.
+        self.transfer_bytes: Dict[str, int] = {}
 
     # -- hot path -----------------------------------------------------------
 
@@ -118,6 +124,12 @@ class StoreEventRing:
             c[kind] += 1
         except KeyError:
             c[kind] = 1
+        if kind == E_PUSH or kind == E_PULL:
+            t = self.transfer_bytes  # ray-tpu: noqa[RT401]
+            try:
+                t[kind] += nbytes
+            except KeyError:
+                t[kind] = nbytes
 
     # -- folding ------------------------------------------------------------
 

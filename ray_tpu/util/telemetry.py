@@ -80,6 +80,27 @@ CATALOG: Dict[str, Dict[str, Any]] = {
         "description": "Handle-path requests rejected by the "
                        "max_queued_requests admission bound (retriable "
                        "OverloadError instead of unbounded queueing)."},
+    # -- serve: decode fleet (ray_tpu.llm.fleet) ---------------------------
+    "ray_tpu_serve_replica_count": {
+        "type": "gauge", "tag_keys": ("fleet",),
+        "description": "Accepting decode replicas in a serving fleet "
+                       "(FleetServer view; draining/dead excluded)."},
+    "ray_tpu_serve_prefix_hit_total": {
+        "type": "counter", "tag_keys": ("outcome",),
+        "description": "Fleet routing outcomes per dispatched request: "
+                       "full (exact prompt cached, prefill skipped), "
+                       "partial (prefix overlap steered placement), "
+                       "miss (load-only placement)."},
+    "ray_tpu_serve_rebalance_total": {
+        "type": "counter", "tag_keys": (),
+        "description": "Requests whose prefix affinity was overridden "
+                       "by the load-imbalance watermark (routed by load "
+                       "instead of cache locality)."},
+    "ray_tpu_serve_replica_scale_total": {
+        "type": "counter", "tag_keys": ("direction",),
+        "description": "Fleet replica scale actions (up = spawn/"
+                       "backfill, down = drain-then-remove), autoscaler "
+                       "or manual."},
     # -- llm ---------------------------------------------------------------
     "ray_tpu_llm_ttft_seconds": {
         "type": "histogram", "tag_keys": (),

@@ -159,6 +159,30 @@ class TestCatalog:
         telemetry.observe("ray_tpu_llm_kv_transfer_seconds", 0.0,
                           tags={"op": "export"})
 
+    def test_fleet_series_registered(self):
+        """The serving-fleet series (llm.fleet: replica-count gauge,
+        prefix-affinity routing outcomes, imbalance rebalances, and
+        autoscaler replica add/remove) are declared in the catalog."""
+        specs = {
+            "ray_tpu_serve_replica_count": ("gauge", ("fleet",)),
+            "ray_tpu_serve_prefix_hit_total": ("counter", ("outcome",)),
+            "ray_tpu_serve_rebalance_total": ("counter", ()),
+            "ray_tpu_serve_replica_scale_total": ("counter",
+                                                  ("direction",)),
+        }
+        for name, (typ, tags) in specs.items():
+            assert name in telemetry.CATALOG, name
+            assert telemetry.CATALOG[name]["type"] == typ, name
+            assert tuple(telemetry.CATALOG[name]["tag_keys"]) == tags
+            assert telemetry.CATALOG[name]["description"].strip(), name
+        telemetry.set_gauge("ray_tpu_serve_replica_count", 0.0,
+                            tags={"fleet": "t"})
+        telemetry.inc("ray_tpu_serve_prefix_hit_total", 0.0,
+                      tags={"outcome": "full"})
+        telemetry.inc("ray_tpu_serve_rebalance_total", 0.0)
+        telemetry.inc("ray_tpu_serve_replica_scale_total", 0.0,
+                      tags={"direction": "up"})
+
     def test_mesh_series_registered(self):
         """The mesh-runtime series (train/mesh: live axis sizes,
         per-process parameter shard bytes, reshape events) are declared
