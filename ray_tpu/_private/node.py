@@ -1078,6 +1078,10 @@ class NodeManager:
                 return
             handle.state = DEAD
             self._workers.pop(handle.worker_id, None)
+            # A worker killed before registering still holds a live
+            # register-watchdog timer; once popped from _workers the
+            # shutdown sweep can't reach it, so cancel here.
+            self._cancel_register_watchdog(handle)
             bucket = self._idle.get(handle.env_key)
             if bucket and handle.worker_id in bucket:
                 bucket.remove(handle.worker_id)

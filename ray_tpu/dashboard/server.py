@@ -111,8 +111,8 @@ async function refresh(){
     if (f.autoscale)
       h += `<p>autoscale: queue/replica ${f.autoscale.signals.queue_per_replica?.toFixed(2)} `
         + `shed/s ${f.autoscale.signals.shed_rate?.toFixed(3)} `
-        + `burning ${f.autoscale.burning_for_s.toFixed(1)}s `
-        + `idle ${f.autoscale.idle_for_s.toFixed(1)}s `
+        + `burning ${(f.autoscale.burning_for_s ?? 0).toFixed(1)}s `
+        + `idle ${(f.autoscale.idle_for_s ?? 0).toFixed(1)}s `
         + `cooldown ${f.autoscale.cooldown_remaining_s.toFixed(1)}s</p>`;
   }
   // Built-in system telemetry: serving / training / llm / data metrics.

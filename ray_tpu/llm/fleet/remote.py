@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from types import SimpleNamespace
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -120,7 +121,11 @@ class RemoteReplica:
         #: One put per handoff object even across import retries — the
         #: dispatcher re-attempts the same object under backpressure and
         #: re-shipping megabytes per retry would swamp the p2p plane.
-        self._put_cache: tuple = (None, None)
+        #: Keyed by a WEAK reference to the handoff (not id()): once a
+        #: handoff is garbage-collected its weakref goes dead and can
+        #: never compare equal to a new object, so an address-reuse
+        #: collision can't ship a stale ref.
+        self._put_cache: tuple = (None, None)  # (weakref, ObjectRef)
         self._stop = threading.Event()
         self._poll = poll_interval_s
         self._poller = sanitizer.spawn(
@@ -137,10 +142,10 @@ class RemoteReplica:
         return self._state == STATE_ACTIVE
 
     def _handoff_ref(self, handoff):
-        cached_id, ref = self._put_cache
-        if cached_id != id(handoff):
+        cached_wr, ref = self._put_cache
+        if cached_wr is None or cached_wr() is not handoff:
             ref = self._ray.put(handoff)
-            self._put_cache = (id(handoff), ref)
+            self._put_cache = (weakref.ref(handoff), ref)
         return ref
 
     def import_prefill(self, handoff, retain: bool = True

@@ -167,7 +167,11 @@ class FleetServer:
         """Add one replica (autoscaler 'up', manual, or backfill)."""
         name = self._add_replica()
         with self._lock:
-            self._target = max(self._target, len(self._replicas))
+            # Count only accepting replicas: _replicas still holds any
+            # draining ones, which must not inflate the fleet target.
+            accepting = sum(
+                1 for r in self._replicas.values() if r.accepting)
+            self._target = max(self._target, accepting)
             self._scales["up"] += 1
         telemetry.inc("ray_tpu_serve_replica_scale_total",
                       tags={"direction": "up"})
@@ -456,9 +460,12 @@ class FleetServer:
         elif not routed:
             self._finish_shed(item, "replica_lost")
             return
+        else:
+            # Count only successfully mapped dispatches so the series
+            # stays in lockstep with status()'s _prefix_counts.
+            telemetry.inc("ray_tpu_serve_prefix_hit_total",
+                          tags={"outcome": outcome})
         self.admission.note_dequeued(item.clazz)
-        telemetry.inc("ray_tpu_serve_prefix_hit_total",
-                      tags={"outcome": outcome})
         self._work.set()
 
     def _dispatch(self, item: _Pending) -> None:

@@ -354,7 +354,13 @@ def _chunked_nll_sum(x, lm_head, targets, mask, num_chunks: int, dt):
         logits = jnp.einsum("bse,ev->bsv", xc, lm_head.astype(dt),
                             preferred_element_type=jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        # promise_in_bounds: targets are token ids < vocab by
+        # construction.  The default mode's NaN fill value poisons the
+        # SPMD-partitioned gather when vocab is sharded (tp) — each
+        # shard's locally-OOB rows fill NaN before the cross-shard
+        # combine.
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1,
+                                  mode="promise_in_bounds")[..., 0]
         return jnp.sum((lse - tgt) * mc)
 
     def body(acc, xtm):
@@ -398,8 +404,12 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
         # elementwise into the dW/dx matmuls.
         logits = logits.astype(jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
+        # promise_in_bounds: targets are token ids < vocab by
+        # construction (see _chunked_nll_sum for why the default NaN
+        # fill breaks under a vocab-sharded partitioned gather).
         tgt = jnp.take_along_axis(logits, targets[..., None],
-                                  axis=-1)[..., 0]
+                                  axis=-1,
+                                  mode="promise_in_bounds")[..., 0]
         nll = lse - tgt
         loss = jnp.sum(nll * mask) / denom
     if cfg.num_experts:

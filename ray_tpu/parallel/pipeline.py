@@ -104,20 +104,20 @@ def pipeline_blocks(stacked_params, x, stage_body: Callable, *,
             check_vma=False,
         )
     else:
-        # Pre-stable API (jax < 0.6): manual-over-pp-only is spelled as
-        # "every OTHER axis stays automatic".  Size-1 axes are dropped
-        # from the auto set — nothing shards over them, and an empty
-        # auto set takes the fully-manual lowering, which legacy
-        # XLA-CPU supports (partial-auto lowers a PartitionId op it
-        # cannot partition).
+        # Pre-stable API (jax < 0.6): always take the fully-manual
+        # lowering (empty auto set) — partial-auto lowers a PartitionId
+        # op legacy XLA-CPU cannot partition.  The in/out specs claim
+        # every non-pp axis replicated, so shard_map all-gathers the
+        # batch/params onto each rank and the pp psum-broadcast output
+        # is truly replicated: numerically identical to
+        # manual-over-pp-only, at an activation-memory cost acceptable
+        # for the legacy fallback.
         from jax.experimental.shard_map import shard_map as _shard_map
         island = _shard_map(
             body, mesh=mesh,
             in_specs=(param_specs, P()),
             out_specs=P(),
             check_rep=False,
-            auto=frozenset(a for a in mesh.axis_names
-                           if a != axis_name and mesh.shape[a] > 1),
         )
     out = island(stacked_params, x_mb)
     return out.reshape(B, S, E)

@@ -125,8 +125,21 @@ def make_lm_train_step(cfg, mesh, *, rules: Optional[ShardingRules] = None,
         init_all, jax.random.key(0))
     opt_shardings = _mirror_param_shardings(
         opt_state_shape, params_shape, param_shardings, mesh)
-    init_fn = jax.jit(init_all,
-                      out_shardings=(param_shardings, opt_shardings))
+    _init_jit = jax.jit(init_all,
+                        out_shardings=(param_shardings, opt_shardings))
+
+    def init_fn(key):
+        # Partitionable threefry for the sharded init only: the default
+        # threefry lowering is NOT sharding-invariant under the SPMD
+        # partitioner (the per-shard counter rewrite changes the bits),
+        # so the same seed would yield different params on different
+        # mesh shapes — an 8-way and a 1-device init must match.
+        old = jax.config.jax_threefry_partitionable
+        jax.config.update("jax_threefry_partitionable", True)
+        try:
+            return _init_jit(key)
+        finally:
+            jax.config.update("jax_threefry_partitionable", old)
 
     def step(params, opt_state, batch):
         if grad_accum > 1:
