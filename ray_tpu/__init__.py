@@ -45,6 +45,15 @@ if _os.environ.get("RAY_TPU_LOCK_PROFILE") == "1":
     from .devtools import lockdebug as _lockdebug
     _lockdebug.install_profile()
 
+# Opt-in implicit host-sync tripwire (devtools/syncdebug.py): patches
+# jax's ArrayImpl host-coercion points so every implicit device->host
+# sync (float()/.item()/np.asarray() on a device array) is timed and
+# attributed to its call site.  Silently a no-op when jax isn't
+# importable in this process.
+if _os.environ.get("RAY_TPU_SYNC_DEBUG") == "1":
+    from .devtools import syncdebug as _syncdebug
+    _syncdebug.install()
+
 # Opt-in runtime resource-leak sanitizer (_private/sanitizer.py):
 # registries for framework threads / pins / tracked files / named
 # actors, snapshotted at cluster start and diffed at shutdown.

@@ -215,6 +215,18 @@ def write_debug_bundle(rt, reason: str,
         return json.dumps(rep, indent=1, default=str)
     section("lock_contention.json", _lock_contention)
 
+    def _syncs():
+        # Host-sync tripwire snapshot (RAY_TPU_SYNC_DEBUG=1): per-site
+        # implicit device->host sync counts and blocked-time histograms,
+        # so a slow-step bundle names the line stalling on the device.
+        # Render with `ray-tpu lint --sync-report <file>`.
+        from ray_tpu.devtools import syncdebug
+        rep = syncdebug.report()
+        if not rep["installed"] and not rep["sites"]:
+            return None
+        return json.dumps(rep, indent=1, default=str)
+    section("sync_findings.json", _syncs)
+
     def _profile():
         # On-demand cluster profile for the incident window (opt-in:
         # the capture blocks for its duration).

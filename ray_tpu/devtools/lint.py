@@ -389,4 +389,4 @@ def explain_text(rule_id: str) -> Optional[str]:
 # Rule modules self-register on import; they import helpers from this
 # module, so this must stay at the bottom.
 from . import (rules_concurrency, rules_dataflow, rules_internal,  # noqa: E402,F401
-               rules_user)
+               rules_jax, rules_user)

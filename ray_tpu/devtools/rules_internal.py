@@ -376,13 +376,6 @@ class NonAtomicPublish(Rule):
                 f"into place (see checkpoint.format.write_bytes_atomic)")
 
 
-#: Modules that dispatch host buffers to devices on mesh/pipeline paths
-#: (RT207): the PR 6 aliasing hazard class — on the CPU/zero-copy
-#: substrate jax.device_put may alias the host ndarray, so a later
-#: in-place write silently corrupts the already-dispatched device value.
-_DEVICE_DISPATCH_MODULES = ("/parallel/", "train/mesh/", "llm/disagg/")
-
-
 @register
 class DevicePutAliasedHostBuffer(Rule):
     id = "RT207"
@@ -406,9 +399,14 @@ class DevicePutAliasedHostBuffer(Rule):
                  "the buffer.")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        if not any(m in ctx.module_key for m in _DEVICE_DISPATCH_MODULES):
-            return
         if "device_put" not in ctx.source:
+            return
+        # Scope: any module in a jax dispatch context — inferred from
+        # the shared RT5xx jax-context detection (imports of jax /
+        # jax.numpy / jax.random, or the lazy `self._jax` handle) —
+        # instead of the old hard-coded directory list.
+        from .rules_jax import module_uses_jax
+        if not module_uses_jax(ctx):
             return
         scopes: List[ast.AST] = [ctx.tree]
         scopes += ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef)

@@ -1,0 +1,1013 @@
+"""JAX correctness & performance lint rules (RT5xx).
+
+The RT1-4xx families audit the control plane; this family audits the
+accelerator hot path — the code that decides whether a step is fast.
+Every rule is grounded in a bug class the repo has already paid for at
+runtime (recompile churn, hidden device→host syncs, donated-buffer
+reads) and pairs with the runtime half in :mod:`ray_tpu.devtools.
+syncdebug` (``RAY_TPU_SYNC_DEBUG=1``), which catches at runtime what
+the static rules cannot see.
+
+* RT501 — Python control flow (``if``/``while``) on a traced value
+  inside a jit-compiled function.  Traced-value flow runs over the
+  per-function CFG (:mod:`ray_tpu.devtools.dataflow`): a name tainted
+  in either branch of an ``if`` is tainted after the join.
+* RT502 — implicit device→host sync per iteration: ``float()`` /
+  ``.item()`` / ``bool()`` / ``np.asarray()`` / ``print`` on a device
+  value inside a loop or comprehension.  One sync per *step* is the
+  blessed batched pattern (see llm/engine.py's "ONE host sync"
+  comments); one sync per *element* is the defect.
+* RT503 — shape-unstable jit call site: a tracked jit called inside a
+  loop on an array built from a list the same loop appends to — a new
+  shape (and a recompile) every iteration.
+* RT504 — donated-buffer read: an argument passed at a
+  ``donate_argnums`` position of a tracked jit is read after the call
+  without being rebound.
+* RT505 — PRNG key reuse: the same key fed to two samplers (or to a
+  sampler inside a loop) without an intervening ``split``/``fold_in``.
+* RT506 — per-iteration op-by-op ``jnp`` dispatch outside any jit in a
+  hot loop: each op is its own device round-trip; jit the body.
+
+Shared here (and consumed by RT207 in rules_internal.py) is the
+jax-context detection: which modules touch jax at all, which names are
+jit-compiled functions, and with which static/donate argument
+semantics.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from . import dataflow
+from .lint import (Finding, ModuleContext, Rule, dotted, register,
+                   walk_same_scope)
+
+# --------------------------------------------------------------------------
+# Shared jax-context detection
+# --------------------------------------------------------------------------
+
+#: Attribute reads that concretize nothing: static metadata available on
+#: tracers and host handles alike (no trace-time branch, no host sync).
+STATIC_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "itemsize", "nbytes", "sharding",
+    "aval", "weak_type", "device", "devices", "is_deleted",
+})
+
+#: Builtins whose result on a traced/device value is static.
+_STATIC_CALLS = frozenset({"len", "isinstance", "type", "id", "repr",
+                           "getattr", "hasattr"})
+
+#: jax.random functions that *derive* keys rather than consume entropy.
+_KEY_DERIVERS = frozenset({"split", "fold_in", "PRNGKey", "key",
+                           "key_data", "wrap_key_data", "clone"})
+
+
+class _JaxContext:
+    """Per-module jax facts, computed once and cached on the
+    ModuleContext (every RT5xx rule and RT207 share one instance)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.jax_names: Set[str] = set()      # names bound to the jax module
+        self.jnp_names: Set[str] = set()      # ... to jax.numpy
+        self.np_names: Set[str] = set()       # ... to (host) numpy
+        self.random_names: Set[str] = set()   # ... to jax.random
+        self.jit_fn_names: Set[str] = set()   # names imported from jax
+        self._scan_imports(ctx)
+        # Lazy-import idiom (llm/engine.py holds `self._jax = jax`):
+        # treat `<anything>._jax` attribute chains as the jax module.
+        self.uses_jax = bool(self.jax_names or self.jnp_names or
+                             self.random_names or self.jit_fn_names or
+                             "._jax." in ctx.source)
+        #: dotted call-site name -> jit kwargs ({"static_argnums": ...,
+        #: "static_argnames": ..., "donate_argnums": ...}); covers
+        #: `self._step = jax.jit(fn, ...)` and `g = jit(f)` bindings.
+        self.jit_sites: Dict[str, Dict[str, object]] = {}
+        #: function-def name -> jit kwargs, for defs that are
+        #: jit-compiled either by decorator or by a jax.jit(<name>)
+        #: wrap elsewhere in the module.
+        self.jit_defs: Dict[str, Dict[str, object]] = {}
+        if self.uses_jax:
+            self._scan_jits(ctx)
+
+    # -- imports -----------------------------------------------------------
+
+    def _scan_imports(self, ctx: ModuleContext) -> None:
+        for node in ctx.nodes(ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                if alias.name == "jax":
+                    self.jax_names.add(bound)
+                elif alias.name == "jax.numpy":
+                    self.jax_names.add("jax")
+                    self.jnp_names.add(alias.asname or "jax.numpy")
+                elif alias.name == "jax.random":
+                    self.jax_names.add("jax")
+                    self.random_names.add(alias.asname or "jax.random")
+                elif alias.name == "numpy":
+                    self.np_names.add(bound)
+        for node in ctx.nodes(ast.ImportFrom):
+            if node.module == "jax":
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if alias.name == "numpy":
+                        self.jnp_names.add(bound)
+                    elif alias.name == "random":
+                        self.random_names.add(bound)
+                    elif alias.name in ("jit", "pjit"):
+                        self.jit_fn_names.add(bound)
+                    else:
+                        self.jax_names.add("jax")
+            elif node.module == "jax.numpy":
+                self.jnp_names.add("jax")  # marker: module uses jnp
+            elif node.module and node.module.startswith("jax."):
+                self.jax_names.add("jax")
+
+    # -- jit bindings ------------------------------------------------------
+
+    def _is_jit_expr(self, func: ast.AST) -> bool:
+        name = dotted(func)
+        if name is None:
+            return False
+        if name in self.jit_fn_names:
+            return True
+        last = name.rsplit(".", 1)[-1]
+        if last not in ("jit", "pjit"):
+            return False
+        head = name.split(".", 1)[0]
+        return head in self.jax_names or ".".join(
+            name.split(".")[:-1]).endswith("_jax")
+
+    @staticmethod
+    def _jit_kwargs(call: ast.Call) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for kw in call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames",
+                          "donate_argnums"):
+                out[kw.arg] = _const_seq(kw.value)
+        return out
+
+    def _scan_jits(self, ctx: ModuleContext) -> None:
+        # Decorated defs: @jax.jit / @jit / @partial(jax.jit, ...).
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            for deco in fn.decorator_list:
+                kwargs = self._decorator_jit_kwargs(deco)
+                if kwargs is not None:
+                    self.jit_defs[fn.name] = kwargs
+                    self.jit_sites[fn.name] = kwargs
+        # Assigned wraps: `target = jax.jit(fn, ...)`.
+        for node in ctx.nodes(ast.Assign):
+            value = node.value
+            if not (isinstance(value, ast.Call) and
+                    self._is_jit_expr(value.func)):
+                continue
+            kwargs = self._jit_kwargs(value)
+            for target in node.targets:
+                tname = dotted(target)
+                if tname:
+                    self.jit_sites[tname] = kwargs
+            inner = value.args[0] if value.args else None
+            # jax.jit(partial(fn, ...)) jits fn with leading args bound.
+            if isinstance(inner, ast.Call) and \
+                    (dotted(inner.func) or "").endswith("partial") and \
+                    inner.args:
+                inner = inner.args[0]
+            iname = dotted(inner) if inner is not None else None
+            if iname and "." not in iname:
+                self.jit_defs[iname] = kwargs
+
+    def _decorator_jit_kwargs(self,
+                              deco: ast.AST) -> Optional[Dict[str, object]]:
+        if self._is_jit_expr(deco):
+            return {}
+        if isinstance(deco, ast.Call):
+            if self._is_jit_expr(deco.func):
+                return self._jit_kwargs(deco)
+            if (dotted(deco.func) or "").endswith("partial") and \
+                    deco.args and self._is_jit_expr(deco.args[0]):
+                return self._jit_kwargs(deco)
+        return None
+
+    # -- expression classification ----------------------------------------
+
+    def is_device_call(self, call: ast.Call) -> bool:
+        """Does this call produce a device value?  jnp.* / jax.* /
+        jax.random.* ops and calls of tracked jit bindings."""
+        name = dotted(call.func)
+        if name is None:
+            return False
+        head = name.split(".", 1)[0]
+        if head in self.jnp_names or head in self.random_names:
+            return True
+        if head in self.jax_names and "." in name:
+            tail = name.split(".", 1)[1]
+            # jax.device_get is the HOST transfer; jax.debug.print /
+            # jax.tree_util etc. are not device values either.
+            if tail.split(".")[0] not in ("debug", "tree_util", "tree",
+                                          "config", "monitoring",
+                                          "device_get"):
+                return True
+        if name in self.jit_sites:
+            return True
+        last = name.rsplit(".", 1)[-1]
+        return last in ("device_put", "device_put_sharded",
+                        "device_put_replicated")
+
+
+def jax_context(ctx: ModuleContext) -> _JaxContext:
+    cached = getattr(ctx, "_rt5_jax", None)
+    if cached is None:
+        cached = ctx._rt5_jax = _JaxContext(ctx)
+    return cached
+
+
+def module_uses_jax(ctx: ModuleContext) -> bool:
+    """Shared jax-context gate (also RT207's scoping): does this module
+    import jax / jax.numpy / jax.random (at module or function level),
+    or hold the lazy ``self._jax`` module handle?"""
+    return jax_context(ctx).uses_jax
+
+
+def _const_seq(node: ast.AST) -> Optional[Tuple[object, ...]]:
+    """Literal static/donate argnum specs: int/str constants and
+    tuples/lists of them.  Non-literal (computed) specs -> None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, str)):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[object] = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and
+                    isinstance(el.value, (int, str))):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def _loops_in(fn: ast.AST) -> List[ast.AST]:
+    return [n for n in walk_same_scope(fn)
+            if isinstance(n, (ast.For, ast.While))]
+
+
+def _is_jitted_def(fn: ast.AST, jc: _JaxContext) -> bool:
+    return getattr(fn, "name", None) in jc.jit_defs
+
+
+# --------------------------------------------------------------------------
+# Traced-value taint over the CFG (RT501)
+# --------------------------------------------------------------------------
+
+
+def _expr_tainted(expr: Optional[ast.AST], tainted: Set[str]) -> bool:
+    """Does evaluating ``expr`` yield a value derived from a tainted
+    (traced) name?  Static metadata reads (``x.shape`` / ``len(x)`` /
+    ``isinstance(x, ...)``) launder the taint — they are concrete at
+    trace time."""
+    if expr is None:
+        return False
+    if isinstance(expr, ast.Attribute) and expr.attr in STATIC_ATTRS:
+        return False
+    if isinstance(expr, ast.Call):
+        fname = dotted(expr.func) or ""
+        if fname in _STATIC_CALLS:
+            return False
+        args: List[ast.AST] = list(expr.args)
+        args += [kw.value for kw in expr.keywords]
+        if isinstance(expr.func, ast.Attribute):
+            # method call on a tainted object (x.sum(), x.astype(...))
+            args.append(expr.func.value)
+        return any(_expr_tainted(a, tainted) for a in args)
+    name = dotted(expr)
+    if name is not None:
+        return name in tainted
+    return any(_expr_tainted(c, tainted)
+               for c in ast.iter_child_nodes(expr))
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in target.elts:
+            out.extend(_assigned_names(el))
+        return out
+    if isinstance(target, ast.Starred):
+        return _assigned_names(target.value)
+    name = dotted(target)
+    return [name] if name else []
+
+
+def _transfer(node: dataflow.Node, tainted: Set[str]) -> Set[str]:
+    """Forward taint transfer for one CFG node (may-be-traced)."""
+    s = node.stmt
+    if s is None:
+        return tainted
+    out = set(tainted)
+    if node.kind == "loop-head" and isinstance(s, (ast.For, ast.AsyncFor)):
+        names = _assigned_names(s.target)
+        if _expr_tainted(s.iter, tainted):
+            out.update(names)
+        else:
+            out.difference_update(names)
+        return out
+    if isinstance(s, ast.Assign):
+        is_t = _expr_tainted(s.value, tainted)
+        for t in s.targets:
+            for name in _assigned_names(t):
+                (out.add if is_t else out.discard)(name)
+        return out
+    if isinstance(s, ast.AnnAssign) and s.value is not None:
+        is_t = _expr_tainted(s.value, tainted)
+        for name in _assigned_names(s.target):
+            (out.add if is_t else out.discard)(name)
+        return out
+    if isinstance(s, ast.AugAssign):
+        names = _assigned_names(s.target)
+        if _expr_tainted(s.value, tainted) or \
+                any(n in tainted for n in names):
+            out.update(names)
+        return out
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+        for item in s.items:
+            if item.optional_vars is None:
+                continue
+            names = _assigned_names(item.optional_vars)
+            if _expr_tainted(item.context_expr, tainted):
+                out.update(names)
+        return out
+    return out
+
+
+def _taint_with_cfg(fn: ast.AST, initial: Set[str]):
+    """Fixpoint may-be-traced analysis over the per-function CFG:
+    (cfg, node idx -> set of traced names *entering* that node).  A
+    name tainted in either branch of an ``if`` is tainted after the
+    join (union meet) — the property tests/test_lint_jax.py pins."""
+    cfg = dataflow.build_cfg(fn)
+    inset: Dict[int, Set[str]] = {n.idx: set() for n in cfg.nodes}
+    inset[cfg.entry] = set(initial)
+    work = [cfg.entry]
+    while work:
+        idx = work.pop()
+        out = _transfer(cfg.nodes[idx], inset[idx])
+        for succ in cfg.successors(idx):
+            if not out <= inset[succ]:
+                inset[succ] |= out
+                work.append(succ)
+    return cfg, inset
+
+
+def traced_taint(fn: ast.AST,
+                 initial: Set[str]) -> Dict[int, Set[str]]:
+    """Public wrapper (the CFG taint unit tests drive this)."""
+    return _taint_with_cfg(fn, initial)[1]
+
+
+def _traced_params(fn: ast.AST, kwargs: Dict[str, object]) -> Set[str]:
+    """Function params minus the static_argnums/static_argnames ones."""
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    static: Set[str] = set()
+    nums = kwargs.get("static_argnums") or ()
+    for n in nums:
+        if isinstance(n, int) and 0 <= n < len(names):
+            static.add(names[n])
+    for n in kwargs.get("static_argnames") or ():
+        if isinstance(n, str):
+            static.add(n)
+    if names and names[0] in ("self", "cls"):
+        static.add(names[0])
+    return {n for n in names if n not in static}
+
+
+@register
+class TracedControlFlow(Rule):
+    id = "RT501"
+    scope = "user"
+    dataflow = True
+    summary = "Python control flow on a traced value inside jit"
+    rationale = ("Inside a jit-compiled function, arguments are tracers "
+                 "without concrete values: `if x > 0:` either raises "
+                 "ConcretizationTypeError or — when it slips through on "
+                 "a weakly-typed path — freezes ONE branch into the "
+                 "compiled program at trace time and silently drops the "
+                 "other.  Branch on data with jax.lax.cond / jnp.where; "
+                 "branch on *shape* freely (x.shape/x.ndim/len(x) are "
+                 "static), or mark the argument static_argnums.")
+    example_bad = (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    if x.sum() > 0:      # traced value in a Python `if`\n"
+        "        return x * 2\n"
+        "    return x\n")
+    example_good = (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return jnp.where(x.sum() > 0, x * 2, x)\n")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        jc = jax_context(ctx)
+        if not jc.uses_jax:
+            return
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            kwargs = jc.jit_defs.get(fn.name)
+            if kwargs is None:
+                continue
+            initial = _traced_params(fn, kwargs)
+            if not initial:
+                continue
+            cfg, taint = _taint_with_cfg(fn, initial)
+            for node in cfg.nodes:
+                s = node.stmt
+                if node.kind == "stmt" and isinstance(s, ast.If):
+                    test = s.test
+                elif node.kind == "loop-head" and isinstance(s, ast.While):
+                    test = s.test
+                else:
+                    continue
+                name = _concretized_name(test, taint[node.idx])
+                if name is None:
+                    continue
+                kind = "while" if isinstance(s, ast.While) else "if"
+                yield ctx.finding(
+                    self, s,
+                    f"`{kind}` on traced value {name!r} inside "
+                    f"jit-compiled `{fn.name}`: tracers have no concrete "
+                    f"truth value — use jax.lax.cond/jnp.where, branch "
+                    f"on shape/dtype (static), or mark it "
+                    f"static_argnums")
+
+
+def _concretized_name(test: ast.AST, tainted: Set[str]) -> Optional[str]:
+    """First traced name whose concrete truth value the test needs, or
+    None.  `x is None` / `x is not None` and `"key" in batch`
+    comparisons are trace-time static (tracers are never None; pytree
+    dict KEYS are concrete even when the values are traced) and
+    exempt."""
+    if isinstance(test, ast.Compare) and \
+            all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in test.ops):
+        return None
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            name = _concretized_name(v, tainted)
+            if name:
+                return name
+        return None
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _concretized_name(test.operand, tainted)
+    if _expr_tainted(test, tainted):
+        for node in ast.walk(test):
+            name = dotted(node)
+            if name in tainted:
+                return name
+        return "<traced>"
+    return None
+
+
+# --------------------------------------------------------------------------
+# Device-value taint (ordered, per scope) shared by RT502/RT503/RT504
+# --------------------------------------------------------------------------
+
+
+#: Host-coercion spellings RT502 flags (and syncdebug patches at
+#: runtime): builtin casts, numpy materialization, and per-element
+#: methods.
+_COERCION_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+_COERCION_METHODS = frozenset({"item", "tolist", "__array__"})
+
+
+class _HotScan:
+    """One ordered walk of a function body: propagates which names hold
+    device values and reports host coercions at loop depth >= 1.
+    Line-ordered like RT207 — cheaper than a fixpoint and right for the
+    straight-line hot paths this targets."""
+
+    def __init__(self, rule: Rule, ctx: ModuleContext, jc: _JaxContext,
+                 fn: ast.AST):
+        self.rule = rule
+        self.ctx = ctx
+        self.jc = jc
+        self.fn = fn
+        self.device: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        for stmt in self.fn.body:
+            self._stmt(stmt, 0)
+        return self.findings
+
+    # -- traversal ---------------------------------------------------------
+
+    def _stmt(self, s: ast.AST, depth: int) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._expr(s.iter, depth)
+            names = _assigned_names(s.target)
+            if self._tainted(s.iter):
+                self.device.update(names)
+            else:
+                self.device.difference_update(names)
+            for child in s.body + s.orelse:
+                self._stmt(child, depth + 1)
+            return
+        if isinstance(s, ast.While):
+            self._expr(s.test, depth)
+            for child in s.body + s.orelse:
+                self._stmt(child, depth + 1)
+            return
+        if isinstance(s, (ast.If,)):
+            self._expr(s.test, depth)
+            for child in s.body + s.orelse:
+                self._stmt(child, depth)
+            return
+        if isinstance(s, ast.Try):
+            for child in (s.body + s.orelse + s.finalbody +
+                          [h for h in s.handlers]):
+                if isinstance(child, ast.ExceptHandler):
+                    for hs in child.body:
+                        self._stmt(hs, depth)
+                else:
+                    self._stmt(child, depth)
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._expr(item.context_expr, depth)
+            for child in s.body:
+                self._stmt(child, depth)
+            return
+        if isinstance(s, ast.Assign):
+            self._expr(s.value, depth)
+            is_dev = self._tainted(s.value)
+            for t in s.targets:
+                for name in _assigned_names(t):
+                    (self.device.add if is_dev
+                     else self.device.discard)(name)
+            return
+        if isinstance(s, ast.AnnAssign) and s.value is not None:
+            self._expr(s.value, depth)
+            is_dev = self._tainted(s.value)
+            for name in _assigned_names(s.target):
+                (self.device.add if is_dev else self.device.discard)(name)
+            return
+        if isinstance(s, ast.AugAssign):
+            self._expr(s.value, depth)
+            if self._tainted(s.value):
+                self.device.update(_assigned_names(s.target))
+            return
+        if isinstance(s, ast.Return) and s.value is not None:
+            self._expr(s.value, depth)
+            return
+        if isinstance(s, ast.Expr):
+            self._expr(s.value, depth)
+            return
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._expr(child, depth)
+
+    def _expr(self, e: Optional[ast.AST], depth: int) -> None:
+        if e is None:
+            return
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            inner = set()
+            for gen in e.generators:
+                self._expr(gen.iter, depth)
+                if self._tainted(gen.iter):
+                    inner.update(_assigned_names(gen.target))
+            saved = set(self.device)
+            self.device |= inner
+            body = [e.key, e.value] if isinstance(e, ast.DictComp) \
+                else [e.elt]
+            for b in body:
+                self._expr(b, depth + 1)
+            for gen in e.generators:
+                for cond in gen.ifs:
+                    self._expr(cond, depth + 1)
+            self.device = saved
+            return
+        if isinstance(e, ast.Call):
+            self._check_coercion(e, depth)
+            for a in e.args:
+                self._expr(a, depth)
+            for kw in e.keywords:
+                self._expr(kw.value, depth)
+            if isinstance(e.func, ast.Attribute):
+                self._expr(e.func.value, depth)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._expr(child, depth)
+
+    # -- classification ----------------------------------------------------
+
+    def _tainted(self, e: Optional[ast.AST]) -> bool:
+        if e is None:
+            return False
+        if isinstance(e, ast.Call) and self.jc.is_device_call(e):
+            return True
+        if isinstance(e, ast.Attribute) and e.attr in STATIC_ATTRS:
+            return False
+        if isinstance(e, ast.Call):
+            fname = dotted(e.func) or ""
+            if fname in _STATIC_CALLS:
+                return False
+            if fname.rsplit(".", 1)[-1] == "device_get":
+                return False  # the blessed explicit host transfer
+            if fname.split(".", 1)[0] in self.jc.np_names:
+                return False  # np.asarray(x) is the HOST copy
+            args = list(e.args) + [kw.value for kw in e.keywords]
+            if isinstance(e.func, ast.Attribute):
+                args.append(e.func.value)
+            return any(self._tainted(a) for a in args)
+        name = dotted(e)
+        if name is not None:
+            return name in self.device
+        return any(self._tainted(c) for c in ast.iter_child_nodes(e))
+
+    def _check_coercion(self, call: ast.Call, depth: int) -> None:
+        if depth < 1:
+            return
+        fname = dotted(call.func) or ""
+        what: Optional[str] = None
+        if fname in _COERCION_BUILTINS and len(call.args) == 1 and \
+                self._tainted(call.args[0]):
+            what = f"{fname}()"
+        elif fname == "print" and any(self._tainted(a)
+                                      for a in call.args):
+            what = "print()"
+        elif isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in _COERCION_METHODS and \
+                    self._tainted(call.func.value):
+                what = f".{attr}()"
+            elif attr in ("asarray", "array") and call.args and \
+                    fname.split(".", 1)[0] in self.jc.np_names and \
+                    self._tainted(call.args[0]):
+                what = f"{fname}()"
+        if what is None:
+            return
+        self.findings.append(self.ctx.finding(
+            self.rule, call,
+            f"implicit device→host sync per iteration: {what} on a "
+            f"device value inside a loop blocks on the device every "
+            f"pass — batch to ONE transfer outside the loop "
+            f"(jax.device_get / a single np.asarray of the stacked "
+            f"result)"))
+
+
+@register
+class HostSyncInHotLoop(Rule):
+    id = "RT502"
+    scope = "user"
+    summary = "implicit device→host sync per loop iteration"
+    rationale = ("float()/.item()/bool()/np.asarray()/print on a device "
+                 "value blocks until the device catches up and ships "
+                 "the value to host.  Once per step is the blessed "
+                 "batched pattern; once per ELEMENT or per iteration "
+                 "turns a fused device program into a sync storm — the "
+                 "exact class the RAY_TPU_SYNC_DEBUG=1 tripwire counts "
+                 "at runtime.  Stack on device, transfer once.")
+    example_bad = (
+        "metrics = train_step(params, batch)   # device dict\n"
+        "return {k: float(v) for k, v in metrics.items()}  # N syncs\n")
+    example_good = (
+        "metrics = train_step(params, batch)\n"
+        "host = jax.device_get(metrics)        # ONE sync\n"
+        "return {k: float(v) for k, v in host.items()}\n")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        jc = jax_context(ctx)
+        if not jc.uses_jax:
+            return
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            if _is_jitted_def(fn, jc):
+                continue  # inside jit these raise TracerError -> RT501
+            yield from _HotScan(self, ctx, jc, fn).run()
+
+
+@register
+class ShapeUnstableJitCall(Rule):
+    id = "RT503"
+    scope = "user"
+    summary = "shape-unstable jit call site in a loop"
+    rationale = ("jax.jit specializes on argument SHAPES: calling a "
+                 "jitted function on an array built from a list the "
+                 "loop itself grows gives a new shape — and a full "
+                 "recompile — every iteration (the recompile detector's "
+                 "warm-site churn, seen statically).  Pad to a fixed "
+                 "shape or bucket to powers of two (see llm/engine.py's "
+                 "chunked prefill).")
+    example_bad = (
+        "buf = []\n"
+        "for tok in stream:\n"
+        "    buf.append(tok)\n"
+        "    logits = decode_fn(jnp.array(buf))  # new shape each step\n")
+    example_good = (
+        "buf = np.zeros((MAX_LEN,), np.int32)\n"
+        "for i, tok in enumerate(stream):\n"
+        "    buf[i] = tok\n"
+        "    logits = decode_fn(jnp.array(buf), i)  # fixed shape\n")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        jc = jax_context(ctx)
+        if not jc.uses_jax or not jc.jit_sites:
+            return
+        array_ctors = jc.jnp_names | jc.np_names
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            for loop in _loops_in(fn):
+                appended = {
+                    dotted(c.func.value)
+                    for c in walk_same_scope(loop)
+                    if isinstance(c, ast.Call) and
+                    isinstance(c.func, ast.Attribute) and
+                    c.func.attr in ("append", "extend") and
+                    dotted(c.func.value)}
+                if not appended:
+                    continue
+                for call in walk_same_scope(loop):
+                    if not (isinstance(call, ast.Call) and
+                            dotted(call.func) in jc.jit_sites):
+                        continue
+                    culprit = self._unstable_arg(call, appended,
+                                                 array_ctors)
+                    if culprit:
+                        yield ctx.finding(
+                            self, call,
+                            f"shape-unstable jit call: "
+                            f"{dotted(call.func)}(...{culprit}...) takes "
+                            f"an array built from a list this loop "
+                            f"appends to — a new shape (and recompile) "
+                            f"every iteration; pad to a fixed shape or "
+                            f"bucket sizes (power-of-two chunks)")
+
+    @staticmethod
+    def _unstable_arg(call: ast.Call, appended: Set[str],
+                      ctors: Set[str]) -> Optional[str]:
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        for arg in args:
+            for node in ast.walk(arg):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted(node.func) or ""
+                head, _, tail = fname.partition(".")
+                if (head in ctors and
+                        tail in ("array", "asarray", "stack")) or \
+                        fname == "len":
+                    inner = node.args[0] if node.args else None
+                    iname = dotted(inner) if inner is not None else None
+                    if iname in appended:
+                        return f"{fname}({iname})"
+        return None
+
+
+@register
+class DonatedBufferRead(Rule):
+    id = "RT504"
+    scope = "user"
+    summary = "donated buffer read after a donate_argnums call"
+    rationale = ("donate_argnums hands the argument's device buffer to "
+                 "the compiled computation for reuse: after the call "
+                 "the old array is DELETED — touching it raises 'Array "
+                 "has been deleted' (or, on backends that alias, reads "
+                 "garbage).  Rebind the name from the call's result "
+                 "(the idiom: `params, state = step(params, state)`), "
+                 "or drop the donation.")
+    example_bad = (
+        "step = jax.jit(train_step, donate_argnums=(0,))\n"
+        "new_params = step(params, batch)\n"
+        "log_norm(params)            # params' buffer was donated\n")
+    example_good = (
+        "step = jax.jit(train_step, donate_argnums=(0,))\n"
+        "params = step(params, batch)   # rebind over the donation\n"
+        "log_norm(params)\n")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        jc = jax_context(ctx)
+        if not jc.uses_jax:
+            return
+        donating = {name: kw["donate_argnums"]
+                    for name, kw in jc.jit_sites.items()
+                    if kw.get("donate_argnums")}
+        if not donating:
+            return
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            yield from self._check_scope(ctx, jc, fn, donating)
+
+    def _check_scope(self, ctx: ModuleContext, jc: _JaxContext,
+                     fn: ast.AST, donating) -> Iterator[Finding]:
+        calls: List[Tuple[ast.Call, List[str]]] = []
+        for node in walk_same_scope(fn):
+            if not (isinstance(node, ast.Call) and
+                    dotted(node.func) in donating):
+                continue
+            nums = donating[dotted(node.func)]
+            donated = [dotted(node.args[i]) for i in nums
+                       if isinstance(i, int) and i < len(node.args) and
+                       dotted(node.args[i])]
+            if donated:
+                calls.append((node, donated))
+        if not calls:
+            return
+        # Line-ordered kill set: assignments to a name end its window.
+        assigns: Dict[str, List[int]] = {}
+        reads: Dict[str, List[Tuple[int, ast.AST]]] = {}
+        for node in walk_same_scope(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for name in _assigned_names(t):
+                        assigns.setdefault(name, []).append(node.lineno)
+            name = dotted(node)
+            if name and isinstance(getattr(node, "ctx", None), ast.Load):
+                reads.setdefault(name, []).append((node.lineno, node))
+        for call, donated in calls:
+            for name in donated:
+                rebind = min((ln for ln in assigns.get(name, ())
+                              if ln >= call.lineno), default=None)
+                for line, node in reads.get(name, ()):
+                    if line <= call.lineno:
+                        continue
+                    if rebind is not None and line >= rebind:
+                        continue
+                    yield ctx.finding(
+                        self, node,
+                        f"{name!r} read after its buffer was donated to "
+                        f"{dotted(call.func)} (donate_argnums, line "
+                        f"{call.lineno}): the donated array is deleted "
+                        f"by the call — rebind {name!r} from the "
+                        f"result before reading it",
+                        anchors=(call,))
+                    break  # one finding per donated name per call
+
+
+@register
+class PrngKeyReuse(Rule):
+    id = "RT505"
+    scope = "user"
+    summary = "PRNG key reused without split"
+    rationale = ("jax.random is splittable-counter based: feeding the "
+                 "SAME key to two samplers (or to one sampler every "
+                 "loop iteration) yields identical 'random' numbers — "
+                 "correlated dropout masks, identical exploration "
+                 "noise, sharding-variant init.  split() before every "
+                 "consumption: `key, sub = jax.random.split(key)` and "
+                 "sample with `sub`.")
+    example_bad = (
+        "key = jax.random.PRNGKey(0)\n"
+        "noise_a = jax.random.normal(key, shape)\n"
+        "noise_b = jax.random.normal(key, shape)  # == noise_a\n")
+    example_good = (
+        "key = jax.random.PRNGKey(0)\n"
+        "key, sub = jax.random.split(key)\n"
+        "noise_a = jax.random.normal(sub, shape)\n"
+        "key, sub = jax.random.split(key)\n"
+        "noise_b = jax.random.normal(sub, shape)\n")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        jc = jax_context(ctx)
+        if not jc.uses_jax:
+            return
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            yield from self._check_scope(ctx, jc, fn)
+
+    def _random_call(self, jc: _JaxContext,
+                     node: ast.AST) -> Optional[str]:
+        """'split'/'fold_in'/sampler name for a jax.random.* call."""
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted(node.func)
+        if not name:
+            return None
+        head, _, tail = name.partition(".")
+        if head in jc.random_names and "." not in tail and tail:
+            return tail
+        if head in jc.jax_names and tail.startswith("random.") and \
+                tail.count(".") == 1:
+            return tail.split(".")[1]
+        return None
+
+    def _check_scope(self, ctx: ModuleContext, jc: _JaxContext,
+                     fn: ast.AST) -> Iterator[Finding]:
+        uses: Dict[str, List[Tuple[int, ast.Call]]] = {}
+        freshened: Dict[str, List[int]] = {}
+        loops = [(lp.lineno, getattr(lp, "end_lineno", lp.lineno), lp)
+                 for lp in _loops_in(fn)]
+        for node in walk_same_scope(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for name in _assigned_names(t):
+                        freshened.setdefault(name, []).append(node.lineno)
+                continue
+            kind = self._random_call(jc, node)
+            if kind is None or kind in _KEY_DERIVERS:
+                continue
+            args = list(node.args) + \
+                [kw.value for kw in node.keywords if kw.arg == "key"]
+            if not args:
+                continue
+            key = dotted(args[0])
+            if key:
+                uses.setdefault(key, []).append((node.lineno, node))
+        for key, sites in uses.items():
+            sites.sort()
+            fresh = sorted(freshened.get(key, ()))
+            # Case 1: two consumptions with no rebind between.
+            prev_line = None
+            flagged = False
+            for line, node in sites:
+                if prev_line is not None and not any(
+                        prev_line < ln <= line for ln in fresh):
+                    yield ctx.finding(
+                        self, node,
+                        f"PRNG key {key!r} reused (also consumed on "
+                        f"line {prev_line}): identical keys give "
+                        f"identical samples — `{key}, sub = jax.random."
+                        f"split({key})` before each use")
+                    flagged = True
+                    break
+                prev_line = line
+            if flagged:
+                continue
+            # Case 2: consumed inside a loop without a per-iteration
+            # refresh of the key in that same loop.
+            for line, node in sites:
+                loop = next((lp for s, e, lp in loops if s <= line <= e),
+                            None)
+                if loop is None:
+                    continue
+                s, e = loop.lineno, getattr(loop, "end_lineno",
+                                            loop.lineno)
+                if any(s <= ln <= e for ln in fresh):
+                    continue
+                if key in _assigned_names(getattr(loop, "target",
+                                                  ast.Tuple(elts=[]))):
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    f"PRNG key {key!r} consumed every iteration of the "
+                    f"loop at line {s} without a split: each pass "
+                    f"samples the SAME numbers — split or fold_in the "
+                    f"key inside the loop")
+                break
+
+
+@register
+class OpByOpDispatchInLoop(Rule):
+    id = "RT506"
+    scope = "user"
+    summary = "per-iteration op-by-op jnp dispatch outside jit"
+    rationale = ("Outside jit every jnp op is its own dispatch: a hot "
+                 "loop running several ops per iteration pays Python "
+                 "dispatch + executable launch per OP per STEP, and "
+                 "nothing fuses.  Wrap the loop body in a jitted "
+                 "function (one compiled program per iteration) or "
+                 "lift the whole loop into jax.lax.scan/fori_loop.")
+    example_bad = (
+        "for batch in stream:\n"
+        "    h = jnp.dot(batch, w1)\n"
+        "    h = jnp.tanh(h + b1)\n"
+        "    out = jnp.dot(h, w2)      # 3+ dispatches every pass\n")
+    example_good = (
+        "@jax.jit\n"
+        "def fwd(batch, w1, b1, w2):\n"
+        "    return jnp.dot(jnp.tanh(jnp.dot(batch, w1) + b1), w2)\n"
+        "for batch in stream:\n"
+        "    out = fwd(batch, w1, b1, w2)  # one compiled program\n")
+
+    #: jnp op calls per loop body before the loop counts as op-by-op
+    #: hot (1-2 ops is often glue around an already-jitted call).
+    THRESHOLD = 3
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        jc = jax_context(ctx)
+        if not jc.uses_jax or not jc.jnp_names:
+            return
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            if _is_jitted_def(fn, jc):
+                continue  # traced once, not dispatched per iteration
+            for loop in _loops_in(fn):
+                ops: List[str] = []
+                for node in walk_same_scope(loop):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted(node.func) or ""
+                    head, _, tail = name.partition(".")
+                    if head in jc.jnp_names and tail and \
+                            not tail.startswith(("asarray", "array")):
+                        ops.append(name)
+                if len(ops) < self.THRESHOLD:
+                    continue
+                distinct = sorted(set(ops))
+                shown = ", ".join(distinct[:4])
+                yield ctx.finding(
+                    self, loop,
+                    f"op-by-op dispatch in a hot loop: {len(ops)} jnp "
+                    f"op calls ({shown}{', ...' if len(distinct) > 4 else ''}) "
+                    f"dispatch individually every iteration outside "
+                    f"jit — jit the body or lift the loop into "
+                    f"jax.lax.scan")

@@ -154,7 +154,9 @@ def prefill_chunk(params: Dict[str, Any], kv_pages,
 
     n_layers = params["blocks"]["wq"].shape[0]
     kv_pages = list(kv_pages)
-    for li in range(n_layers):
+    # Jitted by callers (engine's prefill-chunk jit / disagg prefill): the
+    # layer loop unrolls at trace time, it never dispatches op-by-op.
+    for li in range(n_layers):  # ray-tpu: noqa[RT506]
         layer = jax.tree.map(lambda a, li=li: a[li], params["blocks"])
         kv = kv_pages[li]
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)

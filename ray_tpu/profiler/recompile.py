@@ -52,7 +52,8 @@ _tls = threading.local()
 
 class _SiteState:
     __slots__ = ("name", "signatures", "compiles", "compile_s", "warm",
-                 "recompiles", "warned", "last_signature")
+                 "recompiles", "warned", "last_signature",
+                 "static_argnums", "static_argnames", "donate_argnums")
 
     def __init__(self, name: str):
         self.name = name
@@ -63,6 +64,9 @@ class _SiteState:
         self.recompiles = 0
         self.warned = False
         self.last_signature: Optional[str] = None
+        self.static_argnums: tuple = ()
+        self.static_argnames: tuple = ()
+        self.donate_argnums: tuple = ()
 
 
 def _on_event_duration(event: str, duration_s: float, **_kw) -> None:
@@ -98,10 +102,30 @@ def _ensure_listener() -> bool:
     return True
 
 
-def _signature(args: tuple, kwargs: dict) -> str:
-    """Compact shape/dtype signature of a call's arguments.  Only
-    computed when a compile actually fired (never on the per-step hot
-    path), so an O(tree) walk here is fine."""
+def _norm_argnums(v: Any) -> tuple:
+    if v is None:
+        return ()
+    if isinstance(v, int):
+        return (v,)
+    return tuple(v)
+
+
+def _norm_argnames(v: Any) -> tuple:
+    if v is None:
+        return ()
+    if isinstance(v, str):
+        return (v,)
+    return tuple(v)
+
+
+def _signature(args: tuple, kwargs: dict, static_argnums: tuple = (),
+               static_argnames: tuple = ()) -> str:
+    """Compact shape/dtype signature of a call's arguments.  Static
+    arguments (per the site's jit kwargs) are rendered by VALUE in a
+    separate ``static(...)`` suffix — a changed static value is an
+    expected recompile, and the warning path tells them apart by this
+    split.  Only computed when a compile actually fired (never on the
+    per-step hot path), so an O(tree) walk here is fine."""
     def leaf(x: Any) -> str:
         shape = getattr(x, "shape", None)
         dtype = getattr(x, "dtype", None)
@@ -112,15 +136,35 @@ def _signature(args: tuple, kwargs: dict) -> str:
             return f"{type(x).__name__}={x!r}"
         return type(x).__name__
 
-    try:
-        import jax
-        leaves = jax.tree_util.tree_leaves((args, kwargs))
-    except Exception:  # noqa: BLE001
-        leaves = list(args) + list(kwargs.values())
-    parts = [leaf(x) for x in leaves]
+    def flat(x: Any) -> list:
+        try:
+            import jax
+            return jax.tree_util.tree_leaves(x)
+        except Exception:  # noqa: BLE001
+            return [x]
+
+    parts: List[str] = []
+    static: List[str] = []
+    for i, a in enumerate(args):
+        if i in static_argnums:
+            static.append(f"[{i}]={a!r}")
+        else:
+            parts.extend(leaf(x) for x in flat(a))
+    for k in sorted(kwargs):
+        if k in static_argnames:
+            static.append(f"{k}={kwargs[k]!r}")
+        else:
+            parts.extend(leaf(x) for x in flat(kwargs[k]))
     if len(parts) > 64:
         parts = parts[:64] + [f"...(+{len(parts) - 64} leaves)"]
-    return "(" + ", ".join(parts) + ")"
+    sig = "(" + ", ".join(parts) + ")"
+    if static:
+        sig += " static(" + ", ".join(static) + ")"
+    return sig
+
+
+def _traced_part(sig: str) -> str:
+    return sig.split(" static(")[0]
 
 
 class TrackedFunction:
@@ -128,9 +172,24 @@ class TrackedFunction:
     attribute (``.lower``, ``.compile``, ...) to the wrapped function so
     AOT workflows keep working."""
 
-    def __init__(self, fn, site: str):
+    def __init__(self, fn, site: str, static_argnums: Any = None,
+                 static_argnames: Any = None, donate_argnums: Any = None):
         self.__wrapped__ = fn
         self._site = _site_state(site)
+        # Jit kwargs forwarded from track()/the jax.jit patch: static
+        # args are signature'd by VALUE (a change there is an expected
+        # recompile, not shape churn) and donation is surfaced so
+        # tooling reading the wrapper sees the same contract the
+        # underlying jit was built with.
+        self.static_argnums = _norm_argnums(static_argnums)
+        self.static_argnames = _norm_argnames(static_argnames)
+        self.donate_argnums = _norm_argnums(donate_argnums)
+        if self.static_argnums:
+            self._site.static_argnums = self.static_argnums
+        if self.static_argnames:
+            self._site.static_argnames = self.static_argnames
+        if self.donate_argnums:
+            self._site.donate_argnums = self.donate_argnums
 
     def __getattr__(self, name: str):
         if name == "__wrapped__":
@@ -163,7 +222,8 @@ class TrackedFunction:
                       frame["compiles"], tags=tags)
         telemetry.observe("ray_tpu_profiler_compile_seconds",
                           frame["compile_s"], tags=tags)
-        sig = _signature(args, kwargs)
+        sig = _signature(args, kwargs, self.static_argnums,
+                         self.static_argnames)
         with _lock:
             site.compiles += frame["compiles"]
             site.compile_s += frame["compile_s"]
@@ -181,7 +241,25 @@ class TrackedFunction:
             prior = [s for s in site.signatures if s != sig]
         if post_warmup:
             telemetry.inc("ray_tpu_profiler_recompiles_total", tags=tags)
-            if warn_now:
+            # Same traced shapes as an earlier signature -> only the
+            # static(...) suffix changed: an expected recompile (each
+            # static value compiles its own program by design), so the
+            # advice differs from the shape-churn warning.
+            static_only = any(_traced_part(p) == _traced_part(sig)
+                              for p in prior)
+            if warn_now and static_only:
+                logger.warning(
+                    "post-warmup recompilation of %r (%.2fs of XLA "
+                    "compile): a STATIC argument changed value — %s "
+                    "(previously seen: %s).  Each distinct static value "
+                    "compiles its own program; if this static varies "
+                    "per step, make it a traced argument or bucket its "
+                    "values.  (warned once per site; "
+                    "ray_tpu_profiler_recompiles_total{fn=%r} keeps "
+                    "counting)",
+                    site.name, frame["compile_s"], sig,
+                    "; ".join(prior[-3:]) or "<none recorded>", site.name)
+            elif warn_now:
                 logger.warning(
                     "post-warmup recompilation of %r (%.2fs of XLA "
                     "compile): argument shapes/dtypes changed to %s "
@@ -202,16 +280,23 @@ def _site_state(name: str) -> _SiteState:
         return st
 
 
-def track(fn, name: Optional[str] = None):
+def track(fn, name: Optional[str] = None, static_argnums: Any = None,
+          static_argnames: Any = None, donate_argnums: Any = None):
     """Wrap ``fn`` (typically a jitted function) with per-site compile
-    accounting and post-warmup recompile detection."""
+    accounting and post-warmup recompile detection.  Pass the same
+    ``static_argnums``/``static_argnames``/``donate_argnums`` the jit
+    was built with so signatures classify static-value changes as
+    expected recompiles (the ``jax.jit`` patch forwards them
+    automatically)."""
     if isinstance(fn, TrackedFunction):
         return fn
     site = name or getattr(fn, "__name__", None) \
         or type(fn).__name__
     global _enabled
     _enabled = True
-    return TrackedFunction(fn, site)
+    return TrackedFunction(fn, site, static_argnums=static_argnums,
+                           static_argnames=static_argnames,
+                           donate_argnums=donate_argnums)
 
 
 def install(patch_jit: bool = True) -> bool:
@@ -233,7 +318,10 @@ def install(patch_jit: bool = True) -> bool:
         out = _orig_jit(*args, **kwargs)
         if args and callable(args[0]) and callable(out):
             name = getattr(args[0], "__name__", None) or "jit"
-            return track(out, name=name)
+            return track(out, name=name,
+                         static_argnums=kwargs.get("static_argnums"),
+                         static_argnames=kwargs.get("static_argnames"),
+                         donate_argnums=kwargs.get("donate_argnums"))
         return out
 
     try:
@@ -269,6 +357,9 @@ def report() -> Dict[str, Any]:
             "recompiles": st.recompiles,
             "signatures": list(st.signatures),
             "last_signature": st.last_signature,
+            "static_argnums": list(st.static_argnums),
+            "static_argnames": list(st.static_argnames),
+            "donate_argnums": list(st.donate_argnums),
         } for name, st in _sites.items()}
 
 

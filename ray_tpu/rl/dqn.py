@@ -92,6 +92,35 @@ class DQN(Algorithm):
         self._steps = 0
         self._rng = np.random.default_rng(config.seed)
         self._q_fn = jax.jit(self.module.q_values)
+
+        def targets_dev(target_params, online_params, next_obs, rewards,
+                        terminateds):
+            # Whole TD-target computation on device: the old host-side
+            # version fetched q_next_target AND q_next_online per
+            # update (two blocking transfers) and ran argmax/
+            # take_along_axis on host.  cfg.double_q is a trace-time
+            # constant: one branch compiles.
+            import jax.numpy as jnp
+            q_next_target = self.module.q_values(target_params, next_obs)
+            if config.double_q:
+                q_next_online = self.module.q_values(online_params,
+                                                     next_obs)
+                best = jnp.argmax(q_next_online, axis=-1)
+            else:
+                best = jnp.argmax(q_next_target, axis=-1)
+            next_q = jnp.take_along_axis(q_next_target, best[:, None],
+                                         -1)[:, 0]
+            return (rewards + config.gamma * (1.0 - terminateds) * next_q
+                    ).astype(jnp.float32)
+
+        def q_taken_dev(params, obs, actions):
+            import jax.numpy as jnp
+            q = self.module.q_values(params, obs)
+            return jnp.take_along_axis(q, actions[:, None].astype(
+                jnp.int32), -1)[:, 0]
+
+        self._targets_fn = jax.jit(targets_dev)
+        self._q_taken_fn = jax.jit(q_taken_dev)
         self._ep_return = 0.0
         self._returns: list = []
 
@@ -112,23 +141,13 @@ class DQN(Algorithm):
     # -- training ----------------------------------------------------------- #
 
     def _targets(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
-        import jax.numpy as jnp
-
-        cfg: DQNConfig = self.config
-        q_next_target = np.asarray(
-            self._q_fn(self.target_params, batch["next_obs"]))
-        if cfg.double_q:
-            q_next_online = np.asarray(
-                self._q_fn(self.learner.params, batch["next_obs"]))
-            best = np.argmax(q_next_online, axis=-1)
-        else:
-            best = np.argmax(q_next_target, axis=-1)
-        next_q = np.take_along_axis(q_next_target, best[:, None], -1)[:, 0]
-        return (batch["rewards"]
-                + cfg.gamma * (1.0 - batch["terminateds"]) * next_q
-                ).astype(np.float32)
+        import jax
+        return jax.device_get(self._targets_fn(
+            self.target_params, self.learner.params, batch["next_obs"],
+            batch["rewards"], batch["terminateds"]))
 
     def training_step(self) -> Dict[str, Any]:
+        import jax
         cfg: DQNConfig = self.config
         metrics: Dict[str, float] = {}
         for _ in range(cfg.rollout_fragment_length):
@@ -153,10 +172,12 @@ class DQN(Algorithm):
                     batch["weights"] = w
                     batch["targets"] = self._targets(batch)
                     metrics = self.learner.update(batch)
-                    q = np.asarray(self._q_fn(self.learner.params,
-                                              batch["obs"]))
-                    q_taken = np.take_along_axis(
-                        q, batch["actions"][:, None].astype(int), -1)[:, 0]
+                    # Gather-on-device + ONE explicit transfer: the old
+                    # np.asarray of the full [B, A] q-table synced per
+                    # update and gathered on host.
+                    q_taken = jax.device_get(self._q_taken_fn(
+                        self.learner.params, batch["obs"],
+                        batch["actions"]))
                     self.buffer.update_priorities(
                         idx, q_taken - batch["targets"])
                 else:

@@ -148,24 +148,31 @@ class EnvRunner:
 
         for t in range(num_steps):
             self._key, sub = jax.random.split(self._key)
+            # Every branch lands its outputs with ONE batched
+            # device->host transfer (jax.device_get of the whole
+            # tuple); per-array np.asarray here cost 3 device syncs
+            # per env step (RT502).
             if self.recurrent:
                 if self.explore:
-                    actions, logp, values, new_state = self._explore_rec(
-                        self.params, self._obs, self._rec_state, sub)
+                    actions, logp, values, new_state = jax.device_get(
+                        self._explore_rec(self.params, self._obs,
+                                          self._rec_state, sub))
                 else:
                     # Greedy, like the non-recurrent forward_inference
                     # contract for evaluation runners.
-                    logits, _v, new_state = self._step_fn(
-                        self.params, self._obs, self._rec_state)
-                    actions = np.argmax(np.asarray(logits), axis=-1)
+                    logits, _v, new_state = jax.device_get(
+                        self._step_fn(self.params, self._obs,
+                                      self._rec_state))
+                    actions = np.argmax(logits, axis=-1)
                     logp = np.zeros(n, np.float32)
                     values = np.zeros(n, np.float32)
                 self._rec_state = np.asarray(new_state)
             elif self.explore:
-                actions, logp, values = self._explore_fn(
-                    self.params, self._obs, sub)
+                actions, logp, values = jax.device_get(
+                    self._explore_fn(self.params, self._obs, sub))
             else:
-                actions = self._infer_fn(self.params, self._obs)
+                actions = jax.device_get(
+                    self._infer_fn(self.params, self._obs))
                 logp = np.zeros(n, np.float32)
                 values = np.zeros(n, np.float32)
             actions = np.asarray(actions)
@@ -202,9 +209,9 @@ class EnvRunner:
                     # pre-reset state (the state that produced it).
                     _lg, v_dev, _st = self._step_fn(
                         self.params, fo, np.asarray(new_state))
-                    vals = np.asarray(v_dev)
+                    vals = jax.device_get(v_dev)
                 else:
-                    vals = np.asarray(self._value_fn(self.params, fo))
+                    vals = jax.device_get(self._value_fn(self.params, fo))
                 boot_buf[t, truncs] = vals[truncs]
             self._ep_returns += rewards
             self._ep_lens += 1

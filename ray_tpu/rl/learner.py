@@ -73,15 +73,22 @@ class JaxLearner:
 
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """Fused grad+apply (reference: Learner.update:1028)."""
+        import jax
         self.params, self.opt_state, metrics = self._step(
             self.params, self.opt_state, batch)
-        return {k: float(v) for k, v in metrics.items()}
+        # ONE device->host transfer for the whole metrics dict: a
+        # per-value float() would block on the device once per metric
+        # per step (RT502).
+        host = jax.device_get(metrics)
+        return {k: float(v) for k, v in host.items()}
 
     # -- distributed path ------------------------------------------------- #
 
     def compute_gradients(self, batch) -> Tuple[Any, Dict[str, float]]:
+        import jax
         grads, metrics = self._grads(self.params, batch)
-        return grads, {k: float(v) for k, v in metrics.items()}
+        host = jax.device_get(metrics)  # ONE transfer (see update())
+        return grads, {k: float(v) for k, v in host.items()}
 
     def apply_gradients(self, grads) -> bool:
         self.params, self.opt_state = self._apply(

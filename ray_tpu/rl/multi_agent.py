@@ -122,11 +122,10 @@ class MultiAgentEnvRunner:
             for pid, aids in by_policy.items():
                 obs_batch = np.stack([self._obs[a] for a in aids])
                 self._key, sub = jax.random.split(self._key)
-                acts, logp, vals = self._explore[pid](
-                    self.params[pid], obs_batch, sub)
-                acts = np.asarray(acts)
-                logp = np.asarray(logp)
-                vals = np.asarray(vals)
+                # ONE batched transfer per policy forward, not three
+                # per-array syncs (RT502).
+                acts, logp, vals = jax.device_get(self._explore[pid](
+                    self.params[pid], obs_batch, sub))
                 for i, aid in enumerate(aids):
                     actions[aid] = int(acts[i])
                     step_meta[aid] = (pid, float(logp[i]), float(vals[i]))

@@ -421,18 +421,27 @@ class CQL(Algorithm):
                                   learning_rate=config.lr, seed=config.seed)
         self.target_params = self.learner.params
         self._q_fn = jax.jit(self.module.q_values)
+
+        def targets_dev(target_params, next_obs, rewards, terminateds):
+            # Bellman target on device: the old path shipped the whole
+            # [B, A] q-table to host per update just to max over it.
+            import jax.numpy as jnp
+            q_next = self.module.q_values(target_params, next_obs)
+            return (rewards + config.gamma * (1.0 - terminateds)
+                    * q_next.max(-1)).astype(jnp.float32)
+
+        self._targets_fn = jax.jit(targets_dev)
         self._n_updates = 0
 
     def training_step(self) -> Dict[str, Any]:
+        import jax
         cfg: CQLConfig = self.config
         metrics: Dict[str, float] = {}
         for _ in range(cfg.updates_per_iteration):
             batch = self.data.sample(cfg.train_batch_size)
-            q_next = np.asarray(self._q_fn(self.target_params,
-                                           batch["next_obs"]))
-            targets = (batch["rewards"] + cfg.gamma
-                       * (1.0 - batch["terminateds"]) * q_next.max(-1)
-                       ).astype(np.float32)
+            targets = jax.device_get(self._targets_fn(
+                self.target_params, batch["next_obs"], batch["rewards"],
+                batch["terminateds"]))
             metrics = self.learner.update({
                 "obs": batch["obs"], "actions": batch["actions"],
                 "targets": targets,

@@ -209,6 +209,8 @@ class SAC(Algorithm):
                 batch = self.buffer.sample(cfg.train_batch_size)
                 self._key, sub = jax.random.split(self._key)
                 self.state, m = self._update(self.state, batch, sub)
+                # ONE transfer for the metrics dict, not one per value.
+                m = jax.device_get(m)
                 metrics = {k: float(v) for k, v in m.items()}
         recent = self._returns[-100:]
         return {

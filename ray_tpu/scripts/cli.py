@@ -816,8 +816,13 @@ def stack(address, timeout, output):
               help="Print the top-contended-locks table from a "
                    "lock_contention.json (flight-recorder bundle or "
                    "RAY_TPU_LOCK_PROFILE=1 dump), then exit.")
+@click.option("--sync-report", "sync_report", metavar="FILE",
+              default=None,
+              help="Print the hottest implicit host-sync sites from a "
+                   "sync_findings.json (flight-recorder bundle or "
+                   "RAY_TPU_SYNC_DEBUG=1 dump), then exit.")
 def lint(paths, fmt, list_rules, explain_rule, internal, changed, base,
-         lock_report):
+         lock_report, sync_report):
     """Framework-aware static analysis (see README "Static analysis").
 
     Checks user code for ray_tpu anti-patterns (blocking get() inside
@@ -849,6 +854,16 @@ def lint(paths, fmt, list_rules, explain_rule, internal, changed, base,
             click.echo(f"cannot read lock report {lock_report!r}: {e}")
             raise SystemExit(2)
         click.echo(lockdebug.format_contention(doc))
+        return
+    if sync_report is not None:
+        from ray_tpu.devtools import syncdebug
+        try:
+            with open(sync_report, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            click.echo(f"cannot read sync report {sync_report!r}: {e}")
+            raise SystemExit(2)
+        click.echo(syncdebug.format_sync(doc))
         return
     if changed:
         try:

@@ -412,6 +412,22 @@ CATALOG: Dict[str, Dict[str, Any]] = {
                        "contention profiler.  Long holds on a "
                        "contended site are the thing to shrink first "
                        "(see ray-tpu lint --lock-report)."},
+    # -- jax (host-sync tripwire) ------------------------------------------
+    "ray_tpu_jax_host_sync_total": {
+        "type": "counter", "tag_keys": ("site",),
+        "description": "Implicit jax device->host syncs by call site "
+                       "(float()/.item()/np.asarray() on device arrays), "
+                       "from the opt-in tripwire (RAY_TPU_SYNC_DEBUG=1).  "
+                       "Published in batches of 64 per site; a hot site "
+                       "in a step/decode loop is an RT502 to fix."},
+    "ray_tpu_jax_host_sync_seconds": {
+        "type": "histogram", "tag_keys": ("site",),
+        "boundaries": _LATENCY_BUCKETS,
+        "description": "Sampled blocked-time of implicit device->host "
+                       "syncs by call site (~1/64th of syncs), from the "
+                       "opt-in tripwire.  The histogram shows how long "
+                       "the host thread stalls waiting on the device "
+                       "(see ray-tpu lint --sync-report)."},
     # -- metricsview (time-series backplane) -------------------------------
     "ray_tpu_metricsview_points_total": {
         "type": "counter", "tag_keys": (),

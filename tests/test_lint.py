@@ -566,9 +566,20 @@ def dispatch(sharding):
         assert rule_ids(src, internal=True,
                         path="ray_tpu/train/mesh/runtime.py") == []
 
-    def test_out_of_scope_module_negative(self):
-        # Only mesh/pipeline/disagg dispatch sites are in scope.
+    def test_scope_inferred_from_jax_context(self):
+        # Scoping rides the shared RT5xx jax-context detection (any
+        # module importing jax), not the old hard-coded directory
+        # list: the same aliasing hazard fires outside mesh/pipeline
+        # dirs too.
         assert rule_ids(self.BAD, internal=True,
+                        path="ray_tpu/serve/api.py") == ["RT207"]
+
+    def test_out_of_scope_module_negative(self):
+        # A module with no jax context (a device_put on some unrelated
+        # object, jax never imported) stays out of scope.
+        src = self.BAD.replace("import jax\n", "").replace(
+            "jax.device_put", "backend.device_put")
+        assert rule_ids(src, internal=True,
                         path="ray_tpu/serve/api.py") == []
 
     def test_suppression(self):

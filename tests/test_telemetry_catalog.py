@@ -24,7 +24,7 @@ from ray_tpu.util import telemetry
 _NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
 SUBSYSTEMS = ("serve", "llm", "train", "ckpt", "data", "node", "profiler",
               "internal", "autoscaler", "slice", "sched", "metricsview",
-              "alerts", "store", "lock")
+              "alerts", "store", "lock", "jax")
 
 
 class TestCatalog:
@@ -438,6 +438,18 @@ class TestSmokeAllSubsystems:
                     pass
         finally:
             lockdebug.uninstall_profile()
+
+        # -- jax: the host-sync tripwire publishes on the FIRST sync of a
+        # site (then every 64th), so one forced device->host coercion
+        # under install() deterministically lands both ray_tpu_jax_*
+        # series.
+        from ray_tpu.devtools import syncdebug
+        syncdebug.install()
+        try:
+            float(jnp.sum(jnp.arange(8.0)))
+        finally:
+            syncdebug.uninstall()
+            syncdebug.clear()
 
         # -- data: a small pipeline through the streaming executor --------
         import ray_tpu.data as rdata
